@@ -1,0 +1,24 @@
+"""jepsen_tpu — a TPU-native distributed-systems-testing framework.
+
+A host-side harness drives a distributed system with generator-scheduled
+concurrent client operations while a nemesis injects faults, records every
+operation into a *history*, and then checks those histories for correctness
+on TPU: histories are encoded as padded int32 op tensors and thousands of
+fault-seeded histories are verified per XLA call using vmapped bitset-frontier
+kernels sharded over the device mesh.
+
+Plugin boundaries mirror the reference framework's six protocols
+(see /root/reference/jepsen/src/jepsen/core.clj:330-350):
+
+- ``OS``        — jepsen_tpu.os_
+- ``DB``        — jepsen_tpu.db
+- ``Client``    — jepsen_tpu.client
+- ``Net``       — jepsen_tpu.net
+- ``Generator`` — jepsen_tpu.gen
+- ``Checker``   — jepsen_tpu.checkers
+
+A *test* is a plain dict wiring implementations together; ``runtime.run``
+executes it and ``checkers`` analyze the resulting history.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+from .core import (
+    Checker,
+    check,
+    check_safe,
+    merge_valid,
+    compose,
+    unbridled_optimism,
+    VALID_PRIORITIES,
+)
+from .simple import (
+    set_checker,
+    queue_checker,
+    total_queue_checker,
+    unique_ids_checker,
+    counter_checker,
+)
+from .linearizable import linearizable, LinearizableChecker

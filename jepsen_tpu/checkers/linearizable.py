@@ -1,0 +1,143 @@
+"""Linearizability checking.
+
+The host engine is an exact Wing–Gong/JIT-style state-space search over
+*configurations* ``(model-state, frozenset-of-linearized-pending-ops)`` —
+the same search the reference delegates to Knossos
+(jepsen/src/jepsen/checker.clj:82-107), reformulated so the configuration
+set is a set of small immutable tuples:
+
+- walking the history in real-time order, any subset of currently-pending
+  ops may linearize between two events (computed as a closure);
+- an op that completes ``ok`` must already be linearized at its completion;
+- ``fail`` ops never happened (dropped);
+- ``info`` (indeterminate) ops stay pending to the end of the history —
+  configurations may or may not include them.
+
+The history is linearizable iff the configuration set is non-empty after
+every completion. This exact formulation is also the spec for the TPU
+kernel (jepsen_tpu.ops.linearize), which represents the same configuration
+set densely as a bitset tensor ``[states, 2^pending]``.
+
+Backends:
+  host   — this module's pure-Python engine (reference oracle).
+  native — C++ engine (jepsen_tpu.native), same algorithm, much faster.
+  tpu    — batched XLA path (jepsen_tpu.ops.linearize) for encodable
+           histories; falls back to host when a history exceeds the
+           kernel's static bounds.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..history.core import complete, without_failures, client_ops
+from ..history.ops import Op, INVOKE, OK, FAIL, INFO
+from ..models.core import Model, is_inconsistent
+from .core import Checker
+
+
+def prepare_history(history: List[Op]) -> List[Op]:
+    """Completion-propagated, failure-free client ops — the event stream
+    the search (and the TPU encoder) consumes."""
+    h = [op for op in history if op.is_client]
+    h = complete(h)
+    h = without_failures(h)
+    return h
+
+
+def wgl_check(model: Model, history: List[Op],
+              max_configs: int = 2_000_000) -> dict:
+    """Exact linearizability decision for one history.
+
+    Returns {"valid": bool|"unknown", "op": first-impossible-op,
+             "configs": sample of surviving configs before failure}.
+    """
+    h = prepare_history(history)
+
+    configs = {(model, frozenset())}
+    pending: dict = {}            # op-id -> op (with observed value)
+    open_by_process: dict = {}    # process -> op-id
+
+    def closure(configs):
+        work = list(configs)
+        seen = set(configs)
+        while work:
+            m, s = work.pop()
+            for oid, op in pending.items():
+                if oid in s:
+                    continue
+                m2 = m.step(op)
+                if is_inconsistent(m2):
+                    continue
+                c2 = (m2, s | {oid})
+                if c2 not in seen:
+                    seen.add(c2)
+                    work.append(c2)
+            if len(seen) > max_configs:
+                raise MemoryError("config-set explosion")
+        return seen
+
+    try:
+        for op in h:
+            if op.type == INVOKE:
+                oid = op.index if op.index is not None else id(op)
+                pending[oid] = op
+                open_by_process[op.process] = oid
+                configs = closure(configs)
+            elif op.type == OK:
+                oid = open_by_process.pop(op.process, None)
+                if oid is None:
+                    continue
+                survivors = {(m, s - {oid}) for (m, s) in configs if oid in s}
+                del pending[oid]
+                if not survivors:
+                    return {
+                        "valid": False,
+                        "op": op.to_dict(),
+                        "configs": _sample_configs(configs),
+                    }
+                configs = closure(survivors)
+            elif op.type == INFO:
+                # Stays pending until the end; nothing changes now.
+                open_by_process.pop(op.process, None)
+    except MemoryError as e:
+        return {"valid": "unknown", "error": str(e)}
+
+    return {"valid": True, "configs": _sample_configs(configs)}
+
+
+def _sample_configs(configs, n: int = 10):
+    out = []
+    for m, s in list(configs)[:n]:
+        out.append({"model": repr(m), "pending": sorted(s)})
+    return out
+
+
+class LinearizableChecker(Checker):
+    """Validates linearizability. ``backend`` picks the engine; "tpu"
+    checks on device when the history fits the kernel's static bounds
+    and falls back to the host engine otherwise."""
+
+    def __init__(self, backend: str = "host", **kw):
+        assert backend in ("host", "native", "tpu")
+        # Fail fast at construction if the backend isn't available.
+        if backend == "native":
+            from ..native import wgl_check_native  # noqa: F401
+        elif backend == "tpu":
+            from ..ops.linearize import check_one_tpu  # noqa: F401
+        self.backend = backend
+        self.kw = kw
+
+    def check(self, test, model, history, opts=None) -> dict:
+        if self.backend == "host":
+            return wgl_check(model, history, **self.kw)
+        if self.backend == "native":
+            from ..native import wgl_check_native
+            return wgl_check_native(model, history, **self.kw)
+        if self.backend == "tpu":
+            from ..ops.linearize import check_one_tpu
+            return check_one_tpu(model, history, **self.kw)
+        raise AssertionError
+
+
+def linearizable(backend: str = "host", **kw) -> Checker:
+    return LinearizableChecker(backend=backend, **kw)

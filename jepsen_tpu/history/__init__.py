@@ -1,0 +1,27 @@
+from .ops import (
+    Op,
+    INVOKE,
+    OK,
+    FAIL,
+    INFO,
+    invoke_op,
+    ok_op,
+    fail_op,
+    info_op,
+)
+from .core import (
+    History,
+    pairs,
+    complete,
+    index,
+    processes,
+    without_failures,
+)
+from .codec import write_jsonl, read_jsonl, dumps_op, loads_op
+
+__all__ = [
+    "Op", "INVOKE", "OK", "FAIL", "INFO",
+    "invoke_op", "ok_op", "fail_op", "info_op",
+    "History", "pairs", "complete", "index", "processes", "without_failures",
+    "write_jsonl", "read_jsonl", "dumps_op", "loads_op",
+]

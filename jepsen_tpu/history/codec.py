@@ -1,0 +1,74 @@
+"""History serialization.
+
+The reference persists histories twice: a human-readable text log and a
+machine-readable form (jepsen/src/jepsen/store.clj:259-277). We use JSON
+lines as the machine form — self-describing, streamable, and append-safe
+so a crashed run still leaves a parseable prefix. Tuples round-trip as
+lists; suites that care (e.g. cas [from, to] pairs) treat them uniformly
+as sequences.
+"""
+from __future__ import annotations
+
+import json
+from typing import Iterable, List
+
+from .ops import Op
+
+
+def dumps_op(op: Op) -> str:
+    return json.dumps(op.to_dict(), separators=(",", ":"), default=_default)
+
+
+def loads_op(line: str) -> Op:
+    return Op.from_dict(json.loads(line))
+
+
+def _default(o):
+    if isinstance(o, (set, frozenset)):
+        return {"__set__": sorted(o, key=repr)}
+    return repr(o)
+
+
+def _revive(d):
+    if isinstance(d, dict):
+        if set(d.keys()) == {"__set__"}:
+            return set(d["__set__"])
+        return {k: _revive(v) for k, v in d.items()}
+    if isinstance(d, list):
+        return [_revive(v) for v in d]
+    return d
+
+
+def write_jsonl(path, history: Iterable[Op], chunk: int = 8192) -> None:
+    """Write ops as JSON lines, buffered in chunks (the reference writes
+    long histories in parallel chunks, util.clj:149-170; here buffered
+    sequential IO achieves the same effect for multi-million-op logs)."""
+    with open(path, "w") as f:
+        buf: List[str] = []
+        for op in history:
+            buf.append(dumps_op(op))
+            if len(buf) >= chunk:
+                f.write("\n".join(buf) + "\n")
+                buf.clear()
+        if buf:
+            f.write("\n".join(buf) + "\n")
+
+
+def read_jsonl(path) -> List[Op]:
+    out: List[Op] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                d = json.loads(line)
+                for k, v in list(d.items()):
+                    d[k] = _revive(v)
+                out.append(Op.from_dict(d))
+    return out
+
+
+def write_txt(path, history: Iterable[Op]) -> None:
+    """Human-readable tab-separated log (the reference's history.txt)."""
+    with open(path, "w") as f:
+        for op in history:
+            f.write(str(op) + "\n")

@@ -1,0 +1,131 @@
+"""History container and pure history transforms.
+
+The history is the sole interface between the execution runtime and the
+analysis layer: workers append invoke/completion events; checkers consume
+the frozen sequence. Semantics of the transforms follow the reference
+(invoke/completion pairing at jepsen/src/jepsen/util.clj:554-588, completion
+semantics used by knossos and jepsen.checker).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+from .ops import Op, INVOKE, OK, FAIL, INFO
+
+
+class History:
+    """An append-only, thread-safe op log that freezes into a list.
+
+    Workers call ``append`` concurrently (guarded by a lock, mirroring the
+    reference's history atom, core.clj:41-45); analysis operates on the
+    frozen list from ``ops()``.
+    """
+
+    def __init__(self, ops: Optional[Iterable[Op]] = None):
+        self._ops: List[Op] = list(ops) if ops is not None else []
+        self._lock = threading.Lock()
+
+    def append(self, op: Op) -> Op:
+        with self._lock:
+            op.index = len(self._ops)
+            self._ops.append(op)
+        return op
+
+    def ops(self) -> List[Op]:
+        with self._lock:
+            return list(self._ops)
+
+    def __len__(self) -> int:
+        return len(self._ops)
+
+    def __iter__(self) -> Iterator[Op]:
+        return iter(self.ops())
+
+    def __getitem__(self, i):
+        return self._ops[i]
+
+
+def index(history: List[Op]) -> List[Op]:
+    """Assign sequential indices in place; returns the history."""
+    for i, op in enumerate(history):
+        op.index = i
+    return history
+
+
+def processes(history: List[Op]) -> List:
+    seen, out = set(), []
+    for op in history:
+        if op.process not in seen:
+            seen.add(op.process)
+            out.append(op.process)
+    return out
+
+
+def pairs(history: List[Op]) -> List[Tuple[Op, Optional[Op]]]:
+    """Match invocations with their completions, in invocation order.
+
+    Returns (invoke, completion-or-None) tuples. A process has at most one
+    outstanding op, so pairing is a per-process scan.
+    """
+    open_: Dict[object, int] = {}
+    out: List[Tuple[Op, Optional[Op]]] = []
+    for op in history:
+        if op.type == INVOKE:
+            open_[op.process] = len(out)
+            out.append((op, None))
+        elif op.is_completion and op.process in open_:
+            i = open_.pop(op.process)
+            out[i] = (out[i][0], op)
+    return out
+
+
+def complete(history: List[Op]) -> List[Op]:
+    """Propagate completion values back onto invocations.
+
+    For each ok completion whose invoke recorded no value (e.g. a read),
+    fill the invoke's value from the completion — the semantics knossos'
+    ``history/complete`` provides and the counter checker relies on
+    (jepsen/src/jepsen/checker.clj:342).
+    """
+    out = [op.with_() for op in history]
+    open_: Dict[object, int] = {}
+    for i, op in enumerate(out):
+        if op.type == INVOKE:
+            open_[op.process] = i
+        elif op.is_completion and op.process in open_:
+            j = open_.pop(op.process)
+            if op.type == OK:
+                if out[j].value is None:
+                    out[j].value = op.value
+                elif op.value is None:
+                    op.value = out[j].value
+    return out
+
+
+def without_failures(history: List[Op]) -> List[Op]:
+    """Drop failed ops and their invocations.
+
+    A fail completion means the op definitely did not take effect, so
+    neither event constrains correctness (knossos semantics).
+    """
+    drop = set()
+    open_: Dict[object, int] = {}
+    for i, op in enumerate(history):
+        if op.type == INVOKE:
+            open_[op.process] = i
+        elif op.is_completion and op.process in open_:
+            j = open_.pop(op.process)
+            if op.type == FAIL:
+                drop.add(i)
+                drop.add(j)
+    return [op for i, op in enumerate(history) if i not in drop]
+
+
+def filter_f(history: List[Op], fs) -> List[Op]:
+    fset = {fs} if isinstance(fs, str) else set(fs)
+    return [op for op in history if op.f in fset]
+
+
+def client_ops(history: List[Op]) -> List[Op]:
+    return [op for op in history if op.is_client]

@@ -1,0 +1,11 @@
+from .core import (
+    majority,
+    fraction,
+    integer_interval_set_str,
+    history_latencies,
+    nemesis_intervals,
+    rand_nth,
+    retry,
+    timeout_call,
+    Relatime,
+)

@@ -1,0 +1,135 @@
+"""Small shared utilities (counterparts of jepsen/src/jepsen/util.clj)."""
+from __future__ import annotations
+
+import random
+import threading
+import time as _time
+from fractions import Fraction
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..history.ops import Op, INVOKE, OK, FAIL, INFO
+
+
+def majority(n: int) -> int:
+    """Smallest majority of n nodes (util.clj:57-60)."""
+    return n // 2 + 1
+
+
+def fraction(a: int, b: int):
+    """a/b, but 1 when b is zero (util.clj fraction)."""
+    if b == 0:
+        return 1
+    return Fraction(a, b)
+
+
+def integer_interval_set_str(s) -> str:
+    """Render a set of integers compactly as e.g. "#{1-5 7 9-11}"
+    (util.clj:484-509). Non-integers are rendered individually."""
+    if s is None:
+        return "#{}"
+    ints = sorted(x for x in s if isinstance(x, int))
+    other = sorted((repr(x) for x in s if not isinstance(x, int)))
+    parts: List[str] = []
+    i = 0
+    while i < len(ints):
+        j = i
+        while j + 1 < len(ints) and ints[j + 1] == ints[j] + 1:
+            j += 1
+        parts.append(str(ints[i]) if i == j else f"{ints[i]}-{ints[j]}")
+        i = j + 1
+    parts.extend(other)
+    return "#{" + " ".join(parts) + "}"
+
+
+def history_latencies(history: List[Op]) -> List[Tuple[Op, Optional[int]]]:
+    """Pair each invocation with its completion latency in nanos
+    (util.clj:554-588). Returns (invoke-op, latency-or-None)."""
+    from ..history.core import pairs
+    return [(inv,
+             c.time - inv.time
+             if c is not None and c.time is not None and inv.time is not None
+             else None)
+            for inv, c in pairs(history)]
+
+
+def nemesis_intervals(history: List[Op],
+                      start_fs=("start",), stop_fs=("stop",)):
+    """[(start-op, stop-op-or-None)] intervals of nemesis activity.
+
+    A nemesis usually goes start-invoke, start-ok, stop-invoke, stop-ok;
+    starts queue up and each stop pairs with the oldest queued start, so
+    the emitted pairs are (first, third), (second, fourth) — covering the
+    window through the stop *completion* (util.clj:590-607)."""
+    from collections import deque
+    out = []
+    starts: deque = deque()
+    for op in history:
+        if not op.is_nemesis:
+            continue
+        if op.f in start_fs:
+            starts.append(op)
+        elif op.f in stop_fs and starts:
+            out.append((starts.popleft(), op))
+    out.extend((s, None) for s in starts)
+    return out
+
+
+def rand_nth(rng: random.Random, xs: Sequence):
+    return xs[rng.randrange(len(xs))]
+
+
+def retry(f: Callable, retries: int = 5, backoff: float = 0.1,
+          exceptions=(Exception,), on_retry: Optional[Callable] = None):
+    """Call f, retrying on exception with linear backoff
+    (util.clj:285-324)."""
+    for attempt in range(retries + 1):
+        try:
+            return f()
+        except exceptions:
+            if attempt == retries:
+                raise
+            if on_retry:
+                on_retry(attempt)
+            _time.sleep(backoff)
+    raise AssertionError("unreachable")
+
+
+def timeout_call(seconds: float, default, f: Callable, *args, **kw):
+    """Run f in a thread; if it exceeds the deadline return default
+    (util.clj:272-283). The thread is left to finish in the background —
+    like the reference, which interrupts but cannot guarantee death."""
+    result = {"v": default}
+    done = threading.Event()
+
+    def run():
+        try:
+            result["v"] = f(*args, **kw)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    if done.wait(seconds):
+        return result["v"]
+    return default
+
+
+class Relatime:
+    """Test-relative monotonic nanosecond clock (util.clj:235-252).
+
+    The origin is bound once at the start of a run so every op timestamp
+    is comparable across workers and the nemesis.
+    """
+
+    def __init__(self):
+        self.origin = _time.monotonic_ns()
+
+    def nanos(self) -> int:
+        return _time.monotonic_ns() - self.origin
+
+    def sleep_until(self, t_nanos: int) -> None:
+        while True:
+            dt = t_nanos - self.nanos()
+            if dt <= 0:
+                return
+            _time.sleep(dt / 1e9)

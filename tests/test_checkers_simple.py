@@ -1,0 +1,208 @@
+"""Truth-table tests for the O(n) checkers, mirroring the reference's
+checker unit tests (jepsen/test/jepsen/checker_test.clj)."""
+from fractions import Fraction
+
+from jepsen_tpu.history import invoke_op, ok_op, fail_op, info_op
+from jepsen_tpu.models import unordered_queue
+from jepsen_tpu.checkers import (
+    check, compose, merge_valid, unbridled_optimism, check_safe,
+    set_checker, queue_checker, total_queue_checker, unique_ids_checker,
+    counter_checker,
+)
+from jepsen_tpu.checkers.core import FnChecker
+
+
+def test_merge_valid_lattice():
+    assert merge_valid([]) is True
+    assert merge_valid([True, True]) is True
+    assert merge_valid([True, "unknown"]) == "unknown"
+    assert merge_valid([True, "unknown", False]) is False
+
+
+def test_check_safe_catches():
+    def boom(test, model, history, opts):
+        raise RuntimeError("boom")
+    r = check_safe(FnChecker(boom), None, None, [])
+    assert r["valid"] == "unknown"
+    assert "boom" in r["error"]
+
+
+def test_compose():
+    r = check(compose({"a": unbridled_optimism(),
+                       "b": unbridled_optimism()}), None, None, [])
+    assert r == {"a": {"valid": True}, "b": {"valid": True}, "valid": True}
+
+
+# --- queue ---------------------------------------------------------------
+
+def test_queue_empty():
+    assert check(queue_checker(), None, unordered_queue(), [])["valid"]
+
+
+def test_queue_possible_enqueue_no_dequeue():
+    h = [invoke_op(1, "enqueue", 1)]
+    assert check(queue_checker(), None, unordered_queue(), h)["valid"]
+
+
+def test_queue_definite_enqueue_no_dequeue():
+    h = [ok_op(1, "enqueue", 1)]
+    assert check(queue_checker(), None, unordered_queue(), h)["valid"]
+
+
+def test_queue_concurrent_enqueue_dequeue():
+    h = [invoke_op(2, "dequeue"), invoke_op(1, "enqueue", 1),
+         ok_op(2, "dequeue", 1)]
+    assert check(queue_checker(), None, unordered_queue(), h)["valid"]
+
+
+def test_queue_dequeue_without_enqueue():
+    h = [ok_op(1, "dequeue", 1)]
+    assert not check(queue_checker(), None, unordered_queue(), h)["valid"]
+
+
+# --- total-queue ---------------------------------------------------------
+
+def test_total_queue_empty():
+    assert check(total_queue_checker(), None, None, [])["valid"]
+
+
+def test_total_queue_sane():
+    h = [invoke_op(1, "enqueue", 1),
+         invoke_op(2, "enqueue", 2), ok_op(2, "enqueue", 2),
+         invoke_op(3, "dequeue"), ok_op(3, "dequeue", 1),
+         invoke_op(3, "dequeue"), ok_op(3, "dequeue", 2)]
+    r = check(total_queue_checker(), None, None, h)
+    assert r["valid"] is True
+    assert r["recovered"] == {1: 1}
+    assert r["ok-frac"] == 1
+    assert r["recovered-frac"] == Fraction(1, 2)
+
+
+def test_total_queue_pathological():
+    h = [invoke_op(1, "enqueue", "hung"),
+         invoke_op(2, "enqueue", "enqueued"), ok_op(2, "enqueue", "enqueued"),
+         invoke_op(3, "enqueue", "dup"), ok_op(3, "enqueue", "dup"),
+         invoke_op(4, "dequeue"),
+         invoke_op(5, "dequeue"), ok_op(5, "dequeue", "wtf"),
+         invoke_op(6, "dequeue"), ok_op(6, "dequeue", "dup"),
+         invoke_op(7, "dequeue"), ok_op(7, "dequeue", "dup")]
+    r = check(total_queue_checker(), None, None, h)
+    assert r["valid"] is False
+    assert r["lost"] == {"enqueued": 1}
+    assert r["unexpected"] == {"wtf": 1}
+    assert r["duplicated"] == {"dup": 1}
+    assert r["ok-frac"] == Fraction(1, 3)
+    assert r["lost-frac"] == Fraction(1, 3)
+    assert r["unexpected-frac"] == Fraction(1, 3)
+    assert r["duplicated-frac"] == Fraction(1, 3)
+    assert r["recovered-frac"] == 0
+
+
+def test_total_queue_drain_expansion():
+    h = [invoke_op(1, "enqueue", 1), ok_op(1, "enqueue", 1),
+         invoke_op(2, "enqueue", 2), ok_op(2, "enqueue", 2),
+         invoke_op(3, "drain"), ok_op(3, "drain", [1, 2])]
+    r = check(total_queue_checker(), None, None, h)
+    assert r["valid"] is True
+
+
+# --- counter -------------------------------------------------------------
+
+def test_counter_empty():
+    r = check(counter_checker(), None, None, [])
+    assert r == {"valid": True, "reads": [], "errors": []}
+
+
+def test_counter_initial_read():
+    h = [invoke_op(0, "read"), ok_op(0, "read", 0)]
+    r = check(counter_checker(), None, None, h)
+    assert r == {"valid": True, "reads": [[0, 0, 0]], "errors": []}
+
+
+def test_counter_initial_invalid_read():
+    h = [invoke_op(0, "read"), ok_op(0, "read", 1)]
+    r = check(counter_checker(), None, None, h)
+    assert r == {"valid": False, "reads": [[0, 1, 0]], "errors": [[0, 1, 0]]}
+
+
+def test_counter_interleaved():
+    h = [invoke_op(0, "read"),
+         invoke_op(1, "add", 1),
+         invoke_op(2, "read"),
+         invoke_op(3, "add", 2),
+         invoke_op(4, "read"),
+         invoke_op(5, "add", 4),
+         invoke_op(6, "read"),
+         invoke_op(7, "add", 8),
+         invoke_op(8, "read"),
+         ok_op(0, "read", 6),
+         ok_op(1, "add", 1),
+         ok_op(2, "read", 0),
+         ok_op(3, "add", 2),
+         ok_op(4, "read", 3),
+         ok_op(5, "add", 4),
+         ok_op(6, "read", 100),
+         ok_op(7, "add", 8),
+         ok_op(8, "read", 15)]
+    r = check(counter_checker(), None, None, h)
+    assert r["valid"] is False
+    assert r["reads"] == [[0, 6, 15], [0, 0, 15], [0, 3, 15],
+                          [0, 100, 15], [0, 15, 15]]
+    assert r["errors"] == [[0, 100, 15]]
+
+
+def test_counter_rolling():
+    h = [invoke_op(0, "read"),
+         invoke_op(1, "add", 1),
+         ok_op(0, "read", 0),
+         invoke_op(0, "read"),
+         ok_op(1, "add", 1),
+         invoke_op(1, "add", 2),
+         ok_op(0, "read", 3),
+         invoke_op(0, "read"),
+         ok_op(1, "add", 2),
+         ok_op(0, "read", 5)]
+    r = check(counter_checker(), None, None, h)
+    assert r["valid"] is False
+    assert r["reads"] == [[0, 0, 1], [0, 3, 3], [1, 5, 3]]
+    assert r["errors"] == [[1, 5, 3]]
+
+
+# --- set -----------------------------------------------------------------
+
+def test_set_never_read():
+    h = [invoke_op(0, "add", 0), ok_op(0, "add", 0)]
+    assert check(set_checker(), None, None, h)["valid"] == "unknown"
+
+
+def test_set_ok_lost_unexpected_recovered():
+    h = [invoke_op(0, "add", 0), ok_op(0, "add", 0),      # ok, read
+         invoke_op(1, "add", 1), ok_op(1, "add", 1),      # lost
+         invoke_op(2, "add", 2), info_op(2, "add", 2),    # recovered
+         invoke_op(3, "read"), ok_op(3, "read", [0, 2, 9])]
+    r = check(set_checker(), None, None, h)
+    assert r["valid"] is False
+    assert r["lost"] == "#{1}"
+    assert r["unexpected"] == "#{9}"
+    assert r["recovered"] == "#{2}"
+    assert r["ok"] == "#{0 2}"
+
+
+# --- unique ids ----------------------------------------------------------
+
+def test_unique_ids_ok():
+    h = [invoke_op(0, "generate"), ok_op(0, "generate", 10),
+         invoke_op(1, "generate"), ok_op(1, "generate", 11)]
+    r = check(unique_ids_checker(), None, None, h)
+    assert r["valid"] is True
+    assert r["range"] == [10, 11]
+    assert r["attempted-count"] == 2
+    assert r["acknowledged-count"] == 2
+
+
+def test_unique_ids_dup():
+    h = [invoke_op(0, "generate"), ok_op(0, "generate", 10),
+         invoke_op(1, "generate"), ok_op(1, "generate", 10)]
+    r = check(unique_ids_checker(), None, None, h)
+    assert r["valid"] is False
+    assert r["duplicated"] == {10: 2}

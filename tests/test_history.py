@@ -1,0 +1,108 @@
+import os
+import tempfile
+
+from jepsen_tpu.history import (
+    Op, History, invoke_op, ok_op, fail_op, info_op,
+    pairs, complete, without_failures, write_jsonl, read_jsonl,
+)
+from jepsen_tpu.utils import (
+    integer_interval_set_str, majority, fraction, history_latencies,
+    nemesis_intervals,
+)
+
+
+def test_append_assigns_indices():
+    h = History()
+    a = h.append(invoke_op(0, "read"))
+    b = h.append(ok_op(0, "read", 3))
+    assert a.index == 0 and b.index == 1
+    assert len(h) == 2
+
+
+def test_pairs_matches_invoke_completion():
+    h = [invoke_op(0, "read"), invoke_op(1, "write", 2),
+         ok_op(1, "write", 2), ok_op(0, "read", 5)]
+    p = pairs(h)
+    assert len(p) == 2
+    assert p[0][0].process == 0 and p[0][1].value == 5
+    assert p[1][0].process == 1 and p[1][1].type == "ok"
+
+
+def test_pairs_unmatched_invoke():
+    h = [invoke_op(0, "read")]
+    assert pairs(h) == [(h[0], None)]
+
+
+def test_complete_fills_read_values():
+    h = [invoke_op(0, "read"), ok_op(0, "read", 7)]
+    c = complete(h)
+    assert c[0].value == 7
+    # original untouched
+    assert h[0].value is None
+
+
+def test_without_failures_drops_pairs():
+    h = [invoke_op(0, "write", 1), fail_op(0, "write", 1),
+         invoke_op(0, "write", 2), ok_op(0, "write", 2)]
+    for i, op in enumerate(h):
+        op.index = i
+    out = without_failures(h)
+    assert [op.value for op in out] == [2, 2]
+
+
+def test_jsonl_roundtrip():
+    h = [invoke_op(0, "cas", [1, 2], time=10),
+         ok_op(0, "cas", [1, 2], time=20),
+         info_op("nemesis", "start", {"n1": ["n2"]})]
+    for i, op in enumerate(h):
+        op.index = i
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "history.jsonl")
+        write_jsonl(path, h)
+        back = read_jsonl(path)
+    assert len(back) == 3
+    assert back[0].value == [1, 2]
+    assert back[2].process == "nemesis"
+    assert back[1].time == 20
+
+
+def test_interval_set_str():
+    assert integer_interval_set_str({1, 2, 3, 5, 7, 8}) == "#{1-3 5 7-8}"
+    assert integer_interval_set_str(set()) == "#{}"
+    assert integer_interval_set_str(None) == "#{}"
+
+
+def test_majority_and_fraction():
+    assert majority(5) == 3
+    assert majority(4) == 3
+    assert majority(1) == 1
+    assert fraction(1, 0) == 1
+    assert fraction(1, 2) * 2 == 1
+
+
+def test_latencies():
+    h = [invoke_op(0, "read", time=100), ok_op(0, "read", 1, time=250)]
+    lats = history_latencies(h)
+    assert lats[0][1] == 150
+
+
+def test_nemesis_intervals():
+    h = [info_op("nemesis", "start", time=0),
+         invoke_op(0, "read", time=1),
+         info_op("nemesis", "stop", time=2),
+         info_op("nemesis", "start", time=3)]
+    iv = nemesis_intervals(h)
+    assert len(iv) == 2
+    assert iv[0][1].f == "stop"
+    assert iv[1][1] is None
+
+
+def test_nemesis_intervals_invoke_ok_pairs():
+    # start-invoke, start-ok, stop-invoke, stop-ok: pairs are
+    # (first, third) and (second, fourth), covering through stop completion.
+    s1 = invoke_op("nemesis", "start", time=0)
+    s2 = info_op("nemesis", "start", time=1)
+    t1 = invoke_op("nemesis", "stop", time=2)
+    t2 = info_op("nemesis", "stop", time=3)
+    iv = nemesis_intervals([s1, s2, t1, t2])
+    assert iv == [(s1, t1), (s2, t2)]

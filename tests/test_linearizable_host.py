@@ -1,0 +1,118 @@
+"""Host WGL linearizability engine tests on hand-built histories."""
+from jepsen_tpu.history import invoke_op, ok_op, fail_op, info_op
+from jepsen_tpu.history.core import index
+from jepsen_tpu.models import cas_register, mutex
+from jepsen_tpu.checkers.linearizable import wgl_check
+
+
+def check(model, ops):
+    return wgl_check(model, index(ops))
+
+
+def test_empty():
+    assert check(cas_register(), [])["valid"] is True
+
+
+def test_sequential_ok():
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(0, "read", 1), ok_op(0, "read", 1)]
+    assert check(cas_register(), h)["valid"] is True
+
+
+def test_stale_read_invalid():
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(0, "write", 2), ok_op(0, "write", 2),
+         invoke_op(1, "read"), ok_op(1, "read", 1)]
+    r = check(cas_register(), h)
+    assert r["valid"] is False
+    assert r["op"]["value"] == 1
+
+
+def test_concurrent_read_sees_either():
+    # read overlaps the write: may see old or new value
+    for seen in (None, 2):
+        h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+             invoke_op(1, "read"),
+             invoke_op(0, "write", 2),
+             ok_op(1, "read", seen if seen is not None else 1),
+             ok_op(0, "write", 2)]
+        assert check(cas_register(), h)["valid"] is True
+
+
+def test_cas_ok_and_invalid():
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(0, "cas", (1, 3)), ok_op(0, "cas", (1, 3)),
+         invoke_op(0, "read", 3), ok_op(0, "read", 3)]
+    assert check(cas_register(), h)["valid"] is True
+
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(0, "cas", (2, 3)), ok_op(0, "cas", (2, 3))]
+    assert check(cas_register(), h)["valid"] is False
+
+
+def test_failed_op_did_not_happen():
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(0, "write", 9), fail_op(0, "write", 9),
+         invoke_op(0, "read"), ok_op(0, "read", 1)]
+    assert check(cas_register(), h)["valid"] is True
+
+
+def test_info_write_may_or_may_not_happen():
+    # Crashed write: a later read may see it...
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(1, "write", 2), info_op(1, "write", 2),
+         invoke_op(0, "read"), ok_op(0, "read", 2)]
+    assert check(cas_register(), h)["valid"] is True
+    # ...or not.
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(1, "write", 2), info_op(1, "write", 2),
+         invoke_op(0, "read"), ok_op(0, "read", 1)]
+    assert check(cas_register(), h)["valid"] is True
+    # But it cannot have happened *before* its invocation.
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(0, "read"), ok_op(0, "read", 2),
+         invoke_op(1, "write", 2), info_op(1, "write", 2)]
+    assert check(cas_register(), h)["valid"] is False
+
+
+def test_info_op_can_take_effect_late():
+    # The crashed write can linearize after intervening ok ops.
+    h = [invoke_op(1, "write", 2), info_op(1, "write", 2),
+         invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(0, "read"), ok_op(0, "read", 1),
+         invoke_op(0, "read"), ok_op(0, "read", 2)]
+    assert check(cas_register(), h)["valid"] is True
+
+
+def test_read_returning_two_values_invalid():
+    # Two sequential reads cannot see 1 then 0 without a write in between.
+    h = [invoke_op(0, "write", 0), ok_op(0, "write", 0),
+         invoke_op(1, "write", 1), ok_op(1, "write", 1),
+         invoke_op(0, "read"), ok_op(0, "read", 1),
+         invoke_op(0, "read"), ok_op(0, "read", 0)]
+    assert check(cas_register(), h)["valid"] is False
+
+
+def test_mutex_model():
+    h = [invoke_op(0, "acquire"), ok_op(0, "acquire"),
+         invoke_op(1, "acquire"),
+         invoke_op(0, "release"), ok_op(0, "release"),
+         ok_op(1, "acquire")]
+    assert check(mutex(), h)["valid"] is True
+    # Double acquire without overlap is invalid
+    h = [invoke_op(0, "acquire"), ok_op(0, "acquire"),
+         invoke_op(1, "acquire"), ok_op(1, "acquire")]
+    assert check(mutex(), h)["valid"] is False
+
+
+def test_concurrent_writes_with_cas_chain():
+    # cas must observe one of the concurrent writes
+    h = [invoke_op(0, "write", 1),
+         invoke_op(1, "write", 2),
+         ok_op(0, "write", 1),
+         ok_op(1, "write", 2),
+         invoke_op(2, "cas", (1, 4)),
+         ok_op(2, "cas", (1, 4)),
+         invoke_op(2, "read"), ok_op(2, "read", 4)]
+    # Valid: order w2, w1, cas(1->4), read 4
+    assert check(cas_register(), h)["valid"] is True

@@ -1,0 +1,61 @@
+from jepsen_tpu.history import invoke_op
+from jepsen_tpu.models import (
+    cas_register, mutex, set_model, unordered_queue, fifo_queue,
+    noop, is_inconsistent,
+)
+
+
+def op(f, value=None):
+    return invoke_op(0, f, value)
+
+
+def test_noop():
+    assert noop.step(op("anything")) is noop
+
+
+def test_cas_register():
+    r = cas_register()
+    assert r.value is None
+    r = r.step(op("write", 3))
+    assert r.value == 3
+    assert r.step(op("read", 3)).value == 3
+    assert is_inconsistent(r.step(op("read", 4)))
+    # nil read always ok
+    assert r.step(op("read", None)).value == 3
+    r2 = r.step(op("cas", (3, 5)))
+    assert r2.value == 5
+    assert is_inconsistent(r.step(op("cas", (4, 5))))
+
+
+def test_mutex():
+    m = mutex()
+    assert is_inconsistent(m.step(op("release")))
+    m = m.step(op("acquire"))
+    assert m.locked
+    assert is_inconsistent(m.step(op("acquire")))
+    assert not m.step(op("release")).locked
+
+
+def test_set_model():
+    s = set_model().step(op("add", 1)).step(op("add", 2))
+    assert s.step(op("read", {1, 2})) is s
+    assert is_inconsistent(s.step(op("read", {1})))
+
+
+def test_unordered_queue():
+    q = unordered_queue().step(op("enqueue", 1)).step(op("enqueue", 2))
+    q2 = q.step(op("dequeue", 2))  # out of order is fine
+    assert not is_inconsistent(q2)
+    assert is_inconsistent(q2.step(op("dequeue", 2)))
+    # duplicate enqueues are multiset-counted
+    q3 = q.step(op("enqueue", 1)).step(op("dequeue", 1)).step(op("dequeue", 1))
+    assert not is_inconsistent(q3)
+    assert is_inconsistent(q3.step(op("dequeue", 1)))
+
+
+def test_fifo_queue():
+    q = fifo_queue().step(op("enqueue", 1)).step(op("enqueue", 2))
+    assert is_inconsistent(q.step(op("dequeue", 2)))  # must be FIFO
+    q = q.step(op("dequeue", 1))
+    assert not is_inconsistent(q)
+    assert is_inconsistent(fifo_queue().step(op("dequeue", 1)))
