@@ -26,13 +26,22 @@ def loads_op(line: str) -> Op:
 def _default(o):
     if isinstance(o, (set, frozenset)):
         return {"__set__": sorted(o, key=repr)}
-    return repr(o)
+    if isinstance(o, (bytes, bytearray)):
+        import base64
+        return {"__bytes__": base64.b64encode(bytes(o)).decode("ascii")}
+    # Refuse to guess: silently repr-ing a value would change its type on
+    # a round-trip and flip checker verdicts on reload.
+    raise TypeError(f"op value of type {type(o).__name__} is not "
+                    f"JSON-serializable: {o!r}")
 
 
 def _revive(d):
     if isinstance(d, dict):
         if set(d.keys()) == {"__set__"}:
             return set(d["__set__"])
+        if set(d.keys()) == {"__bytes__"}:
+            import base64
+            return base64.b64decode(d["__bytes__"])
         return {k: _revive(v) for k, v in d.items()}
     if isinstance(d, list):
         return [_revive(v) for v in d]

@@ -1,0 +1,16 @@
+"""TPU compute path: history tensor encoding and batched checker kernels.
+
+This package is the heart of the framework's TPU design: histories are
+lowered (host-side) to padded int32 event tensors plus per-op transition
+tables over an enumerated model state space, and correctness decisions run
+as vmapped/sharded XLA programs — thousands of fault-seeded histories
+per call. It replaces the reference's Knossos dependency
+(jepsen/src/jepsen/checker.clj:82-107) with device kernels.
+
+Modules:
+  statespace — host-side model state-space enumeration + transition tables
+  encode     — history → event tensor lowering (slot assignment, batching)
+  linearize  — dense-frontier WGL linearizability kernel (vmapped, sharded)
+  scans      — vmapped single-pass checkers (set/counter/unique-ids/queue)
+  mesh       — device mesh / sharding helpers
+"""

@@ -1,0 +1,221 @@
+"""History → event tensor lowering for the TPU linearizability kernel.
+
+A prepared history (client ops, completion-propagated, failure-free — see
+jepsen_tpu.checkers.linearizable.prepare_history) lowers to a sequence of
+integer events:
+
+  INVOKE slot trans — op ``trans`` becomes pending in slot ``slot``
+  OK     slot  —    — the op in ``slot`` completed; it must be linearized
+                     by now, and its slot frees
+  (info / crashed ops emit no completion event: their slot stays occupied
+   to the end of the history, encoding "may linearize at any later point
+   or never" — knossos semantics, core.clj:185-205)
+
+Slots are a bounded window: each concurrently-pending op holds one of W
+slots. The kernel represents the WGL configuration set densely as a
+boolean frontier [V states, 2^W pending subsets], so W and the state-space
+bound V are static costs chosen here. Histories that exceed the bounds
+are flagged for host/native fallback rather than mis-checked.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..history.ops import Op, INVOKE, OK, INFO
+from ..models.core import Model
+from .statespace import (StateSpace, StateSpaceExplosion, enumerate_statespace,
+                         history_kinds, op_kind)
+
+# Event type codes (kernel-side contract).
+EV_PAD = 0
+EV_INVOKE = 1
+EV_OK = 2
+
+
+@dataclass
+class EncodedHistory:
+    """One history lowered to kernel inputs (unpadded lengths)."""
+
+    ev_type: np.ndarray    # [n] int32
+    ev_slot: np.ndarray    # [n] int32
+    ev_trans: np.ndarray   # [n] int32 (invoke: kind index; else 0)
+    ev_opidx: np.ndarray   # [n] int32 — history index of the source op
+    space: StateSpace
+    max_live: int          # peak number of concurrently-pending slots
+    n_events: int
+
+    @property
+    def n_states(self) -> int:
+        return self.space.n_states
+
+    @property
+    def n_kinds(self) -> int:
+        return self.space.n_kinds
+
+
+@dataclass
+class EncodeFailure:
+    reason: str
+
+
+def encode_history(model: Model, prepared: List[Op], *,
+                   max_states: int = 64,
+                   max_slots: int = 24,
+                   space_cache: Optional[dict] = None):
+    """Lower one prepared history. Returns EncodedHistory or EncodeFailure.
+
+    ``prepared`` must already be completion-propagated and failure-free;
+    op indices must be assigned (history.core.index). ``space_cache``
+    memoizes the state-space BFS across a batch of histories sharing an
+    op vocabulary (10k fault-seeded variants of one workload would
+    otherwise pay 10k identical enumerations).
+    """
+    kinds = history_kinds(prepared)
+    key = (model, tuple(kinds))
+    space = space_cache.get(key) if space_cache is not None else None
+    if space is None:
+        try:
+            space = enumerate_statespace(model, kinds, max_states)
+        except StateSpaceExplosion as e:
+            return EncodeFailure(str(e))
+        if space_cache is not None:
+            space_cache[key] = space
+
+    ev_type: List[int] = []
+    ev_slot: List[int] = []
+    ev_trans: List[int] = []
+    ev_opidx: List[int] = []
+
+    free = list(range(max_slots - 1, -1, -1))  # stack; low slots first
+    slot_of = {}                               # process -> slot
+    live = 0
+    max_live = 0
+
+    for pos, op in enumerate(prepared):
+        if op.type == INVOKE:
+            if not free:
+                return EncodeFailure(
+                    f"more than {max_slots} concurrently-pending ops")
+            slot = free.pop()
+            slot_of[op.process] = slot
+            live += 1
+            max_live = max(max_live, live)
+            ev_type.append(EV_INVOKE)
+            ev_slot.append(slot)
+            ev_trans.append(space.kind_index[op_kind(op)])
+            ev_opidx.append(op.index if op.index is not None else pos)
+        elif op.type == OK:
+            slot = slot_of.pop(op.process, None)
+            if slot is None:
+                continue  # completion with no open invocation
+            free.append(slot)
+            live -= 1
+            ev_type.append(EV_OK)
+            ev_slot.append(slot)
+            ev_trans.append(0)
+            ev_opidx.append(op.index if op.index is not None else pos)
+        elif op.type == INFO:
+            # Indeterminate: op stays pending to the end. Its slot is
+            # intentionally never freed; no device event is emitted.
+            slot_of.pop(op.process, None)
+
+    return EncodedHistory(
+        ev_type=np.asarray(ev_type, dtype=np.int32),
+        ev_slot=np.asarray(ev_slot, dtype=np.int32),
+        ev_trans=np.asarray(ev_trans, dtype=np.int32),
+        ev_opidx=np.asarray(ev_opidx, dtype=np.int32),
+        space=space,
+        max_live=max_live,
+        n_events=len(ev_type),
+    )
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@dataclass
+class EncodedBatch:
+    """A batch of encoded histories padded to shared static bounds.
+
+    Array shapes (B = batch, N = padded events, V = padded states,
+    K = padded op kinds, W = slot-window width):
+      ev_type/ev_slot/ev_trans/ev_opidx — int32 [B, N]
+      target — int32 [B, K + 1, V]; final row = all-invalid sentinel
+    ``indices`` maps batch rows back to positions in the caller's history
+    list; ``failures`` lists (position, reason) needing host fallback.
+    """
+
+    ev_type: np.ndarray
+    ev_slot: np.ndarray
+    ev_trans: np.ndarray
+    ev_opidx: np.ndarray
+    target: np.ndarray
+    V: int
+    W: int
+    indices: List[int]
+    failures: List[Tuple[int, str]]
+
+    @property
+    def batch(self) -> int:
+        return int(self.ev_type.shape[0])
+
+    @property
+    def n_events(self) -> int:
+        return int(self.ev_type.shape[1])
+
+
+def batch_encode(model: Model, prepared_histories: Sequence[List[Op]], *,
+                 max_states: int = 64, max_slots: int = 24,
+                 min_v: int = 8, min_w: int = 8,
+                 pad_batch_to: Optional[int] = None) -> EncodedBatch:
+    """Encode many prepared histories into one padded batch.
+
+    Static bounds (V, W, N, K) are the maxima over the batch, rounded up
+    for TPU-friendly layouts. Cost scales with V * 2^W, so callers
+    checking heterogeneous histories should bucket by cost first
+    (jepsen_tpu.checkers.batch does).
+    """
+    encs: List[Tuple[int, EncodedHistory]] = []
+    failures: List[Tuple[int, str]] = []
+    space_cache: dict = {}
+    for i, h in enumerate(prepared_histories):
+        e = encode_history(model, h, max_states=max_states,
+                           max_slots=max_slots, space_cache=space_cache)
+        if isinstance(e, EncodeFailure):
+            failures.append((i, e.reason))
+        else:
+            encs.append((i, e))
+
+    if not encs:
+        return EncodedBatch(*(np.zeros((0, 0), np.int32),) * 4,
+                            target=np.zeros((0, 1, min_v), np.int32),
+                            V=min_v, W=min_w, indices=[], failures=failures)
+
+    V = _round_up(max(max(e.n_states for _, e in encs), min_v), 4)
+    W = _round_up(max(max(e.max_live for _, e in encs), min_w), 4)
+    K = max(max(e.n_kinds for _, e in encs), 1)
+    N = _round_up(max(e.n_events for _, e in encs), 8)
+    B = len(encs)
+    Bp = pad_batch_to if pad_batch_to else B
+
+    ev_type = np.zeros((Bp, N), np.int32)
+    ev_slot = np.zeros((Bp, N), np.int32)
+    ev_trans = np.zeros((Bp, N), np.int32)
+    ev_opidx = np.full((Bp, N), -1, np.int32)
+    target = np.full((Bp, K + 1, V), -1, np.int32)
+
+    for row, (_, e) in enumerate(encs):
+        n = e.n_events
+        ev_type[row, :n] = e.ev_type
+        ev_slot[row, :n] = e.ev_slot
+        ev_trans[row, :n] = e.ev_trans
+        ev_opidx[row, :n] = e.ev_opidx
+        target[row] = e.space.padded_target(V, K)
+
+    return EncodedBatch(ev_type=ev_type, ev_slot=ev_slot, ev_trans=ev_trans,
+                        ev_opidx=ev_opidx, target=target, V=V, W=W,
+                        indices=[i for i, _ in encs], failures=failures)

@@ -1,0 +1,197 @@
+"""Dense-frontier WGL linearizability kernel.
+
+The WGL configuration set (see jepsen_tpu.checkers.linearizable for the
+algorithm spec; the reference delegates the same search to Knossos at
+jepsen/src/jepsen/checker.clj:82-107) is represented densely as a boolean
+frontier
+
+    F[s, m] = 1  iff  config (state s, linearized-pending-set m) reachable
+
+with ``m`` ranging over all 2^W subsets of the W pending-op slots. Events
+(lowered by jepsen_tpu.ops.encode) drive a ``lax.scan``:
+
+  * INVOKE slot k — record op kind k in the device slot table.
+  * every event — close F under application of pending ops: for each
+    occupied slot i, (s, m without i) → (target[s], m | i). One
+    application is a static reshape splitting mask-bit i plus a V×V
+    one-hot "transition matmul" on the state axis; closure iterates to
+    fixpoint via ``lax.while_loop`` (monotone OR, so ≤ live-slot
+    iterations; re-running converged lanes under vmap is idempotent).
+  * OK slot — keep exactly the configs whose mask holds the slot's bit
+    and clear it (a dynamic gather along the mask axis — no per-slot
+    branching), freeing the slot. An empty survivor set means the
+    completed op cannot be linearized: the history is invalid and the
+    event index is recorded (it maps back to the offending op for
+    Knossos-parity counterexample reporting).
+
+Shapes are fully static: [V, 2^W] per history, vmapped over the batch and
+shardable over the device mesh on the batch axis (jepsen_tpu.ops.mesh).
+The mask axis provides long 128-lane vectors for the VPU and the
+transition matmuls batch onto the MXU. Cost scales with V * 2^W * events,
+so callers bucket histories by (V, W) cost class before batching.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import numpy as np
+
+from ..history.ops import Op
+from ..models.core import Model
+from .encode import (EV_INVOKE, EV_OK, EncodedBatch, EncodeFailure,
+                     batch_encode, encode_history)
+
+INT32_MAX = np.int32(2**31 - 1)
+
+
+def _apply_slot(F: jnp.ndarray, i: int, tgt_i: jnp.ndarray,
+                V: int, M: int) -> jnp.ndarray:
+    """Close F one step under the op in slot ``i``: every config without
+    bit i spawns (target-state, mask | bit i). ``tgt_i`` is the op's [V]
+    transition vector (-1 where inconsistent; all -1 for empty slots)."""
+    hi, lo = M >> (i + 1), 1 << i
+    Fr = F.reshape(V, hi, 2, lo)
+    src = Fr[:, :, 0, :].reshape(V, hi * lo)
+    onehot = tgt_i[:, None] == jnp.arange(V, dtype=jnp.int32)[None, :]
+    G = jnp.matmul(onehot.astype(jnp.bfloat16).T,
+                   src.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32) > 0
+    out1 = Fr[:, :, 1:, :] | G.reshape(V, hi, 1, lo)
+    return jnp.concatenate([Fr[:, :, :1, :], out1], axis=2).reshape(V, M)
+
+
+def _complete_slot(F: jnp.ndarray, slot: jnp.ndarray, M: int) -> jnp.ndarray:
+    """OK-completion of the op in (dynamic) slot: keep configs whose mask
+    has the slot bit set, with the bit cleared."""
+    idx = jnp.arange(M, dtype=jnp.int32)
+    bit = jnp.int32(1) << slot
+    survivors = jnp.take(F, idx | bit, axis=1)
+    return jnp.where((idx & bit) == 0, survivors, False)
+
+
+def make_kernel(V: int, W: int):
+    """Build the single-history checker for static bounds (V, W).
+
+    Returns ``check(ev_type, ev_slot, ev_trans, target) -> (valid, bad)``
+    where ``bad`` is the event index of the first impossible completion
+    (INT32_MAX when valid). vmap/shard over a leading batch axis.
+    """
+    M = 1 << W
+
+    def closure(F, slot_trans, target):
+        tgt = target[slot_trans]  # [W, V]; empty slots gather the
+                                  # all-invalid sentinel row.
+
+        def body(carry):
+            F0, _ = carry
+            Fn = F0
+            for i in range(W):
+                Fn = _apply_slot(Fn, i, tgt[i], V, M)
+            return Fn, (Fn != F0).any()
+
+        F, _ = lax.while_loop(lambda c: c[1], body, (F, jnp.bool_(True)))
+        return F
+
+    def check(ev_type, ev_slot, ev_trans, target):
+        sentinel = jnp.int32(target.shape[0] - 1)
+
+        def step(carry, ev):
+            F, slot_trans, valid, bad = carry
+            typ, slot, trans, idx = ev
+            is_invoke = typ == EV_INVOKE
+            is_ok = typ == EV_OK
+            st1 = jnp.where(is_invoke,
+                            slot_trans.at[slot].set(trans), slot_trans)
+            Fc = closure(F, st1, target)
+            F_ok = _complete_slot(Fc, slot, M)
+            empty = is_ok & ~F_ok.any()
+            F2 = jnp.where(is_ok, F_ok, Fc)
+            st2 = jnp.where(is_ok, st1.at[slot].set(sentinel), st1)
+            valid2 = valid & ~empty
+            bad2 = jnp.minimum(bad, jnp.where(empty, idx, INT32_MAX))
+            return (F2, st2, valid2, bad2), None
+
+        N = ev_type.shape[0]
+        F0 = jnp.zeros((V, M), jnp.bool_).at[0, 0].set(True)
+        st0 = jnp.full((W,), sentinel, jnp.int32)
+        idx = jnp.arange(N, dtype=jnp.int32)
+        carry = (F0, st0, jnp.bool_(True), jnp.int32(INT32_MAX))
+        (F, st, valid, bad), _ = lax.scan(
+            step, carry, (ev_type, ev_slot, ev_trans, idx))
+        return valid, bad
+
+    return check
+
+
+# One compiled batch kernel per static (V, W); jit caches per event-shape.
+_BATCH_KERNELS: Dict[Tuple[int, int], object] = {}
+
+
+def batch_kernel(V: int, W: int):
+    key = (V, W)
+    k = _BATCH_KERNELS.get(key)
+    if k is None:
+        k = jax.jit(jax.vmap(make_kernel(V, W), in_axes=(0, 0, 0, 0)))
+        _BATCH_KERNELS[key] = k
+    return k
+
+
+def run_encoded_batch(batch: EncodedBatch) -> Tuple[np.ndarray, np.ndarray]:
+    """Device-check an encoded batch. Returns (valid [B] bool, bad [B])."""
+    if batch.batch == 0:
+        return np.zeros((0,), bool), np.zeros((0,), np.int32)
+    kern = batch_kernel(batch.V, batch.W)
+    valid, bad = kern(batch.ev_type, batch.ev_slot,
+                      batch.ev_trans, batch.target)
+    return np.asarray(valid), np.asarray(bad)
+
+
+def _result_for(row: int, batch: EncodedBatch, valid: np.ndarray,
+                bad: np.ndarray, prepared: List[Op]) -> dict:
+    if bool(valid[row]):
+        return {"valid": True}
+    ev = int(bad[row])
+    op_index = int(batch.ev_opidx[row, ev])
+    op = next((o for o in prepared if o.index == op_index), None)
+    return {"valid": False,
+            "op": op.to_dict() if op is not None else {"index": op_index}}
+
+
+def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
+                    max_states: int = 64, max_slots: int = 24,
+                    host_fallback=None) -> List[dict]:
+    """Check many raw histories on device; per-history result dicts.
+
+    Histories the encoder cannot bound (state-space explosion, pending
+    window overflow) are delegated to ``host_fallback(model, history)``
+    (default: the exact host engine).
+    """
+    from ..checkers.linearizable import prepare_history, wgl_check
+    from ..history.core import index as index_history
+    host_fallback = host_fallback or wgl_check
+
+    for h in histories:
+        if any(op.index is None for op in h):
+            index_history(h)
+    prepared = [prepare_history(h) for h in histories]
+    batch = batch_encode(model, prepared,
+                         max_states=max_states, max_slots=max_slots)
+    valid, bad = run_encoded_batch(batch)
+
+    results: List[Optional[dict]] = [None] * len(histories)
+    for row, i in enumerate(batch.indices):
+        results[i] = _result_for(row, batch, valid, bad, prepared[i])
+    for i, reason in batch.failures:
+        r = host_fallback(model, histories[i])
+        r.setdefault("fallback", reason)
+        results[i] = r
+    return results
+
+
+def check_one_tpu(model: Model, history: List[Op], **kw) -> dict:
+    """Single-history device check (the Checker-protocol TPU backend)."""
+    return check_batch_tpu(model, [history], **kw)[0]

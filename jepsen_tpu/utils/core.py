@@ -97,19 +97,26 @@ def retry(f: Callable, retries: int = 5, backoff: float = 0.1,
 def timeout_call(seconds: float, default, f: Callable, *args, **kw):
     """Run f in a thread; if it exceeds the deadline return default
     (util.clj:272-283). The thread is left to finish in the background —
-    like the reference, which interrupts but cannot guarantee death."""
-    result = {"v": default}
+    like the reference, which interrupts but cannot guarantee death.
+    Exceptions raised by f before the deadline propagate to the caller
+    (the reference rethrows on deref); after the deadline they are lost,
+    as in the reference."""
+    result = {}
     done = threading.Event()
 
     def run():
         try:
             result["v"] = f(*args, **kw)
+        except BaseException as e:  # noqa: BLE001 — rethrown on the caller
+            result["e"] = e
         finally:
             done.set()
 
     t = threading.Thread(target=run, daemon=True)
     t.start()
     if done.wait(seconds):
+        if "e" in result:
+            raise result["e"]
         return result["v"]
     return default
 

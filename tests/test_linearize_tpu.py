@@ -1,0 +1,246 @@
+"""TPU linearizability kernel: encoding + parity vs the host oracle.
+
+The host WGL engine (tests/test_linearizable_host.py pins its semantics)
+is the oracle; the vmapped dense-frontier kernel must agree on validity
+and on the first impossible completion, including under indeterminate
+(:info) and crashed ops — the hard cases called out in SURVEY.md §7.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history.core import index
+from jepsen_tpu.history.ops import (Op, invoke_op, ok_op, fail_op, info_op)
+from jepsen_tpu.models.core import cas_register, mutex
+from jepsen_tpu.checkers.linearizable import prepare_history, wgl_check
+from jepsen_tpu.ops.statespace import (enumerate_statespace, history_kinds,
+                                       StateSpaceExplosion)
+from jepsen_tpu.ops.encode import (encode_history, EncodeFailure,
+                                   batch_encode, EV_INVOKE, EV_OK)
+from jepsen_tpu.ops.linearize import check_batch_tpu, check_one_tpu
+
+
+# ---------------------------------------------------------------- statespace
+
+def test_statespace_cas_register():
+    h = index([invoke_op(0, "write", 1), ok_op(0, "write", 1),
+               invoke_op(0, "cas", [1, 2]), ok_op(0, "cas", [1, 2]),
+               invoke_op(0, "read", 2), ok_op(0, "read", 2)])
+    prepared = prepare_history(h)
+    kinds = history_kinds(prepared)
+    space = enumerate_statespace(cas_register(), kinds, max_states=64)
+    # states: None (initial), 1, 2
+    assert space.n_states == 3
+    assert space.states[0] == cas_register()
+    # write 1 maps every state to state(1)
+    wi = space.kind_index[("write", 1)]
+    assert all(t == space.states.index(cas_register(1))
+               for t in space.target[wi])
+    # cas [1,2] valid only from state 1
+    ci = space.kind_index[("cas", (1, 2))]
+    valid_srcs = [s for s in range(3) if space.target[ci, s] >= 0]
+    assert valid_srcs == [space.states.index(cas_register(1))]
+
+
+def test_statespace_explosion():
+    # A set model over many distinct adds has 2^n reachable states.
+    from jepsen_tpu.models.core import set_model
+    h = []
+    for i in range(10):
+        h += [invoke_op(0, "add", i), ok_op(0, "add", i)]
+    prepared = prepare_history(index(h))
+    with pytest.raises(StateSpaceExplosion):
+        enumerate_statespace(set_model(), history_kinds(prepared),
+                             max_states=64)
+
+
+# -------------------------------------------------------------------- encode
+
+def test_encode_slot_assignment():
+    h = index([invoke_op(0, "write", 1),     # slot 0
+               invoke_op(1, "write", 2),     # slot 1
+               ok_op(0, "write", 1),         # frees slot 0
+               invoke_op(2, "write", 3),     # reuses slot 0
+               ok_op(1, "write", 2),
+               ok_op(2, "write", 3)])
+    e = encode_history(cas_register(), prepare_history(h))
+    assert not isinstance(e, EncodeFailure)
+    assert list(e.ev_type) == [EV_INVOKE, EV_INVOKE, EV_OK,
+                               EV_INVOKE, EV_OK, EV_OK]
+    assert list(e.ev_slot) == [0, 1, 0, 0, 1, 0]
+    assert e.max_live == 2
+
+
+def test_encode_info_pins_slot():
+    h = index([invoke_op(0, "write", 1),
+               info_op(0, "write", 1, error="timeout"),  # slot 0 pinned
+               invoke_op(1, "write", 2),                 # slot 1
+               ok_op(1, "write", 2)])
+    e = encode_history(cas_register(), prepare_history(h))
+    # info emits no device event; its slot stays occupied
+    assert list(e.ev_type) == [EV_INVOKE, EV_INVOKE, EV_OK]
+    assert list(e.ev_slot) == [0, 1, 1]
+    assert e.max_live == 2
+
+
+def test_encode_window_overflow():
+    h = index([invoke_op(p, "write", p) for p in range(9)])
+    e = encode_history(cas_register(), prepare_history(h), max_slots=8)
+    assert isinstance(e, EncodeFailure)
+
+
+# ---------------------------------------------------------------- kernel
+
+def check_parity(model, histories):
+    host = [wgl_check(model, h) for h in histories]
+    tpu = check_batch_tpu(model, histories)
+    for i, (a, b) in enumerate(zip(host, tpu)):
+        assert a["valid"] == b["valid"], \
+            f"history {i}: host={a['valid']} tpu={b['valid']}"
+        if a["valid"] is False:
+            assert a["op"]["index"] == b["op"]["index"], \
+                f"history {i}: bad-op host={a['op']} tpu={b['op']}"
+    return host
+
+
+def test_sequential_valid():
+    h = index([invoke_op(0, "write", 1), ok_op(0, "write", 1),
+               invoke_op(0, "read", None), ok_op(0, "read", 1),
+               invoke_op(0, "cas", [1, 2]), ok_op(0, "cas", [1, 2]),
+               invoke_op(0, "read", None), ok_op(0, "read", 2)])
+    assert check_one_tpu(cas_register(), h)["valid"] is True
+
+
+def test_impossible_read():
+    h = index([invoke_op(0, "write", 1), ok_op(0, "write", 1),
+               invoke_op(0, "read", None), ok_op(0, "read", 2)])
+    r = check_one_tpu(cas_register(), h)
+    assert r["valid"] is False
+    assert r["op"]["index"] == 3
+
+
+def test_concurrent_overlap_valid():
+    # write 1 and write 2 overlap; read 1 then read 2 both justifiable
+    h = index([invoke_op(0, "write", 1),
+               invoke_op(1, "write", 2),
+               ok_op(0, "write", 1),
+               invoke_op(2, "read", None), ok_op(2, "read", 1),
+               ok_op(1, "write", 2),
+               invoke_op(2, "read", None), ok_op(2, "read", 2)])
+    assert check_one_tpu(cas_register(), h)["valid"] is True
+
+
+def test_info_write_may_or_may_not_apply():
+    # A timed-out write may apply later: both reads are justifiable.
+    h = index([invoke_op(0, "write", 1), ok_op(0, "write", 1),
+               invoke_op(1, "write", 2), info_op(1, "write", 2),
+               invoke_op(2, "read", None), ok_op(2, "read", 1),
+               invoke_op(2, "read", None), ok_op(2, "read", 2),
+               # but once observed applied, it can't unapply:
+               invoke_op(2, "read", None), ok_op(2, "read", 1)])
+    r = check_one_tpu(cas_register(), h)
+    assert r["valid"] is False
+    assert r["op"]["index"] == 9
+
+
+def test_crashed_op_stays_pending():
+    # invoke with no completion at all — may linearize anytime or never
+    h = index([invoke_op(0, "write", 1),
+               invoke_op(1, "read", None), ok_op(1, "read", 1),
+               invoke_op(1, "read", None), ok_op(1, "read", None)])
+    # second read observed nothing (None = unconstrained) — fine
+    assert check_one_tpu(cas_register(), h)["valid"] is True
+
+
+def test_mutex_parity():
+    ok = index([invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+                invoke_op(0, "release", None), ok_op(0, "release", None),
+                invoke_op(1, "acquire", None), ok_op(1, "acquire", None)])
+    bad = index([invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+                 invoke_op(1, "acquire", None), ok_op(1, "acquire", None)])
+    check_parity(mutex(), [ok, bad])
+    assert check_one_tpu(mutex(), bad)["valid"] is False
+
+
+def test_statespace_fallback_to_host():
+    from jepsen_tpu.models.core import set_model
+    h = []
+    for i in range(10):
+        h += [invoke_op(0, "add", i), ok_op(0, "add", i)]
+    h = index(h)
+    r = check_one_tpu(set_model(), h, max_states=16)
+    assert r["valid"] is True
+    assert "fallback" in r
+
+
+# ------------------------------------------------- randomized parity sweep
+
+def random_history(rng, n_procs=4, n_ops=18, n_values=3, corrupt=0.2,
+                   p_info=0.12):
+    """Simulate a real linearizable register then maybe corrupt a read."""
+    reg = None
+    h = []
+    live = {}
+    free = list(range(n_procs))
+    done = 0
+    while done < n_ops or live:
+        if free and done < n_ops and (not live or rng.random() < 0.6):
+            p = free.pop(rng.randrange(len(free)))
+            f = rng.choice(["read", "write", "cas"])
+            if f == "read":
+                h.append(invoke_op(p, "read", None))
+                live[p] = ("read", None)
+            elif f == "write":
+                v = rng.randrange(n_values)
+                h.append(invoke_op(p, "write", v))
+                live[p] = ("write", v)
+            else:
+                v = [rng.randrange(n_values), rng.randrange(n_values)]
+                h.append(invoke_op(p, "cas", v))
+                live[p] = ("cas", v)
+            done += 1
+        else:
+            p = rng.choice(list(live.keys()))
+            f, v = live.pop(p)
+            r = rng.random()
+            if f == "read":
+                if r < p_info:
+                    h.append(info_op(p, "read", None, error="timeout"))
+                else:
+                    h.append(ok_op(p, "read", reg))
+            elif f == "write":
+                if r < p_info:
+                    if rng.random() < 0.5:
+                        reg = v
+                    h.append(info_op(p, "write", v, error="timeout"))
+                else:
+                    reg = v
+                    h.append(ok_op(p, "write", v))
+            else:
+                if r < p_info:
+                    if rng.random() < 0.5 and reg == v[0]:
+                        reg = v[1]
+                    h.append(info_op(p, "cas", v, error="timeout"))
+                elif reg == v[0]:
+                    reg = v[1]
+                    h.append(ok_op(p, "cas", v))
+                else:
+                    h.append(fail_op(p, "cas", v, error="mismatch"))
+            free.append(p)
+    if rng.random() < corrupt:
+        reads = [i for i, op in enumerate(h)
+                 if op.type == "ok" and op.f == "read"]
+        if reads:
+            i = rng.choice(reads)
+            h[i].value = (h[i].value or 0) + rng.randrange(1, n_values)
+    return index(h)
+
+
+def test_random_parity_sweep():
+    rng = random.Random(7)
+    hists = [random_history(rng) for _ in range(60)]
+    host = check_parity(cas_register(), hists)
+    # make sure the sweep exercises both verdicts
+    verdicts = {r["valid"] for r in host}
+    assert verdicts == {True, False}
