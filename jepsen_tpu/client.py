@@ -1,0 +1,37 @@
+"""Client protocol: how workers apply operations to the system under test.
+
+Mirrors jepsen/src/jepsen/client.clj:4-20. A client is specialized to a
+node at setup (one client per worker process), invoked once per op, and
+torn down at the end. ``invoke`` receives an invocation op dict and must
+return a completion dict with type "ok" (definitely happened), "fail"
+(definitely didn't), or "info" (indeterminate). Exceptions escaping
+``invoke`` count as indeterminate: the worker logs an info op and retires
+the process id (core.clj:185-205 semantics, see runtime.worker).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Client:
+    def setup(self, test: dict, node) -> "Client":
+        """Return a client specialized to ``node`` (may be self)."""
+        return self
+
+    def invoke(self, test: dict, op: dict) -> dict:
+        """Apply ``op``; return the completion op dict."""
+        raise NotImplementedError
+
+    def teardown(self, test: dict) -> None:
+        pass
+
+
+class NoopClient(Client):
+    """Does nothing; acknowledges every op (client.clj:15-20)."""
+
+    def invoke(self, test, op):
+        return {**op, "type": "ok"}
+
+
+def noop_client() -> Client:
+    return NoopClient()

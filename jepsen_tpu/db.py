@@ -1,0 +1,35 @@
+"""DB protocol: install/start/stop the database under test on a node.
+
+Mirrors jepsen/src/jepsen/db.clj:4-25 — the DB, Primary, and LogFiles
+capabilities collapse into one optional-method class here (Python has no
+protocol dispatch; absence of the optional methods means the capability
+is absent, as the reference's `satisfies?` checks do).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class DB:
+    def setup(self, test: dict, node) -> None:
+        """Install and start the database on node."""
+
+    def teardown(self, test: dict, node) -> None:
+        """Tear down and destroy all db state on node."""
+
+    # -- optional capabilities ------------------------------------------
+    # def setup_primary(self, test, node): Primary (db.clj:8-10)
+    # def log_files(self, test, node) -> List[str]: LogFiles (db.clj:11-12)
+
+    def cycle(self, test: dict, node) -> None:
+        """Teardown, then setup — a clean slate (db.clj:20-25)."""
+        self.teardown(test, node)
+        self.setup(test, node)
+
+
+class NoopDB(DB):
+    """No database at all."""
+
+
+def noop_db() -> DB:
+    return NoopDB()

@@ -1,0 +1,607 @@
+"""Generator DSL: composable, stateful op sources for test workloads.
+
+Semantics mirror the reference's generator library
+(jepsen/src/jepsen/generator.clj) — "a big ol box of monads":
+
+  * a generator yields op dicts until exhausted, then yields None;
+  * *everything* may act as a generator: None yields nothing, a dict
+    yields itself forever, a callable is invoked per op, an object with
+    an ``op`` method delegates;
+  * generators may sleep inside ``op`` to pace the test;
+  * thread-scoped combinators (`on`, `reserve`, `nemesis`, `clients`)
+    narrow the set of threads a sub-generator sees — here via an explicit
+    immutable :class:`Context` rather than the reference's dynamic
+    ``*threads*`` var (generator.clj:40-46);
+  * barrier combinators (`synchronize`, `phases`, `then`) block until
+    every thread in scope arrives (generator.clj:402-424).
+
+Stateful combinators are thread-safe: the runtime's workers poll a shared
+generator tree concurrently, as the reference's JVM futures do.
+
+Ops are plain dicts with at least ``{"f": ...}``; workers fill in
+``process``/``time``/``type`` (invoke) — generator.clj:7-9. ``ctx.rng``
+is a seeded Random so single-threaded drains (and the batch-seeded north
+star mode) are deterministic.
+"""
+from __future__ import annotations
+
+import threading
+import time as _time
+from dataclasses import dataclass, field, replace
+from random import Random
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+NEMESIS = "nemesis"
+
+
+@dataclass(frozen=True)
+class Context:
+    """Generator-side execution context.
+
+    threads:     ordered tuple of thread ids in scope (ints + "nemesis").
+    concurrency: worker count; process -> thread is process % concurrency.
+    rng:         seeded Random shared across the generator tree.
+    time_nanos:  callable yielding monotonic nanos (injectable for tests).
+    """
+
+    threads: tuple = ()
+    concurrency: int = 0
+    rng: Random = field(default_factory=Random)
+    time_nanos: Callable[[], int] = _time.monotonic_ns
+
+    def with_threads(self, threads) -> "Context":
+        return replace(self, threads=tuple(threads))
+
+    def thread_of(self, process):
+        """process → thread id (generator.clj:58-63)."""
+        if isinstance(process, int) and self.concurrency:
+            return process % self.concurrency
+        return process
+
+
+def _accepts_3_args(f) -> bool:
+    """Can f be called as f(test, process, ctx)? Decided from the
+    signature, NOT by catching TypeError from a call — a TypeError
+    raised *inside* the body must propagate, not trigger a re-call
+    (which would duplicate side effects and mask the real error)."""
+    import inspect
+    try:
+        inspect.signature(f).bind(None, None, None)
+        return True
+    except TypeError:
+        return False
+    except ValueError:   # no signature available (builtins): assume 0-ary
+        return False
+
+
+def op(gen, test: dict, process, ctx: Context) -> Optional[dict]:
+    """Yield the next op from anything generator-like (generator.clj:25-38)."""
+    if gen is None:
+        return None
+    if isinstance(gen, dict):
+        return dict(gen)
+    if isinstance(gen, Generator):
+        return gen.op(test, process, ctx)
+    if callable(gen):
+        cached = getattr(gen, "__jt_gen_arity3__", None)
+        if cached is None:
+            cached = _accepts_3_args(gen)
+            try:
+                gen.__jt_gen_arity3__ = cached
+            except (AttributeError, TypeError):
+                pass
+        return gen(test, process, ctx) if cached else gen()
+    raise TypeError(f"not a generator: {gen!r}")
+
+
+class Generator:
+    """Base class; subclasses implement ``op`` returning a dict or None."""
+
+    def op(self, test: dict, process, ctx: Context) -> Optional[dict]:
+        raise NotImplementedError
+
+
+class _Fn(Generator):
+    def __init__(self, f):
+        self.f = f
+
+    def op(self, test, process, ctx):
+        return self.f(test, process, ctx)
+
+
+def void() -> Generator:
+    """Terminates immediately (generator.clj:74-77)."""
+    return _Fn(lambda test, process, ctx: None)
+
+
+class _Once(Generator):
+    """Invokes the source exactly once (generator.clj:148-156)."""
+
+    def __init__(self, source):
+        self.source = source
+        self._lock = threading.Lock()
+        self._emitted = False
+
+    def op(self, test, process, ctx):
+        with self._lock:
+            if self._emitted:
+                return None
+            self._emitted = True
+        return op(self.source, test, process, ctx)
+
+
+def once(source) -> Generator:
+    return _Once(source)
+
+
+class _Log(Generator):
+    def __init__(self, msg, logger=None):
+        import logging
+        self.msg = msg
+        self.logger = logger or logging.getLogger("jepsen.gen")
+
+    def op(self, test, process, ctx):
+        self.logger.info(self.msg)
+        return None
+
+
+def log_every(msg) -> Generator:
+    """Logs every time invoked; yields None (generator.clj:158-164)."""
+    return _Log(msg)
+
+
+def log(msg) -> Generator:
+    """Logs once; yields None (generator.clj:166-169)."""
+    return once(_Log(msg))
+
+
+class _Each(Generator):
+    """An independent copy of the underlying generator per process
+    (generator.clj:171-193)."""
+
+    def __init__(self, gen_fn):
+        self.gen_fn = gen_fn
+        self._gens: Dict[Any, Any] = {}
+        self._lock = threading.Lock()
+
+    def op(self, test, process, ctx):
+        with self._lock:
+            g = self._gens.get(process)
+            if g is None:
+                g = self._gens[process] = self.gen_fn()
+        return op(g, test, process, ctx)
+
+
+def each(gen_fn: Callable[[], Any]) -> Generator:
+    return _Each(gen_fn)
+
+
+class _Seq(Generator):
+    """One op per call from the current generator; on None advance to the
+    next (generator.clj:195-206). Accepts any iterable, incl. infinite."""
+
+    def __init__(self, coll: Iterable):
+        self._it = iter(coll)
+        self._cur = None
+        self._live = True
+        self._lock = threading.RLock()
+
+    def op(self, test, process, ctx):
+        with self._lock:
+            while self._live:
+                if self._cur is None:
+                    try:
+                        self._cur = next(self._it)
+                    except StopIteration:
+                        self._live = False
+                        return None
+                o = op(self._cur, test, process, ctx)
+                if o is not None:
+                    # A bare dict/constant yields itself forever; in a seq
+                    # each such element contributes one op then retires.
+                    if not isinstance(self._cur, Generator) \
+                            and not callable(self._cur):
+                        self._cur = None
+                    return o
+                self._cur = None
+        return None
+
+
+def seq(coll: Iterable) -> Generator:
+    return _Seq(coll)
+
+
+def start_stop(t1: float, t2: float) -> Generator:
+    """sleep t1, :start, sleep t2, :stop, forever (generator.clj:208-215)."""
+    import itertools
+    return seq(itertools.cycle([sleep(t1), {"type": "info", "f": "start"},
+                                sleep(t2), {"type": "info", "f": "stop"}]))
+
+
+class _Mix(Generator):
+    """Uniform random choice per op (generator.clj:217-224)."""
+
+    def __init__(self, gens: Sequence):
+        self.gens = list(gens)
+
+    def op(self, test, process, ctx):
+        return op(self.gens[ctx.rng.randrange(len(self.gens))],
+                  test, process, ctx)
+
+
+def mix(gens: Sequence) -> Generator:
+    return _Mix(gens)
+
+
+def cas_gen(n_values: int = 5) -> Generator:
+    """Random read/write/cas invocations over a small int field
+    (generator.clj:226-239)."""
+
+    def g(test, process, ctx):
+        r = ctx.rng.random()
+        if r > 0.66:
+            return {"type": "invoke", "f": "read", "value": None}
+        if r > 0.33:
+            return {"type": "invoke", "f": "write",
+                    "value": ctx.rng.randrange(n_values)}
+        return {"type": "invoke", "f": "cas",
+                "value": [ctx.rng.randrange(n_values),
+                          ctx.rng.randrange(n_values)]}
+
+    return _Fn(g)
+
+
+class _QueueGen(Generator):
+    """Random enqueue (consecutive ints) / dequeue mix
+    (generator.clj:241-252)."""
+
+    def __init__(self):
+        self._i = -1
+        self._lock = threading.Lock()
+
+    def op(self, test, process, ctx):
+        if ctx.rng.random() < 0.5:
+            with self._lock:
+                self._i += 1
+                v = self._i
+            return {"type": "invoke", "f": "enqueue", "value": v}
+        return {"type": "invoke", "f": "dequeue", "value": None}
+
+
+def queue_gen() -> Generator:
+    return _QueueGen()
+
+
+class _DrainQueue(Generator):
+    """After the source is exhausted, emits one dequeue per attempted
+    enqueue that passed through (generator.clj:254-269)."""
+
+    def __init__(self, source):
+        self.source = source
+        self._outstanding = 0
+        self._lock = threading.Lock()
+
+    def op(self, test, process, ctx):
+        o = op(self.source, test, process, ctx)
+        if o is not None:
+            if o.get("f") == "enqueue":
+                with self._lock:
+                    self._outstanding += 1
+            return o
+        with self._lock:
+            self._outstanding -= 1
+            remaining = self._outstanding
+        if remaining >= 0:
+            return {"type": "invoke", "f": "dequeue", "value": None}
+        return None
+
+
+def drain_queue(source) -> Generator:
+    return _DrainQueue(source)
+
+
+class _Limit(Generator):
+    """At most n ops (generator.clj:271-279)."""
+
+    def __init__(self, n: int, source):
+        self.source = source
+        self._life = n + 1
+        self._lock = threading.Lock()
+
+    def op(self, test, process, ctx):
+        with self._lock:
+            self._life -= 1
+            alive = self._life > 0
+        if alive:
+            return op(self.source, test, process, ctx)
+        return None
+
+
+def limit(n: int, source) -> Generator:
+    return _Limit(n, source)
+
+
+class _TimeLimit(Generator):
+    """Ops until dt seconds after first use (generator.clj:281-291)."""
+
+    def __init__(self, dt: float, source):
+        self.source = source
+        self.dt_nanos = int(dt * 1e9)
+        self._deadline = None
+        self._lock = threading.Lock()
+
+    def op(self, test, process, ctx):
+        now = ctx.time_nanos()
+        with self._lock:
+            if self._deadline is None:
+                self._deadline = now + self.dt_nanos
+            deadline = self._deadline
+        if now <= deadline:
+            return op(self.source, test, process, ctx)
+        return None
+
+
+def time_limit(dt: float, source) -> Generator:
+    return _TimeLimit(dt, source)
+
+
+class _Filter(Generator):
+    """Only ops satisfying f (generator.clj:293-303)."""
+
+    def __init__(self, f, source):
+        self.f = f
+        self.source = source
+
+    def op(self, test, process, ctx):
+        while True:
+            o = op(self.source, test, process, ctx)
+            if o is None:
+                return None
+            if self.f(o):
+                return o
+
+
+def filter_gen(f, source) -> Generator:
+    return _Filter(f, source)
+
+
+# ------------------------------------------------- timing combinators
+
+def sleep_til_nanos(ctx: Context, t: int) -> None:
+    while True:
+        dt = t - ctx.time_nanos()
+        if dt <= 10_000:
+            return
+        _time.sleep(dt / 1e9)
+
+
+class _DelayFn(Generator):
+    """Each op takes (f) extra seconds (generator.clj:88-101)."""
+
+    def __init__(self, f, source):
+        self.f = f
+        self.source = source
+
+    def op(self, test, process, ctx):
+        _time.sleep(self.f(ctx))
+        return op(self.source, test, process, ctx)
+
+
+def delay(dt: float, source) -> Generator:
+    return _DelayFn(lambda ctx: dt, source)
+
+
+def stagger(dt: float, source) -> Generator:
+    """Uniform random delay in [0, 2dt) — mean dt (generator.clj:137-141)."""
+    return _DelayFn(lambda ctx: ctx.rng.uniform(0, 2 * dt), source)
+
+
+def sleep(dt: float) -> Generator:
+    """Takes dt seconds and yields None (generator.clj:143-146)."""
+    return delay(dt, void())
+
+
+class _DelayTil(Generator):
+    """Emit invocations as close as possible to shared multiples of dt
+    from an anchor — aligned invocations trigger races
+    (generator.clj:112-135)."""
+
+    def __init__(self, dt: float, source, precache: bool = True):
+        self.dt_nanos = int(dt * 1e9)
+        self.source = source
+        self.precache = precache
+        self._anchor = None
+        self._lock = threading.Lock()
+
+    def _next_tick(self, ctx):
+        now = ctx.time_nanos()
+        with self._lock:
+            if self._anchor is None:
+                self._anchor = now
+            anchor = self._anchor
+        return now + (self.dt_nanos - (now - anchor) % self.dt_nanos)
+
+    def op(self, test, process, ctx):
+        if self.precache:
+            o = op(self.source, test, process, ctx)
+            sleep_til_nanos(ctx, self._next_tick(ctx))
+            return o
+        sleep_til_nanos(ctx, self._next_tick(ctx))
+        return op(self.source, test, process, ctx)
+
+
+def delay_til(dt: float, source, precache: bool = True) -> Generator:
+    return _DelayTil(dt, source, precache)
+
+
+# ------------------------------------------- thread-scoped combinators
+
+class _On(Generator):
+    """Forward ops iff f(thread); narrows ctx.threads
+    (generator.clj:305-312)."""
+
+    def __init__(self, f, source):
+        self.f = f
+        self.source = source
+
+    def op(self, test, process, ctx):
+        if not self.f(ctx.thread_of(process)):
+            return None
+        sub = ctx.with_threads(t for t in ctx.threads if self.f(t))
+        return op(self.source, test, process, sub)
+
+
+def on(f, source) -> Generator:
+    return _On(f, source)
+
+
+def nemesis(nemesis_gen, client_gen=None) -> Generator:
+    """Route the nemesis thread to one generator, clients to another
+    (generator.clj:372-380)."""
+    if client_gen is None:
+        return on(lambda t: t == NEMESIS, nemesis_gen)
+    return concat(on(lambda t: t == NEMESIS, nemesis_gen),
+                  on(lambda t: t != NEMESIS, client_gen))
+
+
+def clients(client_gen) -> Generator:
+    """Executes only on client threads (generator.clj:382-385)."""
+    return on(lambda t: t != NEMESIS, client_gen)
+
+
+class _Reserve(Generator):
+    """(reserve 5 writes 10 cas reads): thread-range partitioning
+    (generator.clj:314-358)."""
+
+    def __init__(self, *args):
+        assert args and len(args) % 2 == 1, \
+            "reserve takes count/gen pairs + a default generator"
+        pairs, self.default = args[:-1], args[-1]
+        self.ranges = []
+        lower = 0
+        for n, g in zip(pairs[::2], pairs[1::2]):
+            self.ranges.append((lower, lower + n, g))
+            lower += n
+
+    def op(self, test, process, ctx):
+        threads = list(ctx.threads)
+        thread = ctx.thread_of(process)
+        # Thread ids in scope, ordered; find our index range.
+        for lower, upper, g in self.ranges:
+            if upper <= len(threads) and thread in threads[lower:upper]:
+                return op(g, test, process, ctx.with_threads(
+                    threads[lower:upper]))
+        tail = self.ranges[-1][1] if self.ranges else 0
+        if thread in threads[tail:]:
+            return op(self.default, test, process,
+                      ctx.with_threads(threads[tail:]))
+        return None
+
+
+def reserve(*args) -> Generator:
+    return _Reserve(*args)
+
+
+# ------------------------------------------------ barrier combinators
+
+class _Concat(Generator):
+    """First non-None op across sources, in order (generator.clj:360-370)."""
+
+    def __init__(self, *sources):
+        self.sources = list(sources)
+
+    def op(self, test, process, ctx):
+        for s in self.sources:
+            o = op(s, test, process, ctx)
+            if o is not None:
+                return o
+        return None
+
+
+def concat(*sources) -> Generator:
+    return _Concat(*sources)
+
+
+class _Await(Generator):
+    """Blocks until f returns (invoked once), then delegates
+    (generator.clj:387-400)."""
+
+    def __init__(self, f, source=None):
+        self.f = f
+        self.source = source
+        self._state = "waiting"
+        self._lock = threading.Lock()
+
+    def op(self, test, process, ctx):
+        if self._state == "waiting":
+            with self._lock:
+                if self._state == "waiting":
+                    self.f()
+                    self._state = "ready"
+        return op(self.source, test, process, ctx)
+
+
+def await_fn(f, source=None) -> Generator:
+    return _Await(f, source)
+
+
+class _Synchronize(Generator):
+    """Block until every thread in scope is waiting here, once; then
+    pass through (generator.clj:402-419)."""
+
+    def __init__(self, source):
+        self.source = source
+        self._barrier = None
+        self._cleared = False
+        self._lock = threading.Lock()
+
+    def op(self, test, process, ctx):
+        if not self._cleared:
+            with self._lock:
+                if self._barrier is None and not self._cleared:
+                    def clear():
+                        self._cleared = True
+                    self._barrier = threading.Barrier(
+                        len(ctx.threads), action=clear)
+                b = self._barrier
+            if not self._cleared and b is not None:
+                b.wait()
+        return op(self.source, test, process, ctx)
+
+
+def synchronize(source) -> Generator:
+    return _Synchronize(source)
+
+
+def phases(*generators) -> Generator:
+    """All threads finish phase k before any starts k+1
+    (generator.clj:421-424)."""
+    return concat(*[synchronize(g) for g in generators])
+
+
+def then(a, b) -> Generator:
+    """b, synchronize, then a — backwards for pipeline composition
+    (generator.clj:426-430)."""
+    return concat(b, synchronize(a))
+
+
+def barrier(source) -> Generator:
+    """When source completes, synchronize, then None (generator.clj:441-444)."""
+    return then(void(), source)
+
+
+class _SingleThreaded(Generator):
+    """Exclusive lock around the underlying generator
+    (generator.clj:432-439)."""
+
+    def __init__(self, source):
+        self.source = source
+        self._lock = threading.Lock()
+
+    def op(self, test, process, ctx):
+        with self._lock:
+            return op(self.source, test, process, ctx)
+
+
+def singlethreaded(source) -> Generator:
+    return _SingleThreaded(source)

@@ -2,25 +2,32 @@
 
 A prepared history (client ops, completion-propagated, failure-free — see
 jepsen_tpu.checkers.linearizable.prepare_history) lowers to a sequence of
-integer events:
+*completion events*. Only ok-completions require device work (the WGL
+closure + filter); everything else — pending-slot allocation, the table
+of which op kind occupies which slot — is deterministic bookkeeping the
+host precomputes:
 
-  INVOKE slot trans — op ``trans`` becomes pending in slot ``slot``
-  OK     slot  —    — the op in ``slot`` completed; it must be linearized
-                     by now, and its slot frees
-  (info / crashed ops emit no completion event: their slot stays occupied
-   to the end of the history, encoding "may linearize at any later point
-   or never" — knossos semantics, core.clj:185-205)
+  * INVOKE: allocate a pending slot (low slots first; LIFO reuse keeps
+    indices < peak-live), record the op kind in the slot table.
+  * OK: emit one device event: (slot, snapshot of the slot table); the
+    op must be linearized by now, and its slot frees afterwards.
+  * INFO / crashed (no completion): the slot stays occupied to the end —
+    "may linearize at any later point or never" (knossos semantics,
+    core.clj:185-205). Exception: ops whose transition is the *total
+    identity* (e.g. a timed-out read that observed nothing) constrain no
+    configuration and never require completion, so they are dropped
+    entirely instead of pinning a slot forever — this keeps the pending
+    window W, whose cost is 2^W, proportional to real concurrency.
 
-Slots are a bounded window: each concurrently-pending op holds one of W
-slots. The kernel represents the WGL configuration set densely as a
-boolean frontier [V states, 2^W pending subsets], so W and the state-space
-bound V are static costs chosen here. Histories that exceed the bounds
-are flagged for host/native fallback rather than mis-checked.
+Slots are a bounded window: the kernel's frontier is [V states, 2^W
+subsets], so W and the state bound V are static costs chosen here.
+Histories exceeding the bounds are flagged for host/native fallback
+rather than mis-checked.
 """
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -31,17 +38,20 @@ from .statespace import (StateSpace, StateSpaceExplosion, enumerate_statespace,
 
 # Event type codes (kernel-side contract).
 EV_PAD = 0
-EV_INVOKE = 1
 EV_OK = 2
+
+# Slot-table entry for an empty slot; remapped to the all-invalid sentinel
+# row of the padded transition table at stacking time.
+EMPTY = -1
 
 
 @dataclass
 class EncodedHistory:
     """One history lowered to kernel inputs (unpadded lengths)."""
 
-    ev_type: np.ndarray    # [n] int32
-    ev_slot: np.ndarray    # [n] int32
-    ev_trans: np.ndarray   # [n] int32 (invoke: kind index; else 0)
+    ev_slot: np.ndarray    # [n] int32 — completing slot per ok event
+    ev_slots: np.ndarray   # [n, max_live] int32 — slot-table snapshot
+                           #   (op-kind index per slot, EMPTY when free)
     ev_opidx: np.ndarray   # [n] int32 — history index of the source op
     space: StateSpace
     max_live: int          # peak number of concurrently-pending slots
@@ -63,7 +73,7 @@ class EncodeFailure:
 
 def encode_history(model: Model, prepared: List[Op], *,
                    max_states: int = 64,
-                   max_slots: int = 24,
+                   max_slots: int = 16,
                    space_cache: Optional[dict] = None):
     """Lower one prepared history. Returns EncodedHistory or EncodeFailure.
 
@@ -83,53 +93,64 @@ def encode_history(model: Model, prepared: List[Op], *,
             return EncodeFailure(str(e))
         if space_cache is not None:
             space_cache[key] = space
+    identity = space.identity_kinds
 
-    ev_type: List[int] = []
+    # Which invocations never complete ok? (info or missing completion)
+    completion_type: Dict[int, str] = {}   # invoke position -> type
+    open_inv: Dict[object, int] = {}
+    for pos, o in enumerate(prepared):
+        if o.type == INVOKE:
+            open_inv[o.process] = pos
+        elif o.is_completion and o.process in open_inv:
+            completion_type[open_inv.pop(o.process)] = o.type
+
     ev_slot: List[int] = []
-    ev_trans: List[int] = []
+    ev_slots: List[List[int]] = []
     ev_opidx: List[int] = []
 
+    table = [EMPTY] * max_slots
     free = list(range(max_slots - 1, -1, -1))  # stack; low slots first
-    slot_of = {}                               # process -> slot
+    slot_of: Dict[object, int] = {}
     live = 0
     max_live = 0
 
-    for pos, op in enumerate(prepared):
-        if op.type == INVOKE:
+    for pos, o in enumerate(prepared):
+        if o.type == INVOKE:
+            ki = space.kind_index[op_kind(o)]
+            if ki in identity and completion_type.get(pos) != OK:
+                continue   # total-identity op that never completes: drop
             if not free:
                 return EncodeFailure(
                     f"more than {max_slots} concurrently-pending ops")
             slot = free.pop()
-            slot_of[op.process] = slot
+            slot_of[o.process] = slot
+            table[slot] = ki
             live += 1
             max_live = max(max_live, live)
-            ev_type.append(EV_INVOKE)
-            ev_slot.append(slot)
-            ev_trans.append(space.kind_index[op_kind(op)])
-            ev_opidx.append(op.index if op.index is not None else pos)
-        elif op.type == OK:
-            slot = slot_of.pop(op.process, None)
+        elif o.type == OK:
+            slot = slot_of.pop(o.process, None)
             if slot is None:
                 continue  # completion with no open invocation
+            ev_slot.append(slot)
+            ev_slots.append(table.copy())   # snapshot WITH the op pending
+            ev_opidx.append(o.index if o.index is not None else pos)
+            table[slot] = EMPTY
             free.append(slot)
             live -= 1
-            ev_type.append(EV_OK)
-            ev_slot.append(slot)
-            ev_trans.append(0)
-            ev_opidx.append(op.index if op.index is not None else pos)
-        elif op.type == INFO:
-            # Indeterminate: op stays pending to the end. Its slot is
-            # intentionally never freed; no device event is emitted.
-            slot_of.pop(op.process, None)
+        elif o.type == INFO:
+            # Indeterminate: stays pending to the end; slot stays pinned.
+            slot_of.pop(o.process, None)
 
+    n = len(ev_slot)
+    w = max(max_live, 1)
     return EncodedHistory(
-        ev_type=np.asarray(ev_type, dtype=np.int32),
         ev_slot=np.asarray(ev_slot, dtype=np.int32),
-        ev_trans=np.asarray(ev_trans, dtype=np.int32),
+        ev_slots=(np.asarray(ev_slots, dtype=np.int32)[:, :w]
+                  if n else np.zeros((0, w), np.int32)),
         ev_opidx=np.asarray(ev_opidx, dtype=np.int32),
         space=space,
         max_live=max_live,
-        n_events=len(ev_type),
+        n_events=n,
     )
 
 
@@ -143,15 +164,19 @@ class EncodedBatch:
 
     Array shapes (B = batch, N = padded events, V = padded states,
     K = padded op kinds, W = slot-window width):
-      ev_type/ev_slot/ev_trans/ev_opidx — int32 [B, N]
-      target — int32 [B, K + 1, V]; final row = all-invalid sentinel
+      ev_type  — int32 [B, N]: EV_OK or EV_PAD
+      ev_slot  — int32 [B, N]
+      ev_slots — int32 [B, N, W]: slot tables; empty slots point at the
+                 all-invalid sentinel row K of ``target``
+      ev_opidx — int32 [B, N]
+      target   — int32 [B, K + 1, V]; final row = all-invalid sentinel
     ``indices`` maps batch rows back to positions in the caller's history
     list; ``failures`` lists (position, reason) needing host fallback.
     """
 
     ev_type: np.ndarray
     ev_slot: np.ndarray
-    ev_trans: np.ndarray
+    ev_slots: np.ndarray
     ev_opidx: np.ndarray
     target: np.ndarray
     V: int
@@ -168,17 +193,10 @@ class EncodedBatch:
         return int(self.ev_type.shape[1])
 
 
-def batch_encode(model: Model, prepared_histories: Sequence[List[Op]], *,
-                 max_states: int = 64, max_slots: int = 24,
-                 min_v: int = 8, min_w: int = 8,
-                 pad_batch_to: Optional[int] = None) -> EncodedBatch:
-    """Encode many prepared histories into one padded batch.
-
-    Static bounds (V, W, N, K) are the maxima over the batch, rounded up
-    for TPU-friendly layouts. Cost scales with V * 2^W, so callers
-    checking heterogeneous histories should bucket by cost first
-    (jepsen_tpu.checkers.batch does).
-    """
+def encode_all(model: Model, prepared_histories: Sequence[List[Op]], *,
+               max_states: int = 64, max_slots: int = 16):
+    """Encode each history (shared state-space cache). Returns
+    (list of (position, EncodedHistory), list of (position, reason))."""
     encs: List[Tuple[int, EncodedHistory]] = []
     failures: List[Tuple[int, str]] = []
     space_cache: dict = {}
@@ -189,33 +207,82 @@ def batch_encode(model: Model, prepared_histories: Sequence[List[Op]], *,
             failures.append((i, e.reason))
         else:
             encs.append((i, e))
+    return encs, failures
 
+
+def stack_encoded(encs: Sequence[Tuple[int, EncodedHistory]],
+                  failures: Sequence[Tuple[int, str]] = (), *,
+                  min_v: int = 8, min_w: int = 4,
+                  pad_batch_to: Optional[int] = None) -> EncodedBatch:
+    """Stack encoded histories into one padded batch; bounds are the
+    maxima over the group, rounded up for TPU-friendly layouts."""
+    failures = list(failures)
     if not encs:
-        return EncodedBatch(*(np.zeros((0, 0), np.int32),) * 4,
+        z = np.zeros((0, 0), np.int32)
+        return EncodedBatch(z, z, np.zeros((0, 0, min_w), np.int32), z,
                             target=np.zeros((0, 1, min_v), np.int32),
                             V=min_v, W=min_w, indices=[], failures=failures)
 
     V = _round_up(max(max(e.n_states for _, e in encs), min_v), 4)
-    W = _round_up(max(max(e.max_live for _, e in encs), min_w), 4)
+    W = max(max(max(e.max_live for _, e in encs), min_w), 1)
     K = max(max(e.n_kinds for _, e in encs), 1)
-    N = _round_up(max(e.n_events for _, e in encs), 8)
+    N = _round_up(max(max(e.n_events for _, e in encs), 1), 8)
     B = len(encs)
     Bp = pad_batch_to if pad_batch_to else B
 
     ev_type = np.zeros((Bp, N), np.int32)
     ev_slot = np.zeros((Bp, N), np.int32)
-    ev_trans = np.zeros((Bp, N), np.int32)
+    ev_slots = np.full((Bp, N, W), K, np.int32)  # K = sentinel row
     ev_opidx = np.full((Bp, N), -1, np.int32)
     target = np.full((Bp, K + 1, V), -1, np.int32)
 
     for row, (_, e) in enumerate(encs):
-        n = e.n_events
-        ev_type[row, :n] = e.ev_type
+        n, w = e.n_events, e.ev_slots.shape[1] if e.n_events else 0
+        ev_type[row, :n] = EV_OK
         ev_slot[row, :n] = e.ev_slot
-        ev_trans[row, :n] = e.ev_trans
+        if n:
+            snap = e.ev_slots.astype(np.int64)
+            ev_slots[row, :n, :w] = np.where(snap == EMPTY, K, snap)
         ev_opidx[row, :n] = e.ev_opidx
         target[row] = e.space.padded_target(V, K)
 
-    return EncodedBatch(ev_type=ev_type, ev_slot=ev_slot, ev_trans=ev_trans,
+    return EncodedBatch(ev_type=ev_type, ev_slot=ev_slot, ev_slots=ev_slots,
                         ev_opidx=ev_opidx, target=target, V=V, W=W,
                         indices=[i for i, _ in encs], failures=failures)
+
+
+def batch_encode(model: Model, prepared_histories: Sequence[List[Op]], *,
+                 max_states: int = 64, max_slots: int = 16,
+                 min_v: int = 8, min_w: int = 4,
+                 pad_batch_to: Optional[int] = None) -> EncodedBatch:
+    """Encode many prepared histories into one padded batch (single cost
+    class; use ``bucket_encode`` for heterogeneous histories)."""
+    encs, failures = encode_all(model, prepared_histories,
+                                max_states=max_states, max_slots=max_slots)
+    return stack_encoded(encs, failures, min_v=min_v, min_w=min_w,
+                         pad_batch_to=pad_batch_to)
+
+
+def bucket_encode(model: Model, prepared_histories: Sequence[List[Op]], *,
+                  max_states: int = 64, max_slots: int = 16,
+                  min_v: int = 8, min_w: int = 4) -> List[EncodedBatch]:
+    """Encode histories grouped into (V, W) cost-class buckets.
+
+    Kernel cost scales with V * 2^W * events: one info-heavy history
+    (large pending window W) must not inflate the frontier of thousands
+    of clean ones, so each bucket pads only to its own class. Failures
+    ride on the first bucket."""
+    encs, failures = encode_all(model, prepared_histories,
+                                max_states=max_states, max_slots=max_slots)
+    groups: Dict[Tuple[int, int], List[Tuple[int, EncodedHistory]]] = {}
+    for i, e in encs:
+        key = (_round_up(max(e.n_states, min_v), 4),
+               _round_up(max(e.max_live, min_w), 4))
+        groups.setdefault(key, []).append((i, e))
+    out = []
+    for j, (key, group) in enumerate(sorted(groups.items())):
+        out.append(stack_encoded(group, failures if j == 0 else (),
+                                 min_v=key[0], min_w=key[1]))
+    if not out and failures:
+        out.append(stack_encoded([], failures, min_v=min_v, min_w=min_w))
+    return out

@@ -7,25 +7,26 @@ frontier
 
     F[s, m] = 1  iff  config (state s, linearized-pending-set m) reachable
 
-with ``m`` ranging over all 2^W subsets of the W pending-op slots. Events
-(lowered by jepsen_tpu.ops.encode) drive a ``lax.scan``:
+with ``m`` ranging over all 2^W subsets of the W pending-op slots. The
+host encoder (jepsen_tpu.ops.encode) reduces the history to ok-completion
+events, each carrying a precomputed snapshot of the pending-slot table;
+a ``lax.scan`` drives one event per step:
 
-  * INVOKE slot k — record op kind k in the device slot table.
-  * every event — close F under application of pending ops: for each
-    occupied slot i, (s, m without i) → (target[s], m | i). One
-    application is a static reshape splitting mask-bit i plus a V×V
-    one-hot "transition matmul" on the state axis; closure iterates to
-    fixpoint via ``lax.while_loop`` (monotone OR, so ≤ live-slot
-    iterations; re-running converged lanes under vmap is idempotent).
-  * OK slot — keep exactly the configs whose mask holds the slot's bit
-    and clear it (a dynamic gather along the mask axis — no per-slot
-    branching), freeing the slot. An empty survivor set means the
-    completed op cannot be linearized: the history is invalid and the
-    event index is recorded (it maps back to the offending op for
-    Knossos-parity counterexample reporting).
+  * close F under application of pending ops: for each occupied slot i,
+    (s, m w/o i) → (target[s], m | i). One application is a static
+    reshape splitting mask-bit i plus a V×V one-hot "transition matmul"
+    on the state axis; closure iterates to fixpoint via
+    ``lax.while_loop`` (monotone OR, ≤ live-slots iterations;
+    re-running converged lanes under vmap is idempotent);
+  * keep exactly the configs whose mask holds the completing slot's bit,
+    clear it (a dynamic gather along the mask axis — no per-slot
+    branching). An empty survivor set means the completed op cannot be
+    linearized: the history is invalid and the event index is recorded
+    (it maps back to the offending op for Knossos-parity counterexample
+    reporting).
 
 Shapes are fully static: [V, 2^W] per history, vmapped over the batch and
-shardable over the device mesh on the batch axis (jepsen_tpu.ops.mesh).
+shardable over the device mesh on the batch axis (jepsen_tpu.parallel).
 The mask axis provides long 128-lane vectors for the VPU and the
 transition matmuls batch onto the MXU. Cost scales with V * 2^W * events,
 so callers bucket histories by (V, W) cost class before batching.
@@ -42,8 +43,8 @@ import numpy as np
 
 from ..history.ops import Op
 from ..models.core import Model
-from .encode import (EV_INVOKE, EV_OK, EncodedBatch, EncodeFailure,
-                     batch_encode, encode_history)
+from .encode import (EV_OK, EncodedBatch, EncodeFailure,
+                     batch_encode, bucket_encode, encode_history)
 
 INT32_MAX = np.int32(2**31 - 1)
 
@@ -76,15 +77,15 @@ def _complete_slot(F: jnp.ndarray, slot: jnp.ndarray, M: int) -> jnp.ndarray:
 def make_kernel(V: int, W: int):
     """Build the single-history checker for static bounds (V, W).
 
-    Returns ``check(ev_type, ev_slot, ev_trans, target) -> (valid, bad)``
+    Returns ``check(ev_type, ev_slot, ev_slots, target) -> (valid, bad)``
     where ``bad`` is the event index of the first impossible completion
     (INT32_MAX when valid). vmap/shard over a leading batch axis.
     """
     M = 1 << W
 
-    def closure(F, slot_trans, target):
-        tgt = target[slot_trans]  # [W, V]; empty slots gather the
-                                  # all-invalid sentinel row.
+    def closure(F, slots_row, target):
+        tgt = target[slots_row]  # [W, V]; empty slots gather the
+                                 # all-invalid sentinel row.
 
         def body(carry):
             F0, _ = carry
@@ -96,32 +97,24 @@ def make_kernel(V: int, W: int):
         F, _ = lax.while_loop(lambda c: c[1], body, (F, jnp.bool_(True)))
         return F
 
-    def check(ev_type, ev_slot, ev_trans, target):
-        sentinel = jnp.int32(target.shape[0] - 1)
-
+    def check(ev_type, ev_slot, ev_slots, target):
         def step(carry, ev):
-            F, slot_trans, valid, bad = carry
-            typ, slot, trans, idx = ev
-            is_invoke = typ == EV_INVOKE
+            F, valid, bad = carry
+            typ, slot, slots_row, idx = ev
             is_ok = typ == EV_OK
-            st1 = jnp.where(is_invoke,
-                            slot_trans.at[slot].set(trans), slot_trans)
-            Fc = closure(F, st1, target)
+            Fc = closure(F, slots_row, target)
             F_ok = _complete_slot(Fc, slot, M)
             empty = is_ok & ~F_ok.any()
-            F2 = jnp.where(is_ok, F_ok, Fc)
-            st2 = jnp.where(is_ok, st1.at[slot].set(sentinel), st1)
-            valid2 = valid & ~empty
-            bad2 = jnp.minimum(bad, jnp.where(empty, idx, INT32_MAX))
-            return (F2, st2, valid2, bad2), None
+            F2 = jnp.where(is_ok, F_ok, F)
+            return (F2, valid & ~empty,
+                    jnp.minimum(bad, jnp.where(empty, idx, INT32_MAX))), None
 
         N = ev_type.shape[0]
         F0 = jnp.zeros((V, M), jnp.bool_).at[0, 0].set(True)
-        st0 = jnp.full((W,), sentinel, jnp.int32)
-        idx = jnp.arange(N, dtype=jnp.int32)
-        carry = (F0, st0, jnp.bool_(True), jnp.int32(INT32_MAX))
-        (F, st, valid, bad), _ = lax.scan(
-            step, carry, (ev_type, ev_slot, ev_trans, idx))
+        carry = (F0, jnp.bool_(True), jnp.int32(INT32_MAX))
+        (F, valid, bad), _ = lax.scan(
+            step, carry, (ev_type, ev_slot, ev_slots,
+                          jnp.arange(N, dtype=jnp.int32)))
         return valid, bad
 
     return check
@@ -140,14 +133,28 @@ def batch_kernel(V: int, W: int):
     return k
 
 
+# Frontier-elements budget per device dispatch: B * V * 2^W bools. Keeps
+# the scan carry (plus XLA's temporaries) well inside one chip's HBM even
+# for info-heavy windows (W=16 → 0.5 MB/history).
+MAX_FRONTIER_ELEMENTS = 1 << 27
+
+
 def run_encoded_batch(batch: EncodedBatch) -> Tuple[np.ndarray, np.ndarray]:
-    """Device-check an encoded batch. Returns (valid [B] bool, bad [B])."""
+    """Device-check an encoded batch. Returns (valid [B] bool, bad [B]).
+    Large batches are chunked to bound device memory."""
     if batch.batch == 0:
         return np.zeros((0,), bool), np.zeros((0,), np.int32)
     kern = batch_kernel(batch.V, batch.W)
-    valid, bad = kern(batch.ev_type, batch.ev_slot,
-                      batch.ev_trans, batch.target)
-    return np.asarray(valid), np.asarray(bad)
+    per_hist = batch.V << batch.W
+    chunk = max(1, MAX_FRONTIER_ELEMENTS // per_hist)
+    valids, bads = [], []
+    for lo in range(0, batch.batch, chunk):
+        hi = min(lo + chunk, batch.batch)
+        valid, bad = kern(batch.ev_type[lo:hi], batch.ev_slot[lo:hi],
+                          batch.ev_slots[lo:hi], batch.target[lo:hi])
+        valids.append(np.asarray(valid))
+        bads.append(np.asarray(bad))
+    return np.concatenate(valids), np.concatenate(bads)
 
 
 def _result_for(row: int, batch: EncodedBatch, valid: np.ndarray,
@@ -162,7 +169,7 @@ def _result_for(row: int, batch: EncodedBatch, valid: np.ndarray,
 
 
 def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
-                    max_states: int = 64, max_slots: int = 24,
+                    max_states: int = 64, max_slots: int = 16,
                     host_fallback=None) -> List[dict]:
     """Check many raw histories on device; per-history result dicts.
 
@@ -178,17 +185,18 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
         if any(op.index is None for op in h):
             index_history(h)
     prepared = [prepare_history(h) for h in histories]
-    batch = batch_encode(model, prepared,
-                         max_states=max_states, max_slots=max_slots)
-    valid, bad = run_encoded_batch(batch)
+    buckets = bucket_encode(model, prepared,
+                            max_states=max_states, max_slots=max_slots)
 
     results: List[Optional[dict]] = [None] * len(histories)
-    for row, i in enumerate(batch.indices):
-        results[i] = _result_for(row, batch, valid, bad, prepared[i])
-    for i, reason in batch.failures:
-        r = host_fallback(model, histories[i])
-        r.setdefault("fallback", reason)
-        results[i] = r
+    for batch in buckets:
+        valid, bad = run_encoded_batch(batch)
+        for row, i in enumerate(batch.indices):
+            results[i] = _result_for(row, batch, valid, bad, prepared[i])
+        for i, reason in batch.failures:
+            r = host_fallback(model, histories[i])
+            r.setdefault("fallback", reason)
+            results[i] = r
     return results
 
 

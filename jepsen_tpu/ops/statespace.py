@@ -59,6 +59,7 @@ class StateSpace:
     kinds: List[Tuple]
     kind_index: Dict[Tuple, int]
     target: np.ndarray
+    _identity_kinds: Optional[frozenset] = None
 
     @property
     def n_states(self) -> int:
@@ -67,6 +68,20 @@ class StateSpace:
     @property
     def n_kinds(self) -> int:
         return len(self.kinds)
+
+    @property
+    def identity_kinds(self) -> frozenset:
+        """Kind indices whose transition is the total identity — valid
+        from every state and state-preserving (they constrain nothing).
+        Cached: one batch shares a StateSpace across thousands of
+        histories."""
+        if self._identity_kinds is None:
+            V = self.target.shape[1]
+            ident = np.arange(V, dtype=np.int32)
+            self._identity_kinds = frozenset(
+                k for k in range(self.n_kinds)
+                if np.array_equal(self.target[k], ident))
+        return self._identity_kinds
 
     def padded_target(self, v_pad: int, k_pad: int) -> np.ndarray:
         """Target table padded to [k_pad + 1, v_pad]; the final row is the

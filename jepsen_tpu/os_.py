@@ -1,0 +1,22 @@
+"""OS protocol: prepare a node's operating system for a test.
+
+Mirrors jepsen/src/jepsen/os.clj:4-14. Concrete implementations (debian,
+container) live in jepsen_tpu.os_impl and use the control layer.
+"""
+from __future__ import annotations
+
+
+class OS:
+    def setup(self, test: dict, node) -> None:
+        pass
+
+    def teardown(self, test: dict, node) -> None:
+        pass
+
+
+class NoopOS(OS):
+    """Does nothing to the underlying OS."""
+
+
+def noop_os() -> OS:
+    return NoopOS()

@@ -1,0 +1,19 @@
+"""Device-mesh parallelism for the batched checker.
+
+Two orthogonal axes (SURVEY.md §2.3 "TPU mapping"):
+
+  data     — histories-per-batch: embarrassingly parallel; shard the batch
+             axis of the encoded tensors over the mesh and let XLA insert
+             the verdict all-reduce.
+  frontier — within one history, the WGL configuration frontier's mask
+             axis (2^W pending subsets) splits across devices — the
+             sequence-parallel analog for this domain. Pending-op applies
+             on device-local mask bits stay local; applies/completions on
+             the top log2(D) bits become hypercube ppermute exchanges.
+
+The reference has no device parallelism at all — its analogs are JVM
+thread pools and pmap'd checkers (jepsen/src/jepsen/checker.clj:384-386,
+jepsen/src/jepsen/util.clj:44-50); the mesh design subsumes them.
+"""
+from .mesh import checker_mesh, data_sharded_kernel
+from .frontier import frontier_sharded_kernel

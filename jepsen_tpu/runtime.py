@@ -1,0 +1,319 @@
+"""Core test runtime: the orchestrator that runs a test map end to end.
+
+The lifecycle mirrors jepsen/src/jepsen/core.clj `run!` (329-436):
+
+  1. fill defaults (concurrency, barrier, clock)
+  2. OS setup on all nodes           (with-os, core.clj:75-82)
+  3. DB cycle + primary setup        (with-db, core.clj:125-139)
+  4. zero the relative-time clock    (core.clj:415)
+  5. run the case: client per worker, nemesis thread, worker loop
+                                     (run-case!, core.clj:275-313)
+  6. snarf node logs                 (core.clj:92-123)
+  7. teardown DB, OS
+  8. persist history; run checker; persist results
+
+A *test is a plain dict* wiring protocol implementations together —
+nodes, client, nemesis, generator, model, checker, os, db — exactly the
+reference's test-as-config stance (core.clj:330-350).
+
+Workers are threads (the reference uses JVM futures): each runs one
+logically-singlethreaded *process*; an indeterminate op (client exception
+or info completion) retires the process id, and `process + concurrency`
+takes over the thread (core.clj:185-205) — the thread id is
+`process % concurrency` throughout.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+from . import gen as generator
+from .checkers.core import check_safe
+from .client import Client
+from .history.core import History
+from .history.ops import Op, INVOKE, OK, FAIL, INFO, NEMESIS
+from .utils.core import Relatime
+
+log = logging.getLogger("jepsen.runtime")
+
+COMPLETION_TYPES = (OK, FAIL, INFO)
+
+
+def synchronize(test: dict) -> None:
+    """Block until all nodes arrive (core.clj:34-39). Used by DB/OS
+    implementations that need cluster-wide phases during setup."""
+    b = test.get("barrier")
+    if b is not None:
+        b.wait()
+
+
+def conj_op(test: dict, op: Op) -> Op:
+    """Append an op to the test's history (core.clj:41-45)."""
+    return test["history"].append(op)
+
+
+def primary(test: dict):
+    """The primary node — by convention the first (core.clj:47-50)."""
+    nodes = test.get("nodes") or []
+    return nodes[0] if nodes else None
+
+
+def _op_from_dict(d: dict, process, time: int) -> Op:
+    return Op(process=process, type=d.get("type", INVOKE), f=d.get("f"),
+              value=d.get("value"), time=time,
+              extra={k: v for k, v in d.items()
+                     if k not in ("type", "f", "value", "process", "time")}
+              or None)
+
+
+def worker(test: dict, process: int, client: Client,
+           ctx: generator.Context) -> None:
+    """One worker's op loop (core.clj:141-206)."""
+    gen = test["generator"]
+    clock: Relatime = test["clock"]
+    while True:
+        d = generator.op(gen, test, process, ctx)
+        if d is None:
+            break
+        if not isinstance(d, dict):
+            raise TypeError(f"expected an op dict, got {d!r}")
+        inv = _op_from_dict(d, process, clock.nanos())
+        conj_op(test, inv)
+        try:
+            completion = client.invoke(test, {**d, "process": process})
+            assert isinstance(completion, dict) and \
+                completion.get("type") in COMPLETION_TYPES, \
+                f"invoke must return type ok/fail/info, got {completion!r}"
+            assert completion.get("f") == inv.f, \
+                f"completion f {completion.get('f')!r} != invoke {inv.f!r}"
+            comp = _op_from_dict(completion, process, clock.nanos())
+            conj_op(test, comp)
+            if comp.type in (OK, FAIL):
+                continue              # process free for another op
+            process += test["concurrency"]  # hung: retire the process id
+        except Exception as e:
+            # All bets are off: the op may or may not have taken effect.
+            # Leave the invocation uncompleted-but-info in the history and
+            # cycle to a new process id (core.clj:185-205).
+            conj_op(test, inv.with_(type=INFO, time=clock.nanos(),
+                                    error=f"indeterminate: {e}"))
+            log.warning("process %s indeterminate: %s", process,
+                        traceback.format_exc())
+            process += test["concurrency"]
+
+
+def nemesis_worker(test: dict, nemesis: Client,
+                   ctx: generator.Context) -> None:
+    """The nemesis op loop: draws fault ops and applies them, writing
+    into every active history (core.clj:208-253)."""
+    gen = test["generator"]
+    clock: Relatime = test["clock"]
+    histories = test["active_histories"]
+    while True:
+        d = generator.op(gen, test, NEMESIS, ctx)
+        if d is None:
+            break
+        assert isinstance(d, dict), f"expected an op dict, got {d!r}"
+        inv = _op_from_dict(d, NEMESIS, clock.nanos())
+        assert inv.type == INFO, "nemesis ops must have type info"
+        for h in tuple(histories):
+            h.append(inv)
+        try:
+            completion = nemesis.invoke(test, {**d, "process": NEMESIS})
+            comp = _op_from_dict(completion, NEMESIS, clock.nanos())
+            assert comp.f == inv.f
+            for h in tuple(histories):
+                h.append(comp)
+        except Exception as e:
+            for h in tuple(histories):
+                h.append(inv.with_(time=clock.nanos(),
+                                   error=f"crashed: {e}"))
+            log.warning("nemesis crashed evaluating %s: %s", d,
+                        traceback.format_exc())
+
+
+def _parallel(fns: List[Callable]) -> list:
+    """Run thunks in parallel, collecting results/exceptions
+    (with-resources discipline, core.clj:52-73)."""
+    if not fns:
+        return []
+    with ThreadPoolExecutor(max_workers=len(fns)) as ex:
+        futs = [ex.submit(f) for f in fns]
+        out = []
+        for f in futs:
+            try:
+                out.append(f.result())
+            except Exception as e:
+                out.append(e)
+        return out
+
+
+def _setup_clients(test: dict) -> List[Client]:
+    """One client per worker, node-striped (core.clj:286-296)."""
+    nodes = test.get("nodes") or []
+    c = test["concurrency"]
+    targets = [nodes[i % len(nodes)] if nodes else None for i in range(c)]
+    proto: Client = test["client"]
+    clients = _parallel([lambda n=n: proto.setup(test, n) for n in targets])
+    errs = [e for e in clients if isinstance(e, Exception)]
+    if errs:
+        _parallel([lambda cl=cl: cl.teardown(test)
+                   for cl in clients if not isinstance(cl, Exception)])
+        raise errs[0]
+    return clients
+
+
+def run_case(test: dict) -> List[Op]:
+    """Spawn nemesis + workers, run one case, return its history
+    (run-case!, core.clj:275-313)."""
+    history = History()
+    test = {**test, "history": history}
+    test["active_histories"].add(history)
+
+    nemesis: Optional[Client] = test.get("nemesis") or None
+    # The nemesis thread id is in generator scope only when a nemesis
+    # thread actually polls the generator — otherwise barrier combinators
+    # (phases/synchronize) would size their barrier for a thread that
+    # never arrives and deadlock the run.
+    threads_in_scope = tuple(range(test["concurrency"]))
+    if nemesis is not None:
+        threads_in_scope += (NEMESIS,)
+    ctx = generator.Context(
+        threads=threads_in_scope,
+        concurrency=test["concurrency"],
+        rng=test["rng"],
+        time_nanos=test["clock"].nanos)
+
+    # Worker/nemesis threads record crashes here; a crashed thread is a
+    # harness bug and must fail the run, not truncate the history
+    # (the reference's futures rethrow on deref, core.clj:300-305).
+    crashes: List[BaseException] = []
+
+    def guarded(f, *args, name=""):
+        try:
+            f(*args)
+        except BaseException as e:  # noqa: BLE001 — rethrown below
+            log.error("%s crashed: %s", name, traceback.format_exc())
+            crashes.append(e)
+
+    clients = _setup_clients(test)
+    try:
+        nem_client = nemesis.setup(test, None) if nemesis else None
+        try:
+            threads = []
+            if nem_client is not None:
+                t = threading.Thread(
+                    target=guarded,
+                    args=(nemesis_worker, test, nem_client, ctx),
+                    kwargs={"name": "nemesis"},
+                    name="jepsen nemesis")
+                t.start()
+                threads.append(t)
+            workers = []
+            for i, cl in enumerate(clients):
+                t = threading.Thread(
+                    target=guarded, args=(worker, test, i, cl, ctx),
+                    kwargs={"name": f"worker {i}"},
+                    name=f"jepsen worker {i}")
+                t.start()
+                workers.append(t)
+            for t in workers:
+                t.join()
+            for t in threads:
+                t.join()
+        finally:
+            if nem_client is not None:
+                nem_client.teardown(test)
+    finally:
+        _parallel([lambda cl=cl: cl.teardown(test) for cl in clients])
+    if crashes:
+        raise crashes[0]
+
+    snarf_logs(test)
+    test["active_histories"].discard(history)
+    return history.ops()
+
+
+def snarf_logs(test: dict) -> None:
+    """Download db log files from every node (core.clj:92-123)."""
+    db = test.get("db")
+    store = test.get("store_handle")
+    if db is None or store is None or not hasattr(db, "log_files"):
+        return
+    from .control.core import on_nodes, download
+
+    def snarf(t, node):
+        for remote in db.log_files(t, node) or []:
+            local = store.path(str(node), remote.lstrip("/"))
+            try:
+                download(t, node, remote, local)
+            except Exception as e:
+                log.info("couldn't download %s from %s: %s", remote, node, e)
+
+    try:
+        on_nodes(test, snarf)
+    except Exception:
+        log.warning("log snarfing failed: %s", traceback.format_exc())
+
+
+def _on_nodes_local(test: dict, f: Callable) -> None:
+    """Apply f(test, node) to every node in parallel."""
+    nodes = test.get("nodes") or []
+    errs = [e for e in _parallel([lambda n=n: f(test, n) for n in nodes])
+            if isinstance(e, Exception)]
+    if errs:
+        raise errs[0]
+
+
+def run(test: dict) -> dict:
+    """Run a complete test; returns the test dict with :history and
+    :results filled in (core.clj:329-436)."""
+    test = dict(test)
+    nodes = test.get("nodes") or []
+    test.setdefault("concurrency", max(1, len(nodes)))
+    test.setdefault("rng", __import__("random").Random(test.get("seed")))
+    test["barrier"] = threading.Barrier(len(nodes)) if nodes else None
+    test["active_histories"] = set()
+
+    store = test.get("store_handle")
+    os_ = test.get("os")
+    db = test.get("db")
+
+    from contextlib import ExitStack
+    with ExitStack() as stack:
+        if test.get("ssh") is not None:
+            from .control.core import with_ssh
+            stack.enter_context(with_ssh(test))
+        try:
+            if os_ is not None:
+                _on_nodes_local(test, os_.setup)
+            try:
+                if db is not None:
+                    _on_nodes_local(test, db.cycle)
+                    if hasattr(db, "setup_primary") and nodes:
+                        db.setup_primary(test, primary(test))
+                test["clock"] = Relatime()
+                history = run_case(test)
+                test["history"] = history
+                if store is not None:
+                    store.save_history(history)
+            except BaseException:
+                snarf_logs(test)  # emergency log dump (core.clj:133-137)
+                raise
+            finally:
+                if db is not None:
+                    _on_nodes_local(test, db.teardown)
+        finally:
+            if os_ is not None:
+                _on_nodes_local(test, os_.teardown)
+
+    test["results"] = check_safe(test.get("checker"), test,
+                                 test.get("model"), test["history"])
+    if store is not None:
+        store.save_results(test["results"])
+    valid = test["results"].get("valid")
+    log.info("Analysis complete: valid? = %s", valid)
+    return test

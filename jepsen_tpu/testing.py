@@ -1,0 +1,145 @@
+"""Test scaffolding: no-op test maps and in-process fakes.
+
+Mirrors jepsen/src/jepsen/tests.clj — `noop_test` is the base test map
+every suite merges over (tests.clj:12-25), and the atom DB/client pair
+implements a CAS register on an in-process variable so a complete
+linearizability-checked test runs with zero SSH and zero real database
+(tests.clj:27-56; exercised by core_test.clj:17-28). This is the seam the
+TPU CI reuses: fake cluster → real histories → device checker.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from . import gen
+from .checkers.core import unbridled_optimism
+from .client import Client
+from .db import NoopDB
+from .os_ import NoopOS
+
+
+def noop_test(**overrides) -> dict:
+    """A test map with everything stubbed (tests.clj:12-25)."""
+    test = {
+        "name": "noop",
+        "nodes": [],
+        "concurrency": 1,
+        "os": NoopOS(),
+        "db": NoopDB(),
+        "client": NoopClientForTest(),
+        "nemesis": None,
+        "generator": None,   # exhausts immediately
+        "checker": unbridled_optimism(),
+        "model": None,
+    }
+    test.update(overrides)
+    return test
+
+
+class NoopClientForTest(Client):
+    def invoke(self, test, op):
+        return {**op, "type": "ok"}
+
+
+class AtomRegister:
+    """The shared in-process register (the reference's `atom-db`,
+    tests.clj:27-32): a value plus a lock giving atomic read/write/cas."""
+
+    def __init__(self, value: Any = None):
+        self.value = value
+        self._lock = threading.Lock()
+
+    def read(self):
+        with self._lock:
+            return self.value
+
+    def write(self, v):
+        with self._lock:
+            self.value = v
+
+    def cas(self, old, new) -> bool:
+        with self._lock:
+            if self.value == old:
+                self.value = new
+                return True
+            return False
+
+    def reset(self):
+        with self._lock:
+            self.value = None
+
+
+class AtomClient(Client):
+    """CAS-register client over an AtomRegister (tests.clj:34-56)."""
+
+    def __init__(self, register: Optional[AtomRegister] = None):
+        self.register = register if register is not None else AtomRegister()
+
+    def setup(self, test, node):
+        return AtomClient(self.register)
+
+    def invoke(self, test, op):
+        f = op["f"]
+        if f == "read":
+            return {**op, "type": "ok", "value": self.register.read()}
+        if f == "write":
+            self.register.write(op["value"])
+            return {**op, "type": "ok"}
+        if f == "cas":
+            old, new = op["value"]
+            ok = self.register.cas(old, new)
+            return {**op, "type": "ok" if ok else "fail"}
+        raise ValueError(f"unknown op {f!r}")
+
+
+class FlakyAtomClient(AtomClient):
+    """AtomClient that crashes (raises) on a fraction of ops — exercises
+    the worker's indeterminate-process-retirement path
+    (core_test.clj:86-101 worker-recovery-test)."""
+
+    def __init__(self, register=None, crash_every: int = 7):
+        super().__init__(register)
+        self.crash_every = crash_every
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def setup(self, test, node):
+        c = FlakyAtomClient(self.register, self.crash_every)
+        c._lock = self._lock
+        return c
+
+    def invoke(self, test, op):
+        with self._lock:
+            self._n += 1
+            n = self._n
+        if n % self.crash_every == 0:
+            # Apply the op *sometimes* before crashing: truly indeterminate.
+            if n % (2 * self.crash_every) == 0 and op["f"] == "write":
+                self.register.write(op["value"])
+            raise RuntimeError("simulated client crash")
+        return super().invoke(test, op)
+
+
+def atom_cas_test(*, time_limit: Optional[float] = None, n_ops: int = 200,
+                  concurrency: int = 5, seed: int = 0,
+                  client: Optional[Client] = None, **overrides) -> dict:
+    """A complete in-process CAS-register test (core_test.clj:17-28):
+    atom client + seeded cas generator + linearizability checking."""
+    from .checkers.linearizable import linearizable
+    from .models.core import cas_register
+
+    g = gen.limit(n_ops, gen.cas_gen())
+    if time_limit is not None:
+        g = gen.time_limit(time_limit, g)
+    test = noop_test(
+        name="atom-cas",
+        concurrency=concurrency,
+        seed=seed,
+        client=client if client is not None else AtomClient(),
+        generator=gen.clients(g),
+        checker=linearizable(),
+        model=cas_register(),
+    )
+    test.update(overrides)
+    return test

@@ -1,0 +1,1 @@
+"""Workload definitions and synthetic history generation."""

@@ -1,0 +1,256 @@
+"""Generator DSL semantics, mirroring the reference's generator_test.clj
+fixtures: drain a generator with real threads per simulated process and
+assert the resulting op sequences."""
+import threading
+import time
+from random import Random
+
+import pytest
+
+import jepsen_tpu.gen as g
+
+
+def ctx(threads=(0, 1), concurrency=None, seed=7, time_nanos=None):
+    return g.Context(threads=tuple(threads),
+                     concurrency=concurrency or
+                     len([t for t in threads if isinstance(t, int)]),
+                     rng=Random(seed),
+                     time_nanos=time_nanos or time.monotonic_ns)
+
+
+def drain_single(gen, process=0, c=None, test=None, cap=10_000):
+    """All ops a single process sees until exhaustion."""
+    c = c or ctx(threads=(0,), concurrency=1)
+    out = []
+    for _ in range(cap):
+        o = g.op(gen, test or {}, process, c)
+        if o is None:
+            return out
+        out.append(o)
+    raise AssertionError("generator did not terminate")
+
+
+def drain_threads(gen, threads, test=None, cap=1000):
+    """Drain with one real thread per simulated thread id (the reference's
+    `ops` fixture, generator_test.clj:10-25). Returns {thread: [ops]}."""
+    c = ctx(threads=threads)
+    results = {t: [] for t in threads}
+    errors = []
+
+    def worker(t):
+        # Client thread ids double as process ids; nemesis is itself.
+        try:
+            for _ in range(cap):
+                o = g.op(gen, test or {}, t, c)
+                if o is None:
+                    return
+                results[t].append(o)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in threads]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errors, errors
+    return results
+
+
+# ------------------------------------------------------------- basics
+
+def test_dict_yields_itself_forever():
+    c = ctx()
+    o1 = g.op({"f": "read"}, {}, 0, c)
+    o2 = g.op({"f": "read"}, {}, 0, c)
+    assert o1 == {"f": "read"} and o2 == {"f": "read"}
+    assert o1 is not o2  # fresh dict per op
+
+
+def test_none_is_void():
+    assert g.op(None, {}, 0, ctx()) is None
+    assert g.op(g.void(), {}, 0, ctx()) is None
+
+
+def test_once():
+    gen = g.once({"f": "w"})
+    assert drain_single(gen) == [{"f": "w"}]
+
+
+def test_limit():
+    gen = g.limit(3, {"f": "r"})
+    assert drain_single(gen) == [{"f": "r"}] * 3
+
+
+def test_seq_each_element_once():
+    gen = g.seq([{"f": "a"}, {"f": "b"}, {"f": "c"}])
+    assert [o["f"] for o in drain_single(gen)] == ["a", "b", "c"]
+
+
+def test_seq_skips_exhausted_generators():
+    gen = g.seq([g.void(), {"f": "a"}, g.limit(2, {"f": "b"})])
+    assert [o["f"] for o in drain_single(gen)] == ["a", "b", "b"]
+
+
+def test_concat():
+    gen = g.concat(g.limit(2, {"f": "a"}), g.limit(1, {"f": "b"}))
+    assert [o["f"] for o in drain_single(gen)] == ["a", "a", "b"]
+
+
+def test_mix_is_seeded():
+    gen = g.mix([{"f": "a"}, {"f": "b"}])
+    fs = [o["f"] for o in drain_single(g.limit(20, gen))]
+    fs2 = [o["f"] for o in drain_single(g.limit(20, g.mix([{"f": "a"},
+                                                           {"f": "b"}])))]
+    assert fs == fs2  # same seed, same draw sequence
+    assert set(fs) == {"a", "b"}
+
+
+def test_each_per_process():
+    gen = g.each(lambda: g.limit(1, {"f": "x"}))
+    c = ctx(threads=(0, 1), concurrency=2)
+    assert g.op(gen, {}, 0, c) == {"f": "x"}
+    assert g.op(gen, {}, 1, c) == {"f": "x"}   # own copy
+    assert g.op(gen, {}, 0, c) is None         # 0's copy exhausted
+
+
+def test_filter():
+    gen = g.filter_gen(lambda o: o["f"] == "a",
+                       g.seq([{"f": "a"}, {"f": "b"}, {"f": "a"}]))
+    assert [o["f"] for o in drain_single(gen)] == ["a", "a"]
+
+
+def test_time_limit():
+    t = {"now": 0}
+    c = ctx(time_nanos=lambda: t["now"])
+    gen = g.time_limit(1.0, {"f": "r"})
+    assert g.op(gen, {}, 0, c) == {"f": "r"}
+    t["now"] = int(0.5e9)
+    assert g.op(gen, {}, 0, c) == {"f": "r"}
+    t["now"] = int(1.5e9)
+    assert g.op(gen, {}, 0, c) is None
+
+
+# ------------------------------------------------------ queue streams
+
+def test_queue_gen_and_drain():
+    gen = g.drain_queue(g.limit(10, g.queue_gen()))
+    ops = drain_single(gen, cap=100)
+    enq = [o for o in ops if o["f"] == "enqueue"]
+    deq = [o for o in ops if o["f"] == "dequeue"]
+    assert len(ops) >= 10
+    assert len(deq) >= len(enq)  # every enqueue eventually drained
+    assert [o["value"] for o in enq] == list(range(len(enq)))
+
+
+def test_cas_gen_shapes():
+    ops = drain_single(g.limit(50, g.cas_gen()), cap=100)
+    for o in ops:
+        assert o["type"] == "invoke"
+        if o["f"] == "cas":
+            assert len(o["value"]) == 2
+        elif o["f"] == "read":
+            assert o["value"] is None
+
+
+# ------------------------------------------------- thread routing
+
+def test_nemesis_routing():
+    gen = g.nemesis(g.limit(2, {"f": "partition"}),
+                    g.limit(2, {"f": "read"}))
+    res = drain_threads(gen, threads=(0, 1, g.NEMESIS))
+    assert [o["f"] for o in res[g.NEMESIS]] == ["partition", "partition"]
+    client_fs = [o["f"] for t in (0, 1) for o in res[t]]
+    assert client_fs.count("read") == 2
+    assert all(f == "read" for f in client_fs)
+
+
+def test_on_narrows_threads():
+    seen = {}
+
+    def probe(test, process, c):
+        seen[process] = c.threads
+        return None
+
+    gen = g.on(lambda t: t != g.NEMESIS, g._Fn(probe))
+    c = ctx(threads=(0, 1, g.NEMESIS))
+    g.op(gen, {}, 0, c)
+    assert seen[0] == (0, 1)
+    assert g.op(gen, {}, g.NEMESIS, c) is None
+
+
+def test_reserve_partitions_thread_ranges():
+    seen = {}
+
+    def mk(tag):
+        def probe(test, process, c):
+            seen[process] = (tag, c.threads)
+            return {"f": tag}
+        return g._Fn(probe)
+
+    gen = g.reserve(2, mk("w"), 1, mk("c"), mk("r"))
+    c = ctx(threads=(0, 1, 2, 3, 4), concurrency=5)
+    for p in range(5):
+        g.op(gen, {}, p, c)
+    assert seen[0] == ("w", (0, 1))
+    assert seen[1] == ("w", (0, 1))
+    assert seen[2] == ("c", (2,))
+    assert seen[3] == ("r", (3, 4))
+    assert seen[4] == ("r", (3, 4))
+
+
+def test_process_to_thread_wraps():
+    # crashed processes retire: process + concurrency maps to same thread
+    c = ctx(threads=(0, 1), concurrency=2)
+    assert c.thread_of(0) == 0
+    assert c.thread_of(3) == 1
+    assert c.thread_of(g.NEMESIS) == g.NEMESIS
+
+
+# ------------------------------------------------------ barriers
+
+def test_phases_synchronize_threads():
+    order = []
+    lock = threading.Lock()
+
+    def tag(name):
+        def probe(test, process, c):
+            with lock:
+                order.append((name, process))
+            return {"f": name}
+        return g.limit(2, g._Fn(probe))
+
+    gen = g.phases(tag("p1"), tag("p2"))
+    res = drain_threads(gen, threads=(0, 1))
+    # every p1 op happens before every p2 op
+    names = [n for n, _ in order]
+    assert names.index("p2") > len([n for n in names if n == "p1"]) - 1
+    p1 = [n for n in names if n == "p1"]
+    assert names[:len(p1)] == p1
+
+
+def test_then_runs_b_then_a():
+    gen = g.then(g.limit(1, {"f": "after"}), g.limit(2, {"f": "before"}))
+    res = drain_threads(gen, threads=(0,))
+    fs = [o["f"] for o in res[0]]
+    assert fs[:2] == ["before", "before"]
+    assert "after" in fs
+
+
+def test_stagger_and_delay_sleep(monkeypatch):
+    gen = g.delay(0.01, g.limit(2, {"f": "r"}))
+    t0 = time.monotonic()
+    drain_single(gen)
+    assert time.monotonic() - t0 >= 0.02
+
+
+def test_delay_til_aligns():
+    ticks = []
+    gen = g.delay_til(0.02, g.limit(4, {"f": "r"}), precache=False)
+    c = ctx(threads=(0,), concurrency=1)
+    for _ in range(4):
+        g.op(gen, {}, 0, c)
+        ticks.append(time.monotonic_ns())
+    gaps = [(b - a) / 1e9 for a, b in zip(ticks, ticks[1:])]
+    for gap in gaps:
+        assert 0.014 <= gap <= 0.2  # aligned to ~20ms grid

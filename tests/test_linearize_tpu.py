@@ -17,7 +17,7 @@ from jepsen_tpu.checkers.linearizable import prepare_history, wgl_check
 from jepsen_tpu.ops.statespace import (enumerate_statespace, history_kinds,
                                        StateSpaceExplosion)
 from jepsen_tpu.ops.encode import (encode_history, EncodeFailure,
-                                   batch_encode, EV_INVOKE, EV_OK)
+                                   batch_encode, EMPTY)
 from jepsen_tpu.ops.linearize import check_batch_tpu, check_one_tpu
 
 
@@ -66,10 +66,16 @@ def test_encode_slot_assignment():
                ok_op(2, "write", 3)])
     e = encode_history(cas_register(), prepare_history(h))
     assert not isinstance(e, EncodeFailure)
-    assert list(e.ev_type) == [EV_INVOKE, EV_INVOKE, EV_OK,
-                               EV_INVOKE, EV_OK, EV_OK]
-    assert list(e.ev_slot) == [0, 1, 0, 0, 1, 0]
+    # one device event per ok completion, completing slots 0, 1, 0
+    assert list(e.ev_slot) == [0, 1, 0]
     assert e.max_live == 2
+    k_w1 = e.space.kind_index[("write", 1)]
+    k_w2 = e.space.kind_index[("write", 2)]
+    k_w3 = e.space.kind_index[("write", 3)]
+    # snapshots carry the pending table WITH the completing op present
+    assert list(e.ev_slots[0]) == [k_w1, k_w2]
+    assert list(e.ev_slots[1]) == [k_w3, k_w2]
+    assert list(e.ev_slots[2]) == [k_w3, EMPTY]
 
 
 def test_encode_info_pins_slot():
@@ -78,10 +84,24 @@ def test_encode_info_pins_slot():
                invoke_op(1, "write", 2),                 # slot 1
                ok_op(1, "write", 2)])
     e = encode_history(cas_register(), prepare_history(h))
-    # info emits no device event; its slot stays occupied
-    assert list(e.ev_type) == [EV_INVOKE, EV_INVOKE, EV_OK]
-    assert list(e.ev_slot) == [0, 1, 1]
+    # the timed-out write still occupies slot 0 at the ok snapshot
+    assert list(e.ev_slot) == [1]
+    k_w1 = e.space.kind_index[("write", 1)]
+    k_w2 = e.space.kind_index[("write", 2)]
+    assert list(e.ev_slots[0]) == [k_w1, k_w2]
     assert e.max_live == 2
+
+
+def test_encode_drops_identity_info_ops():
+    # A timed-out read observed nothing: total identity transition,
+    # never completes — must not pin a pending slot.
+    h = index([invoke_op(0, "read", None),
+               info_op(0, "read", None, error="timeout"),
+               invoke_op(1, "write", 2),
+               ok_op(1, "write", 2)])
+    e = encode_history(cas_register(), prepare_history(h))
+    assert e.max_live == 1
+    assert list(e.ev_slot) == [0]
 
 
 def test_encode_window_overflow():
@@ -176,70 +196,10 @@ def test_statespace_fallback_to_host():
 
 # ------------------------------------------------- randomized parity sweep
 
-def random_history(rng, n_procs=4, n_ops=18, n_values=3, corrupt=0.2,
-                   p_info=0.12):
-    """Simulate a real linearizable register then maybe corrupt a read."""
-    reg = None
-    h = []
-    live = {}
-    free = list(range(n_procs))
-    done = 0
-    while done < n_ops or live:
-        if free and done < n_ops and (not live or rng.random() < 0.6):
-            p = free.pop(rng.randrange(len(free)))
-            f = rng.choice(["read", "write", "cas"])
-            if f == "read":
-                h.append(invoke_op(p, "read", None))
-                live[p] = ("read", None)
-            elif f == "write":
-                v = rng.randrange(n_values)
-                h.append(invoke_op(p, "write", v))
-                live[p] = ("write", v)
-            else:
-                v = [rng.randrange(n_values), rng.randrange(n_values)]
-                h.append(invoke_op(p, "cas", v))
-                live[p] = ("cas", v)
-            done += 1
-        else:
-            p = rng.choice(list(live.keys()))
-            f, v = live.pop(p)
-            r = rng.random()
-            if f == "read":
-                if r < p_info:
-                    h.append(info_op(p, "read", None, error="timeout"))
-                else:
-                    h.append(ok_op(p, "read", reg))
-            elif f == "write":
-                if r < p_info:
-                    if rng.random() < 0.5:
-                        reg = v
-                    h.append(info_op(p, "write", v, error="timeout"))
-                else:
-                    reg = v
-                    h.append(ok_op(p, "write", v))
-            else:
-                if r < p_info:
-                    if rng.random() < 0.5 and reg == v[0]:
-                        reg = v[1]
-                    h.append(info_op(p, "cas", v, error="timeout"))
-                elif reg == v[0]:
-                    reg = v[1]
-                    h.append(ok_op(p, "cas", v))
-                else:
-                    h.append(fail_op(p, "cas", v, error="mismatch"))
-            free.append(p)
-    if rng.random() < corrupt:
-        reads = [i for i, op in enumerate(h)
-                 if op.type == "ok" and op.f == "read"]
-        if reads:
-            i = rng.choice(reads)
-            h[i].value = (h[i].value or 0) + rng.randrange(1, n_values)
-    return index(h)
-
-
 def test_random_parity_sweep():
-    rng = random.Random(7)
-    hists = [random_history(rng) for _ in range(60)]
+    from jepsen_tpu.workloads.synth import synth_cas_batch
+    hists = synth_cas_batch(60, seed0=7, n_procs=4, n_ops=18, n_values=3,
+                            corrupt=0.2, p_info=0.12)
     host = check_parity(cas_register(), hists)
     # make sure the sweep exercises both verdicts
     verdicts = {r["valid"] for r in host}
