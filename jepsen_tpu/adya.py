@@ -1,0 +1,67 @@
+"""Workloads/checkers for Adya's proscribed weak-consistency anomalies.
+
+Mirrors jepsen/src/jepsen/adya.clj: the G2 anti-dependency-cycle test
+emits, per unique key, a *pair* of concurrent inserts — each transaction
+first reads both tables for the key (predicate read) and inserts only if
+both are empty. Under serializability at most one of the pair can
+commit; two commits for one key witness a G2 anomaly.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from . import gen as g
+from . import independent
+from .checkers.core import Checker
+from .history.ops import OK
+
+
+def g2_gen() -> g.Generator:
+    """Pairs of :insert ops [a_id, None] / [None, b_id] per unique key,
+    two threads per key (adya.clj:13-55)."""
+    counter = itertools.count(1)
+    lock = threading.Lock()
+
+    def next_id() -> int:
+        with lock:
+            return next(counter)
+
+    def fgen(k):
+        # Each element must emit exactly one insert then retire (a bare
+        # callable in a seq is polled until IT returns None, forever).
+        return g.seq([
+            g.once(lambda: {"type": "invoke", "f": "insert",
+                            "value": [None, next_id()]}),
+            g.once(lambda: {"type": "invoke", "f": "insert",
+                            "value": [next_id(), None]}),
+        ])
+
+    return independent.concurrent_generator(2, itertools.count(1), fgen)
+
+
+class G2Checker(Checker):
+    """At most one insert may succeed per key (adya.clj:57-83)."""
+
+    def check(self, test, model, history, opts=None) -> dict:
+        keys: dict = {}
+        for op in history:
+            if op.f == "insert" and isinstance(op.value, independent.KV):
+                k = op.value.key
+                if op.type == OK:
+                    keys[k] = keys.get(k, 0) + 1
+                else:
+                    keys.setdefault(k, 0)
+        insert_count = sum(1 for c in keys.values() if c > 0)
+        illegal = {k: c for k, c in sorted(keys.items()) if c > 1}
+        return {
+            "valid": not illegal,
+            "key-count": len(keys),
+            "legal-count": insert_count - len(illegal),
+            "illegal-count": len(illegal),
+            "illegal": illegal,
+        }
+
+
+def g2_checker() -> Checker:
+    return G2Checker()

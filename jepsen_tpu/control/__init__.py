@@ -1,0 +1,10 @@
+"""Remote-control layer: SSH exec, install helpers, net utilities.
+
+The communication backend of the harness (SURVEY.md §2.4): a persistent
+multiplexed OpenSSH transport per node with retry/reconnect discipline,
+plus a dummy transport that stubs it all out for no-cluster runs.
+"""
+from .core import (DEFAULT_SSH, DummyTransport, Literal, RemoteError,
+                   Session, SSHTransport, cd, download, escape, exec_,
+                   exec_star, lit, on, on_many, on_nodes, session, su, sudo,
+                   trace, upload, upload_bytes, with_session, with_ssh)

@@ -1,0 +1,438 @@
+"""Remote control: run commands on db nodes over SSH.
+
+Mirrors jepsen/src/jepsen/control.clj. The reference keeps a persistent
+jsch session per node wrapped in an auto-reconnect layer
+(control.clj:270-286, reconnect.clj); here the transport is the OpenSSH
+client with a ControlMaster multiplexing socket per node — the master
+holds the persistent connection, each exec is a cheap mux client, and a
+dropped master is re-established transparently by the next call, which
+is the same reconnect discipline with the state pushed into ssh(1).
+Transient transport failures (exit 255) are retried with jittered
+backoff (control.clj:140-160).
+
+The reference binds per-thread dynamic vars for host/session/dir/sudo
+(control.clj:15-26); workers here carry the same state in a
+``threading.local`` stack, so the API reads the same way:
+
+    with control.with_session(node, session):
+        with control.su():
+            control.exec_("apt-get", "install", "-y", "etcd")
+
+``dummy`` mode (control.clj:15,274-277) stubs the transport: commands
+are recorded and acknowledged without any SSH, letting every layer above
+— os/db setup, nemesis, full test orchestration — run anywhere.
+"""
+from __future__ import annotations
+
+import os
+import random
+import re
+import shlex
+import subprocess
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_SSH = {
+    "username": "root",
+    "password": None,
+    "port": 22,
+    "private_key_path": None,
+    "strict_host_key_checking": False,
+    "dummy": False,
+    "retries": 5,
+}
+
+
+class RemoteError(RuntimeError):
+    """A remote command returned nonzero exit status
+    (control.clj:118-133)."""
+
+    def __init__(self, cmd: str, host, exit: int, out: str, err: str):
+        super().__init__(
+            f"{cmd} returned non-zero exit status {exit} on {host}. "
+            f"STDOUT:\n{out}\n\nSTDERR:\n{err}")
+        self.cmd, self.host, self.exit, self.out, self.err = \
+            cmd, host, exit, out, err
+
+
+@dataclass
+class Literal:
+    """A string passed to the shell unescaped (control.clj:44-49)."""
+
+    string: str
+
+
+def lit(s: str) -> Literal:
+    return Literal(s)
+
+
+PIPE = lit("|")
+REDIR = {">": ">", ">>": ">>", "<": "<"}
+_NEEDS_QUOTE = re.compile(r'[\\$`"\'\s(){}\[\]*?<>&;]')
+
+
+def escape(s) -> str:
+    """Shell-escape a thing (control.clj:53-96): None → "", Literal
+    passes through, sequences escape element-wise space-joined."""
+    if s is None:
+        return ""
+    if isinstance(s, Literal):
+        return s.string
+    if isinstance(s, (list, tuple, set, frozenset)):
+        return " ".join(escape(x) for x in s)
+    s = str(s)
+    if s == "":
+        return '""'
+    if _NEEDS_QUOTE.search(s):
+        return '"' + re.sub(r'([\\$`"])', r"\\\1", s) + '"'
+    return s
+
+
+# ------------------------------------------------------------ transports
+
+class Transport:
+    def run(self, cmd: str, stdin: Optional[str]) -> Tuple[str, str, int]:
+        raise NotImplementedError
+
+    def upload(self, local: str, remote: str) -> None:
+        raise NotImplementedError
+
+    def download(self, remote: str, local: str) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class SSHTransport(Transport):
+    """OpenSSH subprocess transport with a ControlMaster mux socket."""
+
+    def __init__(self, host, cfg: dict):
+        self.host = str(host)
+        self.cfg = cfg
+        sockdir = os.path.join(
+            os.environ.get("XDG_RUNTIME_DIR", "/tmp"), "jepsen-ssh")
+        os.makedirs(sockdir, exist_ok=True)
+        self.sock = os.path.join(sockdir, f"{self.host}-{os.getpid()}")
+
+    def _base(self, prog: str) -> List[str]:
+        cfg = self.cfg
+        args = [prog, "-o", "BatchMode=yes",
+                "-o", "ControlMaster=auto",
+                "-o", f"ControlPath={self.sock}",
+                "-o", "ControlPersist=60"]
+        if not cfg.get("strict_host_key_checking"):
+            args += ["-o", "StrictHostKeyChecking=no",
+                     "-o", "UserKnownHostsFile=/dev/null",
+                     "-o", "LogLevel=ERROR"]
+        if cfg.get("private_key_path"):
+            args += ["-i", cfg["private_key_path"]]
+        port = cfg.get("port") or 22
+        args += ["-p" if prog == "ssh" else "-P", str(port)]
+        return args
+
+    @property
+    def _target(self) -> str:
+        user = self.cfg.get("username") or "root"
+        return f"{user}@{self.host}"
+
+    def run(self, cmd: str, stdin: Optional[str]) -> Tuple[str, str, int]:
+        p = subprocess.run(self._base("ssh") + [self._target, cmd],
+                           input=stdin, capture_output=True, text=True,
+                           timeout=self.cfg.get("timeout", 600))
+        return p.stdout, p.stderr, p.returncode
+
+    def upload(self, local: str, remote: str) -> None:
+        p = subprocess.run(
+            self._base("scp") + ["-r", local, f"{self._target}:{remote}"],
+            capture_output=True, text=True)
+        if p.returncode != 0:
+            raise RemoteError(f"scp {local}", self.host, p.returncode,
+                              p.stdout, p.stderr)
+
+    def download(self, remote: str, local: str) -> None:
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        p = subprocess.run(
+            self._base("scp") + ["-r", f"{self._target}:{remote}", local],
+            capture_output=True, text=True)
+        if p.returncode != 0:
+            raise RemoteError(f"scp {remote}", self.host, p.returncode,
+                              p.stdout, p.stderr)
+
+    def close(self) -> None:
+        subprocess.run(self._base("ssh") + ["-O", "exit", self._target],
+                       capture_output=True, text=True)
+
+
+class DummyTransport(Transport):
+    """No SSH at all: records commands, acknowledges everything
+    (control.clj:15,274-277). ``responder`` may map a command to fake
+    (out, err, exit) results for tests."""
+
+    def __init__(self, host, responder: Optional[Callable] = None):
+        self.host = host
+        self.commands: List[str] = []
+        self.uploads: List[Tuple[str, str]] = []
+        self.downloads: List[Tuple[str, str]] = []
+        self.responder = responder
+        self._lock = threading.Lock()
+
+    def run(self, cmd, stdin):
+        with self._lock:
+            self.commands.append(cmd)
+        if self.responder is not None:
+            r = self.responder(self.host, cmd)
+            if r is not None:
+                return r
+        return "", "", 0
+
+    def upload(self, local, remote):
+        with self._lock:
+            self.uploads.append((local, remote))
+
+    def download(self, remote, local):
+        with self._lock:
+            self.downloads.append((remote, local))
+
+
+@dataclass
+class Session:
+    """A per-node control session: transport + retry policy + sudo
+    password (carried here, not in thread-local state, so on_nodes
+    worker threads see it)."""
+
+    host: object
+    transport: Transport
+    retries: int = 5
+    password: Optional[str] = None
+
+    def close(self) -> None:
+        self.transport.close()
+
+
+def session(host, ssh_cfg: Optional[dict] = None,
+            responder: Optional[Callable] = None) -> Session:
+    cfg = {**DEFAULT_SSH, **(ssh_cfg or {})}
+    if cfg.get("dummy"):
+        t: Transport = DummyTransport(host, responder)
+    else:
+        t = SSHTransport(host, cfg)
+    return Session(host=host, transport=t,
+                   retries=cfg.get("retries", 5),
+                   password=cfg.get("password"))
+
+
+# --------------------------------------------------- per-thread context
+
+class _Ctx(threading.local):
+    def __init__(self):
+        self.host = None
+        self.session: Optional[Session] = None
+        self.dir = "/"
+        self.sudo: Optional[str] = None
+        self.trace = False
+
+
+_ctx = _Ctx()
+
+
+@contextmanager
+def with_session(host, sess: Session):
+    """Bind host + session for this thread (control.clj:297-304)."""
+    old = (_ctx.host, _ctx.session)
+    _ctx.host, _ctx.session = host, sess
+    try:
+        yield sess
+    finally:
+        _ctx.host, _ctx.session = old
+
+
+@contextmanager
+def cd(dir: str):
+    """Evaluate body in ``dir`` (control.clj:231-236); relative paths
+    resolve against the current dir."""
+    old = _ctx.dir
+    _ctx.dir = expand_path(dir)
+    try:
+        yield
+    finally:
+        _ctx.dir = old
+
+
+@contextmanager
+def sudo(user: str):
+    old = _ctx.sudo
+    _ctx.sudo = user
+    try:
+        yield
+    finally:
+        _ctx.sudo = old
+
+
+def su():
+    """sudo root (control.clj:244-247)."""
+    return sudo("root")
+
+
+@contextmanager
+def trace():
+    old = _ctx.trace
+    _ctx.trace = True
+    try:
+        yield
+    finally:
+        _ctx.trace = old
+
+
+def expand_path(path: str) -> str:
+    if path.startswith("/"):
+        return path
+    base = _ctx.dir or "/"
+    return base + ("" if base.endswith("/") else "/") + path
+
+
+def _wrap(cmd: str, stdin: Optional[str]) -> Tuple[str, Optional[str]]:
+    if _ctx.dir:
+        cmd = f"cd {escape(_ctx.dir)}; {cmd}"
+    if _ctx.sudo:
+        cmd = f"sudo -S -u {_ctx.sudo} bash -c {escape(cmd)}"
+        pw = _ctx.session.password if _ctx.session else None
+        stdin = (pw + "\n" + (stdin or "")) if pw else stdin
+    return cmd, stdin
+
+
+def ssh_run(cmd: str, stdin: Optional[str] = None) -> Tuple[str, str, int]:
+    """Run a raw (already-wrapped) command with transient-failure retry
+    (control.clj:140-160; exit 255 = OpenSSH transport failure)."""
+    s = _ctx.session
+    if s is None:
+        raise RuntimeError(
+            f"No SSH session bound for this thread (host={_ctx.host!r}); "
+            f"run inside with_session/on/on_nodes")
+    tries = s.retries
+    while True:
+        out, err, code = s.transport.run(cmd, stdin)
+        if code == 255 and tries > 0:
+            tries -= 1
+            time.sleep(1 + random.random())
+            continue
+        return out, err, code
+
+
+def exec_star(*commands, stdin: Optional[str] = None) -> str:
+    """Like exec_, but does not escape (control.clj:162-174)."""
+    cmd = " ".join(str(c) for c in commands)
+    cmd, stdin = _wrap(cmd, stdin)
+    if _ctx.trace:
+        import logging
+        logging.getLogger("jepsen.control").info("%s: %s", _ctx.host, cmd)
+    out, err, code = ssh_run(cmd, stdin)
+    if code != 0:
+        raise RemoteError(cmd, _ctx.host, code, out, err)
+    return out.rstrip("\n")
+
+
+def exec_(*commands, stdin: Optional[str] = None) -> str:
+    """Run a command (args escaped), return trimmed stdout, throw on
+    nonzero exit (control.clj:175-181)."""
+    return exec_star(*(escape(c) for c in commands), stdin=stdin)
+
+
+def upload(local: str, remote: str) -> None:
+    """Copy a local path to the current node (control.clj:191-200)."""
+    _ctx.session.transport.upload(local, remote)
+
+
+def upload_bytes(data: bytes, remote: str) -> None:
+    """Ship in-memory bytes to a remote file (used to push C sources and
+    configs without temp-file bookkeeping)."""
+    import base64
+    b64 = base64.b64encode(data).decode("ascii")
+    exec_star(f"echo {b64} | base64 -d > {escape(remote)}")
+
+
+def download(remote: str, local: str) -> None:
+    """Copy a remote path to the local machine (control.clj:205-217)."""
+    _ctx.session.transport.download(remote, local)
+
+
+@contextmanager
+def on(host, ssh_cfg: Optional[dict] = None):
+    """Open a session to host, bind it, close on exit
+    (control.clj:306-315)."""
+    s = session(host, ssh_cfg)
+    try:
+        with with_session(host, s):
+            yield s
+    finally:
+        s.close()
+
+
+@contextmanager
+def with_ssh(test: dict):
+    """Open sessions to every node into test["sessions"]; close them all
+    at exit (control.clj:288-295 + with-resources at core.clj:400-404)."""
+    cfg = {**DEFAULT_SSH, **(test.get("ssh") or {})}
+    responder = (test.get("ssh") or {}).get("responder")
+    sessions: Dict[object, Session] = {}
+    try:
+        for node in test.get("nodes") or []:
+            sessions[node] = session(node, cfg, responder)
+        test["sessions"] = sessions
+        yield sessions
+    finally:
+        for s in sessions.values():
+            try:
+                s.close()
+            except Exception:
+                pass
+        test.pop("sessions", None)
+
+
+def on_nodes(test: dict, f: Callable, nodes: Optional[Sequence] = None
+             ) -> dict:
+    """Evaluate f(test, node) in parallel on each node with its session
+    bound; returns {node: result} (control.clj:337-353)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    nodes = list(nodes if nodes is not None else (test.get("nodes") or []))
+    if not nodes:
+        return {}
+    sessions = test.get("sessions") or {}
+
+    def run_one(node):
+        s = sessions.get(node)
+        if s is None:
+            raise RuntimeError(f"No session for node {node!r}")
+        with with_session(node, s):
+            return f(test, node)
+
+    with ThreadPoolExecutor(max_workers=len(nodes),
+                            thread_name_prefix="jepsen-node") as ex:
+        futs = {node: ex.submit(run_one, node) for node in nodes}
+        out, errs = {}, []
+        for node, fut in futs.items():
+            try:
+                out[node] = fut.result()
+            except Exception as e:
+                errs.append(e)
+        if errs:
+            raise errs[0]
+        return out
+
+
+def on_many(hosts: Sequence, f: Callable,
+            ssh_cfg: Optional[dict] = None) -> dict:
+    """Open sessions to hosts, run f() on each in parallel
+    (control.clj:317-326)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def run_one(h):
+        with on(h, ssh_cfg):
+            return f(h)
+
+    with ThreadPoolExecutor(max_workers=max(1, len(hosts))) as ex:
+        return dict(zip(hosts, ex.map(run_one, hosts)))

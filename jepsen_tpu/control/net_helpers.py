@@ -1,0 +1,35 @@
+"""Host/IP helpers over the control session
+(jepsen/src/jepsen/control/net.clj)."""
+from __future__ import annotations
+
+from .core import RemoteError, exec_, lit
+
+
+def reachable(host) -> bool:
+    """Can the current node ping host? (control/net.clj:7-12)"""
+    try:
+        exec_("ping", "-w", 1, host)
+        return True
+    except RemoteError:
+        return False
+
+
+def local_ip() -> str:
+    """The local node's first IP address (control/net.clj:14-21)."""
+    return exec_("hostname", "-I", lit("|"), "awk", lit("'{print $1}'"))
+
+
+def ip(host: str) -> str:
+    """Resolve a hostname to an IP on the current node via getent
+    (control/net.clj:23-30)."""
+    out = exec_("getent", "ahosts", host)
+    for line in out.split("\n"):
+        parts = line.split()
+        if len(parts) >= 2 and parts[1] in ("STREAM", "RAW"):
+            return parts[0]
+    return out.split()[0] if out.split() else ""
+
+
+def control_ip() -> str:
+    """IP of the control node as seen from here."""
+    return exec_("echo", lit("${SSH_CLIENT%% *}"))

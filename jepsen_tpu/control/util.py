@@ -1,0 +1,197 @@
+"""Utility functions for scripting installations on db nodes.
+
+Mirrors jepsen/src/jepsen/control/util.clj: existence checks, tarball
+deployment with corrupt-download retry, user management, pattern kills,
+and daemon start/stop via start-stop-daemon + pidfiles.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import posixpath
+import random
+import re
+from typing import List, Optional
+
+from .core import (RemoteError, cd, escape, exec_, exec_star, expand_path,
+                   lit, su, _ctx)
+
+log = logging.getLogger("jepsen.control.util")
+
+TMP_DIR_BASE = "/tmp/jepsen"
+
+
+def meh(f, *args, **kw):
+    """Run f, swallowing remote errors (the reference's util/meh)."""
+    try:
+        return f(*args, **kw)
+    except RemoteError:
+        return None
+
+
+def exists(filename: str) -> bool:
+    """Is a path present? (util.clj:17-22)"""
+    try:
+        exec_("stat", filename)
+        return True
+    except RemoteError:
+        return False
+
+
+def ls(dir: str = ".") -> List[str]:
+    """Directory entries, dotfiles included (util.clj:24-31)."""
+    out = exec_("ls", "-A", dir)
+    return [line for line in out.split("\n") if line.strip()]
+
+
+def ls_full(dir: str) -> List[str]:
+    d = dir if dir.endswith("/") else dir + "/"
+    return [d + e for e in ls(d)]
+
+
+def tmp_dir() -> str:
+    """A fresh temporary directory under /tmp/jepsen (util.clj:41-49)."""
+    while True:
+        d = f"{TMP_DIR_BASE}/{random.randrange(2**31)}"
+        if not exists(d):
+            exec_("mkdir", "-p", d)
+            return d
+
+
+def wget(url: str, force: bool = False) -> str:
+    """Download url into the current directory (skipping when cached);
+    returns the filename (util.clj:51-70)."""
+    filename = posixpath.basename(url)
+    if force:
+        exec_("rm", "-f", filename)
+    if not exists(filename):
+        exec_("wget", "--tries", 20, "--waitretry", 60,
+              "--retry-connrefused", "--dns-timeout", 60,
+              "--connect-timeout", 60, "--read-timeout", 60, url)
+    return filename
+
+
+def install_archive(url: str, dest: str, force: bool = False) -> str:
+    """Fetch a tarball/zip (cached in /tmp/jepsen), extract its sole
+    top-level directory's contents (or all files) into dest, retrying
+    corrupt downloads (util.clj:72-141)."""
+    m = re.match(r"file://(.+)", url)
+    if m:
+        local_file: Optional[str] = m.group(1)
+        file = local_file
+    else:
+        local_file = None
+        exec_("mkdir", "-p", TMP_DIR_BASE)
+        with cd(TMP_DIR_BASE):
+            file = expand_path(wget(url, force))
+    tmpdir = tmp_dir()
+    dest = expand_path(dest)
+
+    exec_("rm", "-rf", dest)
+    parent = exec_("dirname", dest)
+    exec_("mkdir", "-p", parent)
+
+    try:
+        with cd(tmpdir):
+            if re.search(r"\.zip$", file):
+                exec_("unzip", file)
+            else:
+                exec_("tar", "xf", file)
+            if _ctx.sudo == "root":
+                exec_("chown", "-R", "root:root", ".")
+            roots = ls()
+            assert roots, "Archive contained no files"
+            if len(roots) == 1:
+                exec_("mv", roots[0], dest)
+            else:
+                exec_("mv", tmpdir, dest)
+    except RemoteError as e:
+        if "tar: Unexpected EOF" in str(e):
+            if local_file:
+                raise RuntimeError(
+                    f"Local archive {local_file} on node {_ctx.host} is "
+                    f"corrupt: unexpected EOF.") from e
+            log.info("Retrying corrupt archive download")
+            exec_("rm", "-rf", file)
+            return install_archive(url, dest, force)
+        raise
+    finally:
+        meh(exec_, "rm", "-rf", tmpdir)
+    return dest
+
+
+def ensure_user(username: str) -> str:
+    """Make sure a user exists (util.clj:150-157)."""
+    try:
+        with su():
+            exec_("adduser", "--disabled-password", "--gecos", lit("''"),
+                  username)
+    except RemoteError as e:
+        if "already exists" not in str(e):
+            raise
+    return username
+
+
+def grepkill(pattern: str, signal: int = 9) -> None:
+    """Kill processes matching a pattern (util.clj:159-174)."""
+    try:
+        # xargs -r: no matching processes is routine, not an error.
+        exec_("ps", "aux", lit("|"), "grep", pattern, lit("|"),
+              "grep", "-v", "grep", lit("|"), "awk", lit("'{print $2}'"),
+              lit("|"), "xargs", "-r", "kill", f"-{signal}")
+    except RemoteError as e:
+        # kill may still race a process that exited on its own.
+        if "No such process" not in e.err:
+            raise
+
+
+def start_daemon(opts: dict, bin: str, *args) -> None:
+    """Start a daemon with output to a logfile and a pidfile, via
+    start-stop-daemon (util.clj:176-219). Opts: logfile, pidfile, chdir,
+    background (True), make_pidfile (True), match_executable (True),
+    match_process_name (False), process_name."""
+    log.info("starting %s", posixpath.basename(bin))
+    exec_("echo", lit("`date +'%Y-%m-%d %H:%M:%S'`"),
+          "Jepsen starting", bin, " ".join(str(a) for a in args),
+          lit(">>"), opts["logfile"])
+    cmd: List = ["start-stop-daemon", "--start"]
+    if opts.get("background", True):
+        cmd += ["--background", "--no-close"]
+    if opts.get("make_pidfile", True):
+        cmd += ["--make-pidfile"]
+    if opts.get("match_executable", True):
+        cmd += ["--exec", bin]
+    if opts.get("match_process_name", False):
+        cmd += ["--name", opts.get("process_name", posixpath.basename(bin))]
+    cmd += ["--pidfile", opts["pidfile"]]
+    if opts.get("chdir"):
+        cmd += ["--chdir", opts["chdir"]]
+    cmd += ["--oknodo", "--startas", bin, "--"]
+    cmd += list(args) + [lit(">>"), opts["logfile"], lit("2>&1")]
+    exec_(*cmd)
+
+
+def stop_daemon(pidfile: str, cmd: Optional[str] = None) -> None:
+    """Kill a daemon by pidfile, or by command name (util.clj:221-236)."""
+    if cmd is not None:
+        log.info("Stopping %s", cmd)
+        meh(exec_, "killall", "-9", "-w", cmd)
+        meh(exec_, "rm", "-rf", pidfile)
+        return
+    if exists(pidfile):
+        log.info("Stopping %s", pidfile)
+        pid = int(exec_("cat", pidfile))
+        meh(exec_, "kill", "-9", pid)
+        meh(exec_, "rm", "-rf", pidfile)
+
+
+def daemon_running(pidfile: str) -> bool:
+    """Is the pidfile's process alive?"""
+    if not exists(pidfile):
+        return False
+    try:
+        pid = int(exec_("cat", pidfile))
+        exec_("kill", "-0", pid)
+        return True
+    except (RemoteError, ValueError):
+        return False

@@ -1,0 +1,47 @@
+"""libfaketime wrappers: run a DB under a skewed, rate-drifting clock.
+
+Mirrors jepsen/src/jepsen/faketime.clj: replace a db binary with a shell
+wrapper that launches the real binary under faketime with a per-node
+random rate, so nodes' clocks drift apart continuously (as opposed to
+the discrete jumps of the clock nemesis).
+"""
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from .control.core import escape, exec_, exec_star, lit, su
+
+
+def script(bin_path: str, rate: float) -> str:
+    """A wrapper script body running bin under faketime at the given
+    rate (faketime.clj:8-17)."""
+    return (f"#!/bin/bash\n"
+            f"faketime -m -f \"+0s x{rate:.2f}\" {bin_path}.real "
+            f'"$@"\n')
+
+
+def wrap(bin_path: str, rate: float) -> None:
+    """Move bin to bin.real and install a faketime wrapper in its place
+    (faketime.clj:19-31). Idempotent."""
+    with su():
+        moved = exec_star(
+            f"if [ ! -f {escape(bin_path)}.real ]; then "
+            f"mv {escape(bin_path)} {escape(bin_path)}.real; fi; echo ok")
+        assert moved.strip() == "ok"
+        exec_("printf", "%s", script(bin_path, rate),
+              lit(">"), bin_path)
+        exec_("chmod", "a+x", bin_path)
+
+
+def unwrap(bin_path: str) -> None:
+    """Restore the original binary."""
+    with su():
+        exec_star(
+            f"if [ -f {escape(bin_path)}.real ]; then "
+            f"mv {escape(bin_path)}.real {escape(bin_path)}; fi")
+
+
+def rand_rate(rng: Optional[random.Random] = None) -> float:
+    """A random clock rate in (0, 5] (faketime.clj rand-factor)."""
+    return round(((rng or random).random() * 4.99) + 0.01, 2)

@@ -16,11 +16,19 @@ from .ops import Op
 
 
 def dumps_op(op: Op) -> str:
-    return json.dumps(op.to_dict(), separators=(",", ":"), default=_default)
+    d = op.to_dict()
+    v = d.get("value")
+    # Independent-key tuples must survive the round trip as KV, not list.
+    if type(v).__name__ == "KV":
+        d["value"] = {"__kv__": [v[0], v[1]]}
+    return json.dumps(d, separators=(",", ":"), default=_default)
 
 
 def loads_op(line: str) -> Op:
-    return Op.from_dict(json.loads(line))
+    d = json.loads(line)
+    for k, v in list(d.items()):
+        d[k] = _revive(v)
+    return Op.from_dict(d)
 
 
 def _default(o):
@@ -42,6 +50,9 @@ def _revive(d):
         if set(d.keys()) == {"__bytes__"}:
             import base64
             return base64.b64decode(d["__bytes__"])
+        if set(d.keys()) == {"__kv__"}:
+            from ..independent import KV
+            return KV(_revive(d["__kv__"][0]), _revive(d["__kv__"][1]))
         return {k: _revive(v) for k, v in d.items()}
     if isinstance(d, list):
         return [_revive(v) for v in d]
@@ -69,10 +80,7 @@ def read_jsonl(path) -> List[Op]:
         for line in f:
             line = line.strip()
             if line:
-                d = json.loads(line)
-                for k, v in list(d.items()):
-                    d[k] = _revive(v)
-                out.append(Op.from_dict(d))
+                out.append(loads_op(line))
     return out
 
 

@@ -1,0 +1,265 @@
+"""Independent-key lifting: scale expensive checkers sideways.
+
+Some properties (linearizability) are only tractable over short
+histories, but short histories under-sample concurrency bugs. The fix
+(jepsen/src/jepsen/independent.clj:1-8): lift a single-register test to a
+*map* of keys — run many keyed sub-tests concurrently, then strain the
+recorded history into per-key subhistories and check each independently.
+
+TPU twist: the per-key strainer is exactly a batch builder. Where the
+reference pmap's a JVM checker over keys, `batch_checker` lowers *all*
+per-key subhistories into one encoded batch and decides every key in a
+single device call (jepsen_tpu.ops.linearize.check_batch_tpu) — the
+north-star shape: one workload × many keys/seeds ↦ [B, ...] tensors.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+from . import gen as g
+from .checkers.core import Checker, check_safe, merge_valid
+from .history.ops import Op
+
+DIR = "independent"
+
+
+class KV(tuple):
+    """A (key, value) tuple marking values produced by independent
+    generators (independent.clj:20-28)."""
+
+    __slots__ = ()
+
+    def __new__(cls, k, v):
+        return super().__new__(cls, (k, v))
+
+    @property
+    def key(self):
+        return self[0]
+
+    @property
+    def value(self):
+        return self[1]
+
+    def __repr__(self):
+        return f"KV({self[0]!r}, {self[1]!r})"
+
+
+def is_kv(v) -> bool:
+    return isinstance(v, KV)
+
+
+def tuple_(k, v) -> KV:
+    return KV(k, v)
+
+
+class _SequentialGenerator(g.Generator):
+    """One key at a time: drain fgen(k1), then fgen(k2), ...
+    (independent.clj:30-63). Wraps each op value in a KV tuple."""
+
+    def __init__(self, keys: Iterable, fgen: Callable):
+        self._it = iter(keys)
+        self.fgen = fgen
+        self._lock = threading.RLock()
+        self._k = None
+        self._gen = None
+        self._live = True
+        self._advance()
+
+    def _advance(self) -> bool:
+        try:
+            self._k = next(self._it)
+            self._gen = self.fgen(self._k)
+            return True
+        except StopIteration:
+            self._live = False
+            return False
+
+    def op(self, test, process, ctx):
+        with self._lock:
+            while self._live:
+                o = g.op(self._gen, test, process, ctx)
+                if o is not None:
+                    return {**o, "value": KV(self._k, o.get("value"))}
+                if not self._advance():
+                    return None
+            return None
+
+
+def sequential_generator(keys: Iterable, fgen: Callable) -> g.Generator:
+    return _SequentialGenerator(keys, fgen)
+
+
+class _ConcurrentGenerator(g.Generator):
+    """n threads per key; thread groups run independent keys concurrently
+    (independent.clj:65-219). Thread t belongs to group t // n; each
+    group drains fgen(k) with ctx narrowed to its own threads (so barrier
+    combinators work per key), then takes the next key."""
+
+    def __init__(self, n: int, keys: Iterable, fgen: Callable):
+        assert isinstance(n, int) and n > 0
+        self.n = n
+        self._keys = iter(keys)
+        self.fgen = fgen
+        self._lock = threading.RLock()
+        self._active: Optional[list] = None     # per-group [k, gen] | None
+        self._group_threads: Optional[list] = None
+
+    def _init(self, test, ctx):
+        threads = [t for t in ctx.threads if isinstance(t, int)]
+        tc = len(threads)
+        if sorted(threads) != list(range(tc)):
+            raise AssertionError(
+                f"concurrent-generator expects integer threads 0..{tc - 1}, "
+                f"got {threads}")
+        if test.get("concurrency") != tc:
+            raise AssertionError(
+                f"Expected test concurrency ({test.get('concurrency')}) to "
+                f"equal the number of integer threads ({tc})")
+        if self.n > tc:
+            raise AssertionError(
+                f"With {tc} worker threads, this concurrent-generator cannot "
+                f"run a key with {self.n} threads concurrently. Consider "
+                f"raising your test's concurrency to at least {self.n}.")
+        groups = tc // self.n
+        if groups * self.n != tc:
+            raise AssertionError(
+                f"This concurrent-generator has {tc} threads to work with, "
+                f"but can only use {groups * self.n} of those threads to run "
+                f"{groups} concurrent keys with {self.n} threads apiece. "
+                f"Consider raising or lowering the test's concurrency to a "
+                f"multiple of {self.n}.")
+        self._group_threads = [tuple(threads[i * self.n:(i + 1) * self.n])
+                               for i in range(groups)]
+        self._active = []
+        for _ in range(groups):
+            try:
+                k = next(self._keys)
+                self._active.append([k, self.fgen(k)])
+            except StopIteration:
+                self._active.append(None)
+
+    def op(self, test, process, ctx):
+        with self._lock:
+            if self._active is None:
+                self._init(test, ctx)
+        thread = ctx.thread_of(process)
+        if not isinstance(thread, int):
+            raise AssertionError(
+                "Only worker threads with numeric ids can ask for operations "
+                f"from concurrent-generator; got {thread!r}")
+        group = thread // self.n
+        while True:
+            with self._lock:
+                pair = self._active[group]
+            if pair is None:
+                return None
+            k, sub = pair
+            sub_ctx = ctx.with_threads(self._group_threads[group])
+            o = g.op(sub, test, process, sub_ctx)
+            if o is not None:
+                return {**o, "value": KV(k, o.get("value"))}
+            with self._lock:
+                # Don't race another group member to pick the next key.
+                if self._active[group] is pair:
+                    try:
+                        k2 = next(self._keys)
+                        self._active[group] = [k2, self.fgen(k2)]
+                    except StopIteration:
+                        self._active[group] = None
+
+
+def concurrent_generator(n: int, keys: Iterable, fgen: Callable) -> g.Generator:
+    return _ConcurrentGenerator(n, keys, fgen)
+
+
+def history_keys(history: Sequence[Op]) -> List:
+    """Distinct KV keys in a history, in first-seen order
+    (independent.clj:221-231)."""
+    seen, out = set(), []
+    for op in history:
+        v = op.value
+        if isinstance(v, KV) and v.key not in seen:
+            seen.add(v.key)
+            out.append(v.key)
+    return out
+
+
+def subhistory(k, history: Sequence[Op]) -> List[Op]:
+    """All ops without a *differing* key, KV values unwrapped — unkeyed
+    ops (nemesis, logging) appear in every subhistory
+    (independent.clj:233-244)."""
+    out = []
+    for op in history:
+        v = op.value
+        if not isinstance(v, KV):
+            out.append(op)
+        elif v.key == k:
+            out.append(op.with_(value=v.value))
+    return out
+
+
+class IndependentChecker(Checker):
+    """Lift a checker over v-values to one over KV-valued histories
+    (independent.clj:246-295): check each key's subhistory; valid iff
+    all sub-results are; writes per-key artifacts when a store handle is
+    present in opts."""
+
+    def __init__(self, checker: Checker):
+        self.checker = checker
+
+    def check(self, test, model, history, opts=None) -> dict:
+        opts = opts or {}
+        results = {}
+        for k in history_keys(history):
+            h = subhistory(k, history)
+            sub_opts = {**opts,
+                        "subdirectory": list(opts.get("subdirectory", []))
+                        + [DIR, str(k)]}
+            r = check_safe(self.checker, test, model, h, sub_opts)
+            store = opts.get("store") or test.get("store_handle")
+            if store is not None:
+                store.write_json([DIR, str(k), "results.json"], r)
+                store.write_history([DIR, str(k), "history"], h)
+            results[k] = r
+        failures = [k for k, r in results.items()
+                    if r.get("valid") is not True]
+        return {
+            "valid": merge_valid(r["valid"] for r in results.values())
+            if results else True,
+            "results": results,
+            "failures": failures,
+        }
+
+
+def checker(sub_checker: Checker) -> Checker:
+    return IndependentChecker(sub_checker)
+
+
+class BatchLinearizableChecker(Checker):
+    """TPU-batched independent linearizability: strains the history into
+    per-key subhistories and decides ALL keys in one device dispatch per
+    cost bucket — the reference's per-key pmap (independent.clj:263-280)
+    becomes the batch axis of the frontier kernel."""
+
+    def __init__(self, **kw):
+        self.kw = kw
+
+    def check(self, test, model, history, opts=None) -> dict:
+        from .ops.linearize import check_batch_tpu
+        ks = history_keys(history)
+        subs = [subhistory(k, history) for k in ks]
+        rs = check_batch_tpu(model, subs, **self.kw)
+        results = dict(zip(ks, rs))
+        failures = [k for k, r in results.items()
+                    if r.get("valid") is not True]
+        return {
+            "valid": merge_valid(r["valid"] for r in results.values())
+            if results else True,
+            "results": results,
+            "failures": failures,
+        }
+
+
+def batch_checker(**kw) -> Checker:
+    return BatchLinearizableChecker(**kw)

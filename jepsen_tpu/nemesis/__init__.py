@@ -1,0 +1,14 @@
+"""Fault injection: nemeses are Clients driven by the nemesis thread.
+
+Mirrors jepsen/src/jepsen/nemesis.clj (+ nemesis/time.clj, faketime.clj):
+partitions are *grudge* maps (node → nodes to reject), built by pure
+grudge combinators and applied through the Net layer; process-level
+faults (kill/pause), clock skew (via on-node-compiled C helpers), and
+data corruption round out the zoo.
+"""
+from .core import (Noop, noop, snub_nodes, partition, bisect, split_one,
+                   complete_grudge, bridge, partitioner, partition_halves,
+                   partition_random_halves, partition_random_node,
+                   majorities_ring, partition_majorities_ring, compose,
+                   set_time, clock_scrambler, node_start_stopper,
+                   hammer_time, truncate_file)

@@ -1,0 +1,337 @@
+"""Core nemesis library (jepsen/src/jepsen/nemesis.clj)."""
+from __future__ import annotations
+
+import random
+import threading
+import time as _time
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from ..client import Client
+from ..control import core as c
+from ..control.core import exec_, on_many, on_nodes, su
+
+
+class Noop(Client):
+    """Does nothing (nemesis.clj:9-14)."""
+
+    def setup(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        return op
+
+
+noop = Noop()
+
+
+def snub_nodes(test: dict, dest, sources: Sequence) -> None:
+    """Drop all packets from sources as seen at dest (nemesis.clj:16-19)."""
+    net = test["net"]
+    for src in sources:
+        net.drop(test, src, dest)
+
+
+def partition(test: dict, grudge: Dict) -> None:
+    """Apply a grudge map: node → collection of nodes it rejects
+    (nemesis.clj:21-27). Cumulative until heal."""
+    def f(t, node):
+        snub_nodes(t, node, grudge.get(node, ()))
+    on_nodes(test, f)
+
+
+# ----------------------------------------------------- grudge builders
+
+def bisect(coll: Sequence) -> List[List]:
+    """Cut a sequence in half; smaller half first (nemesis.clj:29-32)."""
+    xs = list(coll)
+    mid = len(xs) // 2
+    return [xs[:mid], xs[mid:]]
+
+
+def split_one(coll: Sequence, loner=None, rng: Optional[random.Random] = None
+              ) -> List[List]:
+    """Split one node off from the rest (nemesis.clj:34-39)."""
+    xs = list(coll)
+    if loner is None:
+        loner = (rng or random).choice(xs)
+    return [[loner], [x for x in xs if x != loner]]
+
+
+def complete_grudge(components: Sequence[Sequence]) -> Dict:
+    """No node may talk outside its component (nemesis.clj:41-53)."""
+    comps = [set(comp) for comp in components]
+    universe: Set = set().union(*comps) if comps else set()
+    grudge: Dict = {}
+    for comp in comps:
+        for node in comp:
+            grudge[node] = universe - comp
+    return grudge
+
+
+def bridge(nodes: Sequence) -> Dict:
+    """Cut the network in half but keep one bridge node connected to both
+    sides (nemesis.clj:55-66)."""
+    components = bisect(nodes)
+    bridge_node = components[1][0]
+    grudge = complete_grudge(components)
+    grudge.pop(bridge_node, None)
+    return {node: rejects - {bridge_node}
+            for node, rejects in grudge.items()}
+
+
+def majorities_ring(nodes: Sequence,
+                    rng: Optional[random.Random] = None) -> Dict:
+    """Every node sees a majority, but no two nodes see the same majority
+    (nemesis.clj:105-126): shuffle into a ring, give each node a
+    contiguous majority window, and have the window's midpoint drop
+    everyone outside it."""
+    from ..utils.core import majority
+    xs = list(nodes)
+    (rng or random).shuffle(xs)
+    U = set(xs)
+    n = len(xs)
+    m = majority(n)
+    grudge = {}
+    for i in range(n):
+        window = [xs[(i + j) % n] for j in range(m)]
+        mid = window[len(window) // 2]
+        grudge[mid] = U - set(window)
+    return grudge
+
+
+# ------------------------------------------------------- partitioners
+
+class Partitioner(Client):
+    """:start cuts links per (grudge nodes); :stop heals
+    (nemesis.clj:68-86)."""
+
+    def __init__(self, grudge_fn: Callable):
+        self.grudge_fn = grudge_fn
+
+    def setup(self, test, node):
+        test["net"].heal(test)
+        return self
+
+    def invoke(self, test, op):
+        f = op["f"]
+        if f == "start":
+            grudge = self.grudge_fn(test["nodes"])
+            partition(test, grudge)
+            return {**op, "value": f"Cut off {grudge!r}"}
+        if f == "stop":
+            test["net"].heal(test)
+            return {**op, "value": "fully connected"}
+        raise ValueError(f"partitioner got unknown op {f!r}")
+
+    def teardown(self, test):
+        test["net"].heal(test)
+
+
+def partitioner(grudge_fn: Callable) -> Client:
+    return Partitioner(grudge_fn)
+
+
+def partition_halves() -> Client:
+    """First half vs second (nemesis.clj:88-93)."""
+    return partitioner(lambda nodes: complete_grudge(bisect(nodes)))
+
+
+def partition_random_halves(rng: Optional[random.Random] = None) -> Client:
+    """Randomly chosen halves (nemesis.clj:95-98)."""
+    def f(nodes):
+        xs = list(nodes)
+        (rng or random).shuffle(xs)
+        return complete_grudge(bisect(xs))
+    return partitioner(f)
+
+
+def partition_random_node(rng: Optional[random.Random] = None) -> Client:
+    """Isolate a single random node (nemesis.clj:100-103)."""
+    return partitioner(lambda nodes: complete_grudge(
+        split_one(nodes, rng=rng)))
+
+
+def partition_majorities_ring(rng: Optional[random.Random] = None) -> Client:
+    """Intersecting-majorities ring partition (nemesis.clj:128-132)."""
+    return partitioner(lambda nodes: majorities_ring(nodes, rng))
+
+
+# ------------------------------------------------------- composition
+
+class Compose(Client):
+    """Route ops to child nemeses by :f (nemesis.clj:134-166). Takes a
+    dict {router: nemesis} or an iterable of (router, nemesis) pairs —
+    a router is a set/dict/callable mapping an op's f to the child's f
+    (None → not handled). Pairs allow dict routers, which rename fs so
+    two partitioners can coexist (nemesis.clj:141-149)."""
+
+    def __init__(self, nemeses):
+        self.nemeses = list(nemeses.items()) if isinstance(nemeses, dict) \
+            else list(nemeses)
+
+    @staticmethod
+    def _route(fs, f):
+        if callable(fs) and not isinstance(fs, (set, frozenset, dict)):
+            return fs(f)
+        if isinstance(fs, (set, frozenset)):
+            return f if f in fs else None
+        if isinstance(fs, dict):
+            return fs.get(f)
+        raise TypeError(f"bad f-router {fs!r}")
+
+    def setup(self, test, node):
+        return Compose([(fs, nem.setup(test, node))
+                        for fs, nem in self.nemeses])
+
+    def invoke(self, test, op):
+        f = op["f"]
+        for fs, nem in self.nemeses:
+            f2 = self._route(fs, f)
+            if f2 is not None:
+                out = nem.invoke(test, {**op, "f": f2})
+                return {**out, "f": f}
+        raise ValueError(f"no nemesis can handle {f!r}")
+
+    def teardown(self, test):
+        for _, nem in self.nemeses:
+            nem.teardown(test)
+
+
+def compose(nemeses) -> Client:
+    return Compose(nemeses)
+
+
+# ---------------------------------------------------- clock scrambling
+
+def set_time(t: float) -> None:
+    """Set the current node's clock, POSIX seconds (nemesis.clj:168-171)."""
+    with su():
+        exec_("date", "+%s", "-s", f"@{int(t)}")
+
+
+class ClockScrambler(Client):
+    """Randomize every node's clock within ±dt seconds
+    (nemesis.clj:173-188)."""
+
+    def __init__(self, dt: int, rng: Optional[random.Random] = None):
+        self.dt = dt
+        self.rng = rng or random.Random()
+
+    def setup(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        dt = self.dt
+
+        def f(t, node):
+            set_time(_time.time() + self.rng.randint(-dt, dt))
+        value = on_nodes(test, f)
+        return {**op, "value": value}
+
+    def teardown(self, test):
+        def f(t, node):
+            set_time(_time.time())
+        on_nodes(test, f)
+
+
+def clock_scrambler(dt: int, rng: Optional[random.Random] = None) -> Client:
+    return ClockScrambler(dt, rng)
+
+
+# ------------------------------------------- targeted start/stop faults
+
+class NodeStartStopper(Client):
+    """:start runs start_fn on targeted nodes; :stop runs stop_fn on them
+    (nemesis.clj:190-225). Each :start re-targets; overlapping starts
+    are rejected."""
+
+    def __init__(self, targeter: Callable, start_fn: Callable,
+                 stop_fn: Callable):
+        self.targeter = targeter
+        self.start_fn = start_fn
+        self.stop_fn = stop_fn
+        self._nodes: Optional[List] = None
+        self._lock = threading.Lock()
+
+    def setup(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        with self._lock:
+            f = op["f"]
+            if f == "start":
+                targets = self.targeter(list(test["nodes"]))
+                if targets is None:
+                    return {**op, "type": "info", "value": "no-target"}
+                targets = list(targets) if isinstance(
+                    targets, (list, tuple, set)) else [targets]
+                if self._nodes is not None:
+                    return {**op, "type": "info",
+                            "value": f"nemesis already disrupting "
+                                     f"{self._nodes!r}"}
+                self._nodes = targets
+                value = on_nodes(test,
+                                 lambda t, n: self.start_fn(t, n), targets)
+                return {**op, "type": "info", "value": value}
+            if f == "stop":
+                if self._nodes is None:
+                    return {**op, "type": "info", "value": "not-started"}
+                value = on_nodes(test,
+                                 lambda t, n: self.stop_fn(t, n),
+                                 self._nodes)
+                self._nodes = None
+                return {**op, "type": "info", "value": value}
+            raise ValueError(f"node-start-stopper got unknown op {f!r}")
+
+    def teardown(self, test):
+        pass
+
+
+def node_start_stopper(targeter: Callable, start_fn: Callable,
+                       stop_fn: Callable) -> Client:
+    return NodeStartStopper(targeter, start_fn, stop_fn)
+
+
+def hammer_time(process: str, targeter: Optional[Callable] = None) -> Client:
+    """SIGSTOP a process on targeted nodes at :start; SIGCONT at :stop
+    (nemesis.clj:227-241)."""
+    targeter = targeter or (lambda nodes: random.choice(nodes))
+
+    def start(test, node):
+        with su():
+            exec_("killall", "-s", "STOP", process)
+        return ["paused", process]
+
+    def stop(test, node):
+        with su():
+            exec_("killall", "-s", "CONT", process)
+        return ["resumed", process]
+
+    return node_start_stopper(targeter, start, stop)
+
+
+class TruncateFile(Client):
+    """{:f :truncate, :value {node: {"file": ..., "drop": n}}} — drop the
+    last n bytes of a file on those nodes (nemesis.clj:243-269)."""
+
+    def setup(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        assert op["f"] == "truncate"
+        plan = op["value"]
+
+        def f(t, node):
+            spec = plan[node]
+            with su():
+                exec_("truncate", "-c", "-s", f"-{int(spec['drop'])}",
+                      spec["file"])
+        on_nodes(test, f, list(plan.keys()))
+        return op
+
+    def teardown(self, test):
+        pass
+
+
+def truncate_file() -> Client:
+    return TruncateFile()

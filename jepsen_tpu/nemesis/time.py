@@ -1,0 +1,143 @@
+"""Clock manipulation: compile C helpers on db nodes and drive them.
+
+Mirrors jepsen/src/jepsen/nemesis/time.clj: the harness ships C sources
+(jepsen_tpu/resources/*.c) to each node, compiles them with the node's
+gcc into /opt/jepsen, and the clock nemesis invokes the binaries for
+millisecond-precision jumps and strobes that shell tools can't deliver.
+"""
+from __future__ import annotations
+
+import logging
+import random
+from pathlib import Path
+from typing import Dict, Optional
+
+from .. import gen as g
+from ..client import Client
+from ..control.core import cd, exec_, su, upload_bytes
+from ..control.util import meh
+from ..utils.core import majority
+
+log = logging.getLogger("jepsen.nemesis.time")
+
+RESOURCES = Path(__file__).resolve().parent.parent / "resources"
+OPT_DIR = "/opt/jepsen"
+
+
+def compile_c(source: bytes, bin_name: str) -> str:
+    """Upload C source to the current node and gcc it into
+    /opt/jepsen/<bin> (time.clj:11-27)."""
+    with su():
+        exec_("mkdir", "-p", OPT_DIR)
+        exec_("chmod", "a+rwx", OPT_DIR)
+        upload_bytes(source, f"{OPT_DIR}/{bin_name}.c")
+        with cd(OPT_DIR):
+            exec_("gcc", "-O2", "-o", bin_name, f"{bin_name}.c")
+    return bin_name
+
+
+def compile_resource(resource: str, bin_name: str) -> str:
+    """Compile a bundled resource file on the current node
+    (time.clj:29-33)."""
+    return compile_c((RESOURCES / resource).read_bytes(), bin_name)
+
+
+def install() -> None:
+    """Upload + compile the clock tools on the current node
+    (time.clj:35-42)."""
+    compile_resource("strobe-time.c", "strobe-time")
+    compile_resource("bump-time.c", "bump-time")
+
+
+def reset_time() -> None:
+    """NTP-reset the current node's clock (time.clj:44-47)."""
+    with su():
+        exec_("ntpdate", "-b", "pool.ntp.org")
+
+
+def bump_time(delta_ms: int) -> None:
+    """Jump the clock by delta milliseconds (time.clj:50-53)."""
+    with su():
+        exec_(f"{OPT_DIR}/bump-time", delta_ms)
+
+
+def strobe_time(delta_ms: int, period_ms: int, duration_s: int) -> None:
+    """Strobe the clock by ±delta every period for duration
+    (time.clj:55-59)."""
+    with su():
+        exec_(f"{OPT_DIR}/strobe-time", delta_ms, period_ms, duration_s)
+
+
+class ClockNemesis(Client):
+    """Handles {:f :reset|:strobe|:bump} clock ops (time.clj:61-91):
+
+        {"f": "reset",  "value": [node, ...]}
+        {"f": "strobe", "value": {node: {"delta": ms, "period": ms,
+                                         "duration": s}}}
+        {"f": "bump",   "value": {node: delta_ms}}
+    """
+
+    def setup(self, test, node):
+        from ..control.core import on_nodes
+        on_nodes(test, lambda t, n: (install(), meh(reset_time)))
+        return self
+
+    def invoke(self, test, op):
+        from ..control.core import on_nodes
+        f, v = op["f"], op["value"]
+        if f == "reset":
+            on_nodes(test, lambda t, n: reset_time(), v)
+        elif f == "strobe":
+            on_nodes(test, lambda t, n: strobe_time(
+                v[n]["delta"], v[n]["period"], v[n]["duration"]),
+                list(v.keys()))
+        elif f == "bump":
+            on_nodes(test, lambda t, n: bump_time(v[n]), list(v.keys()))
+        else:
+            raise ValueError(f"clock nemesis got unknown op {f!r}")
+        return op
+
+    def teardown(self, test):
+        from ..control.core import on_nodes
+        meh(on_nodes, test, lambda t, n: reset_time())
+
+
+def clock_nemesis() -> Client:
+    return ClockNemesis()
+
+
+# -------------------------------------------- randomized op generators
+# (time.clj:93-126): seeded streams of clock-fault invocations.
+
+def _subset(nodes, rng: random.Random):
+    k = rng.randint(1, len(nodes))
+    return rng.sample(list(nodes), k)
+
+
+def reset_gen(test, process, ctx):
+    """Reset clocks on a random subset of nodes (time.clj:93-99)."""
+    return {"type": "info", "f": "reset",
+            "value": _subset(test["nodes"], ctx.rng)}
+
+
+def bump_gen(test, process, ctx):
+    """Bump clocks by ±max 262s on a random subset (time.clj:101-107)."""
+    return {"type": "info", "f": "bump",
+            "value": {n: (ctx.rng.choice([-1, 1]) *
+                          2 ** ctx.rng.randint(0, 18))
+                      for n in _subset(test["nodes"], ctx.rng)}}
+
+
+def strobe_gen(test, process, ctx):
+    """Strobe clocks — ±max 262s deltas, ms periods, ≤32 s durations
+    (time.clj:109-117)."""
+    return {"type": "info", "f": "strobe",
+            "value": {n: {"delta": 2 ** ctx.rng.randint(0, 18),
+                          "period": 2 ** ctx.rng.randint(0, 10),
+                          "duration": ctx.rng.randint(0, 31)}
+                      for n in _subset(test["nodes"], ctx.rng)}}
+
+
+def clock_gen() -> g.Generator:
+    """A mix of reset/bump/strobe ops (time.clj:119-126)."""
+    return g.mix([g._Fn(reset_gen), g._Fn(bump_gen), g._Fn(strobe_gen)])

@@ -1,0 +1,128 @@
+"""Network manipulation: the controlled *anti*-network.
+
+Mirrors jepsen/src/jepsen/net.clj — the Net protocol (drop/heal/slow/
+flaky/fast) with an iptables implementation (packet drops between
+specific nodes) and tc-netem implementations of delay and loss. An
+ipfilter variant covers SmartOS-style nodes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .control import core as c
+from .control.core import RemoteError, exec_, lit, on_nodes, su
+from .control import net_helpers
+
+TC = "/sbin/tc"
+
+
+class Net:
+    """drop/heal/slow/flaky/fast (net.clj:9-20)."""
+
+    def drop(self, test: dict, src, dest) -> None:
+        """Drop traffic from src as seen at dest."""
+        raise NotImplementedError
+
+    def heal(self, test: dict) -> None:
+        raise NotImplementedError
+
+    def slow(self, test: dict, mean_ms: int = 50, variance_ms: int = 10,
+             distribution: str = "normal") -> None:
+        raise NotImplementedError
+
+    def flaky(self, test: dict) -> None:
+        raise NotImplementedError
+
+    def fast(self, test: dict) -> None:
+        raise NotImplementedError
+
+
+class NoopNet(Net):
+    """Does nothing (net.clj:24-32)."""
+
+    def drop(self, test, src, dest):
+        pass
+
+    def heal(self, test):
+        pass
+
+    def slow(self, test, mean_ms=50, variance_ms=10, distribution="normal"):
+        pass
+
+    def flaky(self, test):
+        pass
+
+    def fast(self, test):
+        pass
+
+
+noop = NoopNet()
+
+
+class IPTablesNet(Net):
+    """Default iptables implementation (net.clj:34-75): assumes root
+    control of every node. Drops are directional — installed at dest
+    against src's IP."""
+
+    def drop(self, test, src, dest):
+        def f(t, node):
+            with su():
+                exec_("iptables", "-A", "INPUT", "-s",
+                      net_helpers.ip(str(src)), "-j", "DROP", "-w")
+        on_nodes(test, f, [dest])
+
+    def heal(self, test):
+        def f(t, node):
+            with su():
+                exec_("iptables", "-F", "-w")
+                exec_("iptables", "-X", "-w")
+        on_nodes(test, f)
+
+    def slow(self, test, mean_ms=50, variance_ms=10, distribution="normal"):
+        def f(t, node):
+            with su():
+                exec_(TC, "qdisc", "add", "dev", "eth0", "root", "netem",
+                      "delay", f"{mean_ms}ms", f"{variance_ms}ms",
+                      "distribution", distribution)
+        on_nodes(test, f)
+
+    def flaky(self, test):
+        def f(t, node):
+            with su():
+                exec_(TC, "qdisc", "add", "dev", "eth0", "root", "netem",
+                      "loss", "20%", "75%")
+        on_nodes(test, f)
+
+    def fast(self, test):
+        def f(t, node):
+            with su():
+                try:
+                    exec_(TC, "qdisc", "del", "dev", "eth0", "root")
+                except RemoteError as e:
+                    if "RTNETLINK answers: No such file or directory" \
+                            not in str(e):
+                        raise
+        on_nodes(test, f)
+
+
+iptables = IPTablesNet()
+
+
+class IPFilterNet(IPTablesNet):
+    """ipfilter rules for SmartOS-style nodes (net.clj:77-109)."""
+
+    def drop(self, test, src, dest):
+        def f(t, node):
+            with su():
+                exec_("echo", "block", "in", "from", str(src), "to", "any",
+                      lit("|"), "ipf", "-f", "-")
+        on_nodes(test, f, [dest])
+
+    def heal(self, test):
+        def f(t, node):
+            with su():
+                exec_("ipf", "-Fa")
+        on_nodes(test, f)
+
+
+ipfilter = IPFilterNet()

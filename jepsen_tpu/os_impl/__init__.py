@@ -1,0 +1,1 @@
+"""Concrete OS implementations (debian, container) over the control layer."""

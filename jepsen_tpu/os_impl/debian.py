@@ -1,0 +1,119 @@
+"""Debian OS automation (jepsen/src/jepsen/os/debian.clj): apt package
+management, repo/key management, and the base-package setup the harness
+needs on every db node."""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..control.core import RemoteError, exec_, lit, su
+from ..control.util import meh
+from ..os_ import OS
+
+log = logging.getLogger("jepsen.os.debian")
+
+BASE_PACKAGES = ["wget", "curl", "vim", "man-db", "faketime", "ntpdate",
+                 "unzip", "iptables", "psmisc", "tar", "bzip2",
+                 "iputils-ping", "iproute2", "rsyslog", "logrotate",
+                 "gcc", "libc6-dev"]
+
+
+def setup_hostfile(nodes: Sequence[str]) -> None:
+    """Write /etc/hosts entries so nodes resolve each other by name
+    (debian.clj setup-hostfile!)."""
+    # Only meaningful with a cluster config that maps names to IPs; most
+    # deployments (docker compose) already resolve node names.
+
+
+def time_since_last_update() -> int:
+    """Seconds since the last apt update (debian.clj:33-42)."""
+    out = exec_("stat", "-c", "%Y", "/var/cache/apt/pkgcache.bin")
+    return int(time.time()) - int(out)
+
+
+def update() -> None:
+    exec_("apt-get", "update")
+
+
+def maybe_update() -> None:
+    """apt update if the cache is over a day old (debian.clj:44-50)."""
+    try:
+        if time_since_last_update() > 86400:
+            update()
+    except RemoteError:
+        update()
+
+
+def installed(packages: Sequence[str]) -> set:
+    """Which of these packages are installed? (debian.clj:52-62)"""
+    out = exec_("dpkg", "--get-selections", *packages)
+    got = set()
+    for line in out.split("\n"):
+        parts = line.split()
+        if len(parts) == 2 and parts[1] == "install":
+            got.add(parts[0])
+    return got
+
+
+def installed_version(package: str) -> Optional[str]:
+    """Installed version of a package (debian.clj:70-78)."""
+    out = exec_("dpkg-query", "-W", "-f", lit("'${Version}'"), package)
+    return out or None
+
+
+def uninstall(packages) -> None:
+    """Remove packages (debian.clj:80-87)."""
+    if isinstance(packages, str):
+        packages = [packages]
+    exec_("apt-get", "remove", "--purge", "-y", *packages)
+
+
+def install(packages, force: bool = False) -> None:
+    """Ensure packages are installed (debian.clj:89-98)."""
+    if isinstance(packages, str):
+        packages = [packages]
+    packages = list(packages)
+    if force:
+        missing = packages
+    else:
+        got = installed(packages)   # one dpkg round-trip for the lot
+        missing = [p for p in packages if p not in got]
+    if missing:
+        exec_("env", "DEBIAN_FRONTEND=noninteractive",
+              "apt-get", "install", "-y", *missing)
+
+
+def add_repo(name: str, line: str, keyserver: Optional[str] = None,
+             key: Optional[str] = None) -> None:
+    """Add an apt repo + optional signing key (debian.clj:100-119)."""
+    path = f"/etc/apt/sources.list.d/{name}.list"
+    exec_("echo", line, lit(">"), path)
+    if keyserver and key:
+        exec_("apt-key", "adv", "--keyserver", keyserver, "--recv", key)
+    update()
+
+
+def install_jdk() -> None:
+    """A headless JDK for JVM-based databases (debian.clj:121-135)."""
+    install(["default-jre-headless"])
+
+
+class DebianOS(OS):
+    """Base-package setup + network heal on every node
+    (debian.clj:137-167)."""
+
+    def setup(self, test, node):
+        log.info("%s setting up debian", node)
+        with su():
+            maybe_update()
+            install(BASE_PACKAGES)
+        net = test.get("net")
+        if net is not None:
+            meh(net.heal, test)
+
+    def teardown(self, test, node):
+        pass
+
+
+os = DebianOS()

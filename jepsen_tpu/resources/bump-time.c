@@ -1,0 +1,52 @@
+/* bump-time: jump the system wall clock by a signed millisecond delta.
+ *
+ * Usage: bump-time DELTA_MS
+ *
+ * Compiled on the db node by the harness (jepsen_tpu.nemesis.time) and
+ * invoked by the clock nemesis; functional counterpart of the
+ * reference's resources/bump-time.c. Uses clock_gettime/clock_settime
+ * on CLOCK_REALTIME and normalizes nanosecond carry.
+ */
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+int main(int argc, char **argv) {
+  long long delta_ms;
+  struct timespec ts;
+  char *end;
+
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s DELTA_MS\n", argv[0]);
+    return 2;
+  }
+  delta_ms = strtoll(argv[1], &end, 10);
+  if (*end != '\0') {
+    fprintf(stderr, "bad delta: %s\n", argv[1]);
+    return 2;
+  }
+
+  if (clock_gettime(CLOCK_REALTIME, &ts) != 0) {
+    perror("clock_gettime");
+    return 1;
+  }
+
+  ts.tv_sec += delta_ms / 1000;
+  ts.tv_nsec += (delta_ms % 1000) * 1000000LL;
+  while (ts.tv_nsec >= 1000000000L) {
+    ts.tv_nsec -= 1000000000L;
+    ts.tv_sec += 1;
+  }
+  while (ts.tv_nsec < 0) {
+    ts.tv_nsec += 1000000000L;
+    ts.tv_sec -= 1;
+  }
+
+  if (clock_settime(CLOCK_REALTIME, &ts) != 0) {
+    perror("clock_settime");
+    return 1;
+  }
+  return 0;
+}

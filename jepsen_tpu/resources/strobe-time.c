@@ -1,0 +1,85 @@
+/* strobe-time: flip the wall clock between its true value and a skewed
+ * value every PERIOD_MS, for DURATION_S seconds.
+ *
+ * Usage: strobe-time DELTA_MS PERIOD_MS DURATION_S
+ *
+ * The true time is tracked against CLOCK_MONOTONIC so repeated
+ * settime calls don't accumulate drift: at each flip we recompute what
+ * the wall clock *should* read from the monotonic anchor, then set it
+ * either to that or to that plus DELTA_MS. Functional counterpart of
+ * the reference's resources/strobe-time.c.
+ */
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long ts_to_ns(const struct timespec *ts) {
+  return (long long)ts->tv_sec * 1000000000LL + ts->tv_nsec;
+}
+
+static struct timespec ns_to_ts(long long ns) {
+  struct timespec ts;
+  ts.tv_sec = ns / 1000000000LL;
+  ts.tv_nsec = ns % 1000000000LL;
+  if (ts.tv_nsec < 0) {
+    ts.tv_nsec += 1000000000LL;
+    ts.tv_sec -= 1;
+  }
+  return ts;
+}
+
+int main(int argc, char **argv) {
+  long long delta_ms, period_ms, duration_s;
+  struct timespec mono0, real0, mono, set;
+  long long anchor;  /* real0 - mono0, in ns */
+  long long deadline_ns, now_mono_ns;
+  int skewed = 0;
+
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s DELTA_MS PERIOD_MS DURATION_S\n", argv[0]);
+    return 2;
+  }
+  delta_ms = atoll(argv[1]);
+  period_ms = atoll(argv[2]);
+  duration_s = atoll(argv[3]);
+  if (period_ms <= 0 || duration_s < 0) {
+    fprintf(stderr, "period must be positive; duration non-negative\n");
+    return 2;
+  }
+
+  if (clock_gettime(CLOCK_MONOTONIC, &mono0) != 0 ||
+      clock_gettime(CLOCK_REALTIME, &real0) != 0) {
+    perror("clock_gettime");
+    return 1;
+  }
+  anchor = ts_to_ns(&real0) - ts_to_ns(&mono0);
+  deadline_ns = ts_to_ns(&mono0) + duration_s * 1000000000LL;
+
+  for (;;) {
+    if (clock_gettime(CLOCK_MONOTONIC, &mono) != 0) {
+      perror("clock_gettime");
+      return 1;
+    }
+    now_mono_ns = ts_to_ns(&mono);
+    if (now_mono_ns >= deadline_ns)
+      break;
+
+    skewed = !skewed;
+    set = ns_to_ts(anchor + now_mono_ns +
+                   (skewed ? delta_ms * 1000000LL : 0));
+    if (clock_settime(CLOCK_REALTIME, &set) != 0) {
+      perror("clock_settime");
+      return 1;
+    }
+    usleep((useconds_t)(period_ms * 1000));
+  }
+
+  /* Restore the true time on the way out. */
+  if (clock_gettime(CLOCK_MONOTONIC, &mono) == 0) {
+    set = ns_to_ts(anchor + ts_to_ns(&mono));
+    clock_settime(CLOCK_REALTIME, &set);
+  }
+  return 0;
+}

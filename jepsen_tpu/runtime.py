@@ -249,7 +249,7 @@ def snarf_logs(test: dict) -> None:
         for remote in db.log_files(t, node) or []:
             local = store.path(str(node), remote.lstrip("/"))
             try:
-                download(t, node, remote, local)
+                download(remote, local)
             except Exception as e:
                 log.info("couldn't download %s from %s: %s", remote, node, e)
 
@@ -260,8 +260,13 @@ def snarf_logs(test: dict) -> None:
 
 
 def _on_nodes_local(test: dict, f: Callable) -> None:
-    """Apply f(test, node) to every node in parallel."""
+    """Apply f(test, node) to every node in parallel, with each node's
+    control session bound when the test runs over SSH."""
     nodes = test.get("nodes") or []
+    if test.get("sessions"):
+        from .control.core import on_nodes
+        on_nodes(test, f, nodes)
+        return
     errs = [e for e in _parallel([lambda n=n: f(test, n) for n in nodes])
             if isinstance(e, Exception)]
     if errs:

@@ -1,0 +1,142 @@
+"""Control layer: escaping, sudo/cd wrapping, dummy transport,
+parallel node execution (mirrors the semantics pinned by
+jepsen/src/jepsen/control.clj and its use sites)."""
+import threading
+
+import pytest
+
+from jepsen_tpu.control import core as c
+from jepsen_tpu.control.core import (DummyTransport, RemoteError, escape,
+                                     exec_, lit, on_nodes, session, su,
+                                     cd, with_session, with_ssh)
+
+
+# ---------------------------------------------------------------- escape
+
+def test_escape_basics():
+    assert escape(None) == ""
+    assert escape("") == '""'
+    assert escape("foo") == "foo"
+    assert escape(123) == "123"
+    assert escape("foo bar") == '"foo bar"'
+    assert escape('say "hi"') == '"say \\"hi\\""'
+    assert escape("$HOME") == '"\\$HOME"'
+    assert escape("back\\slash") == '"back\\\\slash"'
+    assert escape("semi;colon") == '"semi;colon"'
+    assert escape(["a", "b c"]) == 'a "b c"'
+    assert escape(lit("a | b")) == "a | b"
+
+
+# ------------------------------------------------------- dummy transport
+
+def dummy_session(host="n1", responder=None):
+    return session(host, {"dummy": True}, responder)
+
+
+def test_exec_records_commands():
+    s = dummy_session()
+    with with_session("n1", s):
+        out = exec_("echo", "hello world")
+    assert out == ""
+    assert s.transport.commands == ['cd /; echo "hello world"']
+
+
+def test_sudo_and_cd_wrapping():
+    s = dummy_session()
+    with with_session("n1", s):
+        with cd("/tmp"):
+            with su():
+                exec_("ls", "-la")
+    [cmd] = s.transport.commands
+    assert cmd == 'sudo -S -u root bash -c "cd /tmp; ls -la"'
+
+
+def test_cd_relative_expansion():
+    s = dummy_session()
+    with with_session("n1", s):
+        with cd("/opt"):
+            with cd("jepsen"):
+                exec_("pwd")
+    [cmd] = s.transport.commands
+    assert cmd.startswith("cd /opt/jepsen;")
+
+
+def test_nonzero_exit_raises_remote_error():
+    def responder(host, cmd):
+        if "fail" in cmd:
+            return "", "boom", 1
+        return "ok\n", "", 0
+
+    s = dummy_session(responder=responder)
+    with with_session("n1", s):
+        assert exec_("echo", "ok") == "ok"
+        with pytest.raises(RemoteError, match="boom"):
+            exec_("fail")
+
+
+def test_no_session_raises():
+    with pytest.raises(RuntimeError, match="No SSH session"):
+        exec_("ls")
+
+
+def test_with_ssh_and_on_nodes():
+    test = {"nodes": ["n1", "n2", "n3"], "ssh": {"dummy": True}}
+    hosts_seen = []
+    lock = threading.Lock()
+
+    with with_ssh(test):
+        assert set(test["sessions"]) == {"n1", "n2", "n3"}
+
+        def f(t, node):
+            exec_("hostname")
+            with lock:
+                hosts_seen.append(node)
+            return node.upper()
+
+        out = on_nodes(test, f)
+    assert out == {"n1": "N1", "n2": "N2", "n3": "N3"}
+    assert sorted(hosts_seen) == ["n1", "n2", "n3"]
+    assert "sessions" not in test
+
+
+def test_upload_bytes_uses_base64():
+    s = dummy_session()
+    with with_session("n1", s):
+        c.upload_bytes(b"int main(){}", "/opt/jepsen/x.c")
+    [cmd] = s.transport.commands
+    assert "base64 -d > /opt/jepsen/x.c" in cmd
+
+
+# ---------------------------------------------------------- control.util
+
+def test_daemon_helpers_issue_expected_commands():
+    from jepsen_tpu.control import util as cu
+
+    def responder(host, cmd):
+        if "stat" in cmd:
+            return "", "no such file", 1  # nothing exists
+        return "", "", 0
+
+    s = dummy_session(responder=responder)
+    with with_session("n1", s):
+        cu.start_daemon({"logfile": "/var/log/db.log",
+                         "pidfile": "/var/run/db.pid",
+                         "chdir": "/opt/db"},
+                        "/opt/db/bin/db", "--port", 1234)
+        cu.stop_daemon("/var/run/db.pid")
+    cmds = s.transport.commands
+    assert any("start-stop-daemon --start" in x and
+               "--pidfile /var/run/db.pid" in x and
+               "--chdir /opt/db" in x for x in cmds)
+    # stop on a nonexistent pidfile is a no-op beyond the stat
+    assert not any("kill -9" in x for x in cmds)
+
+
+def test_grepkill_pipeline():
+    from jepsen_tpu.control import util as cu
+    s = dummy_session()
+    with with_session("n1", s):
+        cu.grepkill("etcd")
+    [cmd] = s.transport.commands
+    assert "ps aux | grep etcd | grep -v grep" in cmd
+    assert "xargs -r kill -9" in cmd

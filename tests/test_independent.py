@@ -1,0 +1,202 @@
+"""Independent-key lifting (mirrors independent_test.clj) + adya G2."""
+import threading
+
+import pytest
+
+import jepsen_tpu.gen as g
+from jepsen_tpu import independent
+from jepsen_tpu.adya import g2_gen, g2_checker
+from jepsen_tpu.checkers.linearizable import linearizable
+from jepsen_tpu.history.core import index
+from jepsen_tpu.history.ops import invoke_op, ok_op, Op
+from jepsen_tpu.independent import (KV, sequential_generator,
+                                    concurrent_generator, history_keys,
+                                    subhistory)
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.runtime import run
+from jepsen_tpu.testing import AtomClient, AtomRegister, noop_test
+
+
+def ctx(threads, concurrency=None):
+    import time
+    from random import Random
+    return g.Context(threads=tuple(threads),
+                     concurrency=concurrency or
+                     len([t for t in threads if isinstance(t, int)]),
+                     rng=Random(0), time_nanos=time.monotonic_ns)
+
+
+def test_kv_tuple():
+    kv = KV("k1", 42)
+    assert kv.key == "k1" and kv.value == 42
+    assert tuple(kv) == ("k1", 42)
+
+
+def test_sequential_generator():
+    gen = sequential_generator(["a", "b"],
+                               lambda k: g.limit(2, {"f": "w", "value": k}))
+    c = ctx((0,))
+    ops = []
+    while True:
+        o = g.op(gen, {}, 0, c)
+        if o is None:
+            break
+        ops.append(o["value"])
+    assert ops == [KV("a", "a"), KV("a", "a"), KV("b", "b"), KV("b", "b")]
+
+
+def test_concurrent_generator_groups():
+    seen = {}
+    lock = threading.Lock()
+
+    def fgen(k):
+        def probe(test, process, c):
+            with lock:
+                seen.setdefault(k, set()).add(c.threads)
+            return None  # immediately exhausted after recording
+        return g.concat(g.limit(2, {"f": "w"}), g._Fn(probe))
+
+    gen = concurrent_generator(2, ["a", "b", "c"], fgen)
+    test = {"concurrency": 4}
+    c = ctx((0, 1, 2, 3))
+    # threads 0,1 are group 0 (key a); 2,3 group 1 (key b)
+    o = g.op(gen, test, 0, c)
+    assert o["value"].key == "a"
+    o = g.op(gen, test, 2, c)
+    assert o["value"].key == "b"
+    o = g.op(gen, test, 3, c)
+    assert o["value"].key == "b"
+
+
+def test_concurrent_generator_bad_thread_counts():
+    gen = concurrent_generator(3, ["a"], lambda k: {"f": "w"})
+    with pytest.raises(AssertionError, match="multiple of 3"):
+        g.op(gen, {"concurrency": 4}, 0, ctx((0, 1, 2, 3)))
+    gen2 = concurrent_generator(5, ["a"], lambda k: {"f": "w"})
+    with pytest.raises(AssertionError, match="at least 5"):
+        g.op(gen2, {"concurrency": 2}, 0, ctx((0, 1)))
+
+
+def test_history_keys_and_subhistory():
+    h = index([
+        invoke_op(0, "write", KV("a", 1)),
+        Op(process="nemesis", type="info", f="start", value=None),
+        ok_op(0, "write", KV("a", 1)),
+        invoke_op(1, "read", KV("b", None)),
+        ok_op(1, "read", KV("b", 2)),
+    ])
+    assert history_keys(h) == ["a", "b"]
+    sa = subhistory("a", h)
+    # unkeyed nemesis op appears; b ops don't; values unwrapped
+    assert [o.f for o in sa] == ["write", "start", "write"]
+    assert sa[0].value == 1
+    sb = subhistory("b", h)
+    assert [o.f for o in sb] == ["start", "read", "read"]
+    assert sb[2].value == 2
+
+
+def _keyed_register_history():
+    """Two keys: key a linearizable, key b violated."""
+    return index([
+        invoke_op(0, "write", KV("a", 1)), ok_op(0, "write", KV("a", 1)),
+        invoke_op(1, "write", KV("b", 1)), ok_op(1, "write", KV("b", 1)),
+        invoke_op(0, "read", KV("a", None)), ok_op(0, "read", KV("a", 1)),
+        invoke_op(1, "read", KV("b", None)), ok_op(1, "read", KV("b", 9)),
+    ])
+
+
+def test_independent_checker():
+    r = independent.checker(linearizable()).check(
+        {}, cas_register(), _keyed_register_history())
+    assert r["valid"] is False
+    assert r["failures"] == ["b"]
+    assert r["results"]["a"]["valid"] is True
+    assert r["results"]["b"]["valid"] is False
+
+
+def test_batch_checker_matches_per_key():
+    r = independent.batch_checker().check(
+        {}, cas_register(), _keyed_register_history())
+    assert r["valid"] is False
+    assert r["failures"] == ["b"]
+    assert r["results"]["a"]["valid"] is True
+    assert r["results"]["b"]["valid"] is False
+    # the failing op is b's bad read
+    assert r["results"]["b"]["op"]["value"] == 9
+
+
+class KeyedAtomClient(AtomClient):
+    """Routes KV-valued register ops to per-key registers."""
+
+    def __init__(self, registers=None):
+        self.registers = registers if registers is not None else {}
+        self._lock = threading.Lock()
+
+    def setup(self, test, node):
+        c = KeyedAtomClient(self.registers)
+        c._lock = self._lock
+        return c
+
+    def invoke(self, test, op):
+        kv = op["value"]
+        k, v = kv.key, kv.value
+        with self._lock:
+            reg = self.registers.setdefault(k, AtomRegister())
+        inner = {**op, "value": v}
+        self.register = reg
+        out = AtomClient.invoke(self, test, inner)
+        return {**out, "value": KV(k, out.get("value"))}
+
+
+def test_end_to_end_concurrent_keys_tpu_batch():
+    """Full pipeline: concurrent keyed workload on the fake cluster →
+    TPU-batched independent linearizability check."""
+    gen = concurrent_generator(
+        2, ["k0", "k1", "k2"],
+        lambda k: g.limit(20, g.cas_gen(n_values=3)))
+    t = run(noop_test(
+        name="independent-atomic",
+        concurrency=4,
+        client=KeyedAtomClient(),
+        generator=g.clients(gen),
+        checker=independent.batch_checker(),
+        model=cas_register()))
+    r = t["results"]
+    assert r["valid"] is True, r
+    assert sorted(r["results"]) == ["k0", "k1", "k2"]
+
+
+def test_g2_checker():
+    h = index([
+        invoke_op(0, "insert", KV(1, [None, 1])),
+        ok_op(0, "insert", KV(1, [None, 1])),
+        invoke_op(1, "insert", KV(1, [2, None])),
+        ok_op(1, "insert", KV(1, [2, None])),     # both committed: G2!
+        invoke_op(0, "insert", KV(2, [None, 3])),
+        ok_op(0, "insert", KV(2, [None, 3])),
+    ])
+    r = g2_checker().check({}, None, h)
+    assert r["valid"] is False
+    assert r["illegal"] == {1: 2}
+    assert r["key-count"] == 2
+
+
+def test_g2_gen_shape():
+    gen = g2_gen()
+    test = {"concurrency": 4}
+    c = ctx((0, 1, 2, 3))
+    o0 = g.op(gen, test, 0, c)
+    o1 = g.op(gen, test, 1, c)
+    assert o0["f"] == "insert"
+    k0, v0 = o0["value"].key, o0["value"].value
+    k1, v1 = o1["value"].key, o1["value"].value
+    assert k0 == k1  # same group, same key
+    # one op has only a-id, the other only b-id
+    shapes = sorted((v0.index(None), v1.index(None)))
+    assert shapes == [0, 1]
+    ids = [x for x in v0 + v1 if x is not None]
+    assert len(ids) == 2 and len(set(ids)) == 2  # globally unique ids
+    # two more draws for the same group advance to a NEW key
+    o2 = g.op(gen, test, 0, c)
+    o3 = g.op(gen, test, 1, c)
+    assert o2["value"].key == o3["value"].key != k0

@@ -1,0 +1,127 @@
+"""Full-lifecycle integration over the dummy SSH transport (the
+reference's ssh-test seam, core_test.clj:30-84): OS setup, DB cycle with
+a cluster-wide barrier, partitioning nemesis, log snarfing — zero real
+SSH, zero real database."""
+import threading
+
+import pytest
+
+import jepsen_tpu.gen as g
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.checkers.linearizable import linearizable
+from jepsen_tpu.control.core import exec_
+from jepsen_tpu.db import DB
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.os_ import OS
+from jepsen_tpu.runtime import run, synchronize
+from jepsen_tpu.testing import AtomClient, noop_test
+
+
+class RecordingOS(OS):
+    def __init__(self):
+        self.setups = []
+        self.teardowns = []
+        self._lock = threading.Lock()
+
+    def setup(self, test, node):
+        exec_("echo", "os-setup")
+        with self._lock:
+            self.setups.append(node)
+
+    def teardown(self, test, node):
+        with self._lock:
+            self.teardowns.append(node)
+
+
+class BarrierDB(DB):
+    """DB whose setup uses the cluster-wide barrier, as real suites do
+    (e.g. rabbitmq.clj:67,79)."""
+
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def setup(self, test, node):
+        exec_("echo", "db-install")
+        synchronize(test)  # all nodes must reach this point
+        with self._lock:
+            self.events.append(("setup", node))
+
+    def teardown(self, test, node):
+        with self._lock:
+            self.events.append(("teardown", node))
+
+    def setup_primary(self, test, node):
+        with self._lock:
+            self.events.append(("primary", node))
+
+    def log_files(self, test, node):
+        return ["/var/log/db.log"]
+
+
+class FakeNet:
+    def __init__(self):
+        self.drops = []
+        self.heals = 0
+        self._lock = threading.Lock()
+
+    def drop(self, test, src, dest):
+        with self._lock:
+            self.drops.append((src, dest))
+
+    def heal(self, test):
+        with self._lock:
+            self.heals += 1
+
+
+def test_full_lifecycle_with_dummy_ssh():
+    os_ = RecordingOS()
+    db = BarrierDB()
+    net = FakeNet()
+    nodes = ["n1", "n2", "n3", "n4", "n5"]
+    t = run(noop_test(
+        name="dummy-cluster",
+        nodes=nodes,
+        concurrency=5,
+        ssh={"dummy": True},
+        os=os_,
+        db=db,
+        net=net,
+        client=AtomClient(),
+        nemesis=nem.partition_random_halves(),
+        generator=g.nemesis(
+            g.seq([{"type": "info", "f": "start"},
+                   {"type": "info", "f": "stop"}]),
+            g.limit(60, g.cas_gen())),
+        checker=linearizable(),
+        model=cas_register()))
+
+    assert t["results"]["valid"] is True
+    assert sorted(os_.setups) == nodes
+    assert sorted(os_.teardowns) == nodes
+    # db cycle = teardown + setup on every node, plus one primary setup
+    assert sorted(n for e, n in db.events if e == "setup") == nodes
+    assert ("primary", "n1") in db.events
+    # nemesis actually cut and healed the fake network
+    assert net.drops
+    assert net.heals >= 2  # setup heal + stop heal + teardown heal
+    # nemesis ops are in the history
+    nem_fs = [o.f for o in t["history"] if o.is_nemesis]
+    assert "start" in nem_fs and "stop" in nem_fs
+
+
+def test_db_setup_failure_tears_down():
+    class ExplodingDB(DB):
+        def setup(self, test, node):
+            raise RuntimeError("db install failed")
+
+    os_ = RecordingOS()
+    with pytest.raises(RuntimeError, match="db install failed"):
+        run(noop_test(
+            nodes=["n1", "n2"],
+            ssh={"dummy": True},
+            os=os_,
+            db=ExplodingDB(),
+            generator=g.clients(g.limit(5, {"f": "ping"}))))
+    # OS teardown still ran on both nodes
+    assert sorted(os_.teardowns) == ["n1", "n2"]

@@ -1,0 +1,204 @@
+"""Nemesis library: grudge math (mirrors nemesis_test.clj:39-87),
+partitioner behavior through a fake Net, composition routing, and the
+clock nemesis command stream over the dummy transport."""
+import random
+import subprocess
+import threading
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control.core import with_ssh
+from jepsen_tpu.utils.core import majority
+
+
+# ------------------------------------------------------------ grudge math
+
+def test_bisect():
+    assert nem.bisect([]) == [[], []]
+    assert nem.bisect([1]) == [[], [1]]
+    assert nem.bisect([1, 2, 3, 4]) == [[1, 2], [3, 4]]
+    assert nem.bisect([1, 2, 3, 4, 5]) == [[1, 2], [3, 4, 5]]
+
+
+def test_split_one():
+    assert nem.split_one([1, 2, 3], loner=2) == [[2], [1, 3]]
+
+
+def test_complete_grudge():
+    g = nem.complete_grudge(nem.bisect(["n1", "n2", "n3", "n4", "n5"]))
+    assert g["n1"] == {"n3", "n4", "n5"}
+    assert g["n2"] == {"n3", "n4", "n5"}
+    assert g["n3"] == {"n1", "n2"}
+    assert g["n5"] == {"n1", "n2"}
+
+
+def test_bridge():
+    g = nem.bridge(["n1", "n2", "n3", "n4", "n5"])
+    # n3 is the bridge: snubs nobody, snubbed by nobody
+    assert "n3" not in g
+    assert g["n1"] == {"n4", "n5"}
+    assert g["n2"] == {"n4", "n5"}
+    assert g["n4"] == {"n1", "n2"}
+    assert g["n5"] == {"n1", "n2"}
+
+
+@pytest.mark.parametrize("n", [3, 5, 7, 9])
+def test_majorities_ring(n):
+    """Ring-walk proof (nemesis_test.clj:51-87): every node sees a
+    majority; no two nodes see the same majority."""
+    nodes = [f"n{i}" for i in range(n)]
+    g = nem.majorities_ring(nodes, random.Random(5))
+    assert len(g) == n
+    m = majority(n)
+    views = set()
+    for node, rejects in g.items():
+        visible = set(nodes) - set(rejects)
+        assert node in visible
+        assert len(visible) == m
+        views.add(frozenset(visible))
+    assert len(views) == n  # all majorities distinct
+
+
+# ---------------------------------------------------------- partitioners
+
+class FakeNet:
+    def __init__(self):
+        self.drops = []
+        self.heals = 0
+        self._lock = threading.Lock()
+
+    def drop(self, test, src, dest):
+        with self._lock:
+            self.drops.append((src, dest))
+
+    def heal(self, test):
+        with self._lock:
+            self.heals += 1
+
+
+def mktest(nodes):
+    return {"nodes": nodes, "net": FakeNet(), "ssh": {"dummy": True}}
+
+
+def test_partitioner_start_stop():
+    test = mktest(["n1", "n2", "n3", "n4", "n5"])
+    with with_ssh(test):
+        p = nem.partition_halves().setup(test, None)
+        assert test["net"].heals == 1
+        out = p.invoke(test, {"type": "info", "f": "start"})
+        assert "Cut off" in out["value"]
+        # every cross-half pair dropped, in both directions
+        drops = set(test["net"].drops)
+        assert ("n1", "n3") in drops and ("n3", "n1") in drops
+        assert ("n2", "n5") in drops
+        assert not any(s in ("n1", "n2") and d in ("n1", "n2")
+                       for s, d in drops)
+        out = p.invoke(test, {"type": "info", "f": "stop"})
+        assert out["value"] == "fully connected"
+        assert test["net"].heals == 2
+
+
+def test_compose_routing():
+    class Recorder(nem.Noop):
+        def __init__(self):
+            self.ops = []
+
+        def invoke(self, test, op):
+            self.ops.append(op["f"])
+            return op
+
+    a, b = Recorder(), Recorder()
+    composed = nem.compose([(frozenset(["start", "stop"]), a),
+                            ({"kill-start": "start"}, b)])
+    composed.invoke({}, {"f": "start"})
+    composed.invoke({}, {"f": "kill-start"})
+    assert a.ops == ["start"]
+    assert b.ops == ["start"]  # renamed through the dict router
+    with pytest.raises(ValueError, match="no nemesis"):
+        composed.invoke({}, {"f": "mystery"})
+
+
+def test_node_start_stopper():
+    test = mktest(["n1", "n2", "n3"])
+    calls = []
+    with with_ssh(test):
+        n = nem.node_start_stopper(
+            lambda nodes: nodes[0],
+            lambda t, node: calls.append(("start", node)) or "started",
+            lambda t, node: calls.append(("stop", node)) or "stopped")
+        out = n.invoke(test, {"type": "info", "f": "start"})
+        assert out["value"] == {"n1": "started"}
+        # double start is rejected
+        out = n.invoke(test, {"type": "info", "f": "start"})
+        assert "already disrupting" in out["value"]
+        out = n.invoke(test, {"type": "info", "f": "stop"})
+        assert out["value"] == {"n1": "stopped"}
+        out = n.invoke(test, {"type": "info", "f": "stop"})
+        assert out["value"] == "not-started"
+    assert calls == [("start", "n1"), ("stop", "n1")]
+
+
+def test_hammer_time_commands():
+    test = mktest(["n1"])
+    with with_ssh(test):
+        h = nem.hammer_time("etcd", targeter=lambda nodes: nodes[0])
+        h.invoke(test, {"type": "info", "f": "start"})
+        h.invoke(test, {"type": "info", "f": "stop"})
+        cmds = test["sessions"]["n1"].transport.commands
+    assert any("killall -s STOP etcd" in x for x in cmds)
+    assert any("killall -s CONT etcd" in x for x in cmds)
+
+
+def test_truncate_file_commands():
+    test = mktest(["n1", "n2"])
+    with with_ssh(test):
+        tr = nem.truncate_file()
+        tr.invoke(test, {"type": "info", "f": "truncate",
+                         "value": {"n2": {"file": "/data/wal", "drop": 64}}})
+        assert not test["sessions"]["n1"].transport.commands
+        cmds = test["sessions"]["n2"].transport.commands
+    assert any("truncate -c -s -64 /data/wal" in x for x in cmds)
+
+
+# ------------------------------------------------------------ clock tools
+
+def test_clock_nemesis_command_stream():
+    from jepsen_tpu.nemesis.time import clock_nemesis
+    test = mktest(["n1", "n2"])
+    with with_ssh(test):
+        cn = clock_nemesis().setup(test, None)
+        cn.invoke(test, {"type": "info", "f": "bump",
+                         "value": {"n1": 500}})
+        cn.invoke(test, {"type": "info", "f": "strobe",
+                         "value": {"n2": {"delta": 100, "period": 10,
+                                          "duration": 5}}})
+        c1 = test["sessions"]["n1"].transport.commands
+        c2 = test["sessions"]["n2"].transport.commands
+    # setup compiled the tools on both nodes
+    assert any("gcc" in x and "bump-time" in x for x in c1)
+    assert any("gcc" in x and "strobe-time" in x for x in c2)
+    assert any("/opt/jepsen/bump-time 500" in x for x in c1)
+    assert any("/opt/jepsen/strobe-time 100 10 5" in x for x in c2)
+
+
+def test_c_resources_compile(tmp_path):
+    """The shipped C sources must compile cleanly with the local gcc."""
+    res = Path("jepsen_tpu/resources")
+    for src in ["bump-time.c", "strobe-time.c"]:
+        out = tmp_path / src.replace(".c", "")
+        r = subprocess.run(["gcc", "-O2", "-Wall", "-o", str(out),
+                            str(res / src)],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        # both refuse bad argument counts with exit 2
+        r = subprocess.run([str(out)], capture_output=True)
+        assert r.returncode == 2
+
+
+def test_faketime_script():
+    from jepsen_tpu.faketime import script, rand_rate
+    s = script("/usr/bin/db", 1.5)
+    assert "faketime" in s and "/usr/bin/db.real" in s
+    assert 0 < rand_rate(random.Random(1)) <= 5
