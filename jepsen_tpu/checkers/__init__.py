@@ -15,3 +15,5 @@ from .simple import (
     counter_checker,
 )
 from .linearizable import linearizable, LinearizableChecker
+from .perf import latency_graph, perf, rate_graph_checker
+from .timeline import html_timeline

@@ -1,0 +1,204 @@
+"""Performance graphs: latency and throughput over the test timeline.
+
+Mirrors jepsen/src/jepsen/checker/perf.clj, rendered with matplotlib
+instead of a gnuplot subprocess: raw latency scatter by completion type
+(perf.clj:221-245), latency quantiles (247-283), throughput rate
+(294-332), with shaded nemesis activity regions (190-202). The
+bucketing/quantile math is pure and unit-testable (16-80).
+"""
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..history.ops import Op, INVOKE, OK, FAIL, INFO
+from ..utils.core import nemesis_intervals
+from .core import Checker
+
+DEFAULT_QUANTILES = (0.5, 0.95, 0.99, 1.0)
+
+TYPE_COLORS = {OK: "#81BFFC", INFO: "#FFA400", FAIL: "#FF1E90"}
+
+
+def bucket_scale(dt: float, b: int) -> float:
+    """The center time of bucket b, given bucket width dt
+    (perf.clj:16-24)."""
+    return b * dt + dt / 2
+
+
+def bucket_time(dt: float, t: float) -> float:
+    """Map a time to its bucket's center (perf.clj:26-31)."""
+    return bucket_scale(dt, int(t // dt))
+
+
+def buckets(dt: float, pairs: Sequence[Tuple[float, object]]
+            ) -> Dict[float, List[object]]:
+    """Group (time, x) pairs into dt-width buckets keyed by center time
+    (perf.clj:33-44)."""
+    out: Dict[float, List[object]] = defaultdict(list)
+    for t, x in pairs:
+        out[bucket_time(dt, t)].append(x)
+    return dict(out)
+
+
+def quantile(q: float, xs: Sequence[float]) -> float:
+    """The q-quantile of xs (nearest-rank; perf.clj:46-55)."""
+    if not xs:
+        raise ValueError("empty sequence")
+    s = sorted(xs)
+    i = min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))
+    return s[i]
+
+
+def latencies_by_quantiles(dt: float, qs: Sequence[float],
+                           points: Sequence[Tuple[float, float]]
+                           ) -> Dict[float, List[Tuple[float, float]]]:
+    """{q: [(bucket-time, latency-quantile)]} (perf.clj:57-80)."""
+    bs = buckets(dt, points)
+    out: Dict[float, List[Tuple[float, float]]] = {q: [] for q in qs}
+    for t in sorted(bs):
+        for q in qs:
+            out[q].append((t, quantile(q, bs[t])))
+    return out
+
+
+def _completion_latencies(history: Sequence[Op]):
+    """[(completion-time-s, latency-s, completion-type)] for client ops."""
+    from ..history.core import pairs
+    out = []
+    for inv, comp in pairs(history):
+        if comp is None or not inv.is_client:
+            continue
+        if inv.time is None or comp.time is None:
+            continue
+        out.append((comp.time / 1e9, (comp.time - inv.time) / 1e9,
+                    comp.type))
+    return out
+
+
+def _nemesis_regions_s(history: Sequence[Op]):
+    end = max((op.time or 0) for op in history) / 1e9 if history else 0
+    return [((a.time or 0) / 1e9,
+             (b.time / 1e9) if b is not None and b.time is not None else end)
+            for a, b in nemesis_intervals(history)]
+
+
+def _plot_base(history):
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(10, 5))
+    for lo, hi in _nemesis_regions_s(history):
+        ax.axvspan(lo, hi, color="#CCCCCC", alpha=0.5, zorder=0)
+    ax.set_xlabel("time (s)")
+    return plt, fig, ax
+
+
+def point_graph(history: Sequence[Op], path: str) -> str:
+    """Raw latency scatter, colored by completion type
+    (perf.clj:221-245)."""
+    plt, fig, ax = _plot_base(history)
+    pts = _completion_latencies(history)
+    for typ in (OK, INFO, FAIL):
+        xs = [t for t, l, ty in pts if ty == typ]
+        ys = [l for t, l, ty in pts if ty == typ]
+        if xs:
+            ax.scatter(xs, ys, s=4, label=typ, color=TYPE_COLORS[typ])
+    ax.set_yscale("log")
+    ax.set_ylabel("latency (s)")
+    ax.legend(loc="upper right")
+    ax.set_title("latency raw")
+    fig.savefig(path, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    return path
+
+
+def quantiles_graph(history: Sequence[Op], path: str,
+                    dt: float = 10.0,
+                    qs: Sequence[float] = DEFAULT_QUANTILES) -> str:
+    """Latency quantiles over time (perf.clj:247-283)."""
+    plt, fig, ax = _plot_base(history)
+    pts = [(t, l) for t, l, ty in _completion_latencies(history)
+           if ty == OK]
+    if pts:
+        for q, series in latencies_by_quantiles(dt, qs, pts).items():
+            ax.plot([t for t, _ in series], [l for _, l in series],
+                    marker="o", markersize=3, label=f"q={q}")
+    ax.set_yscale("log")
+    ax.set_ylabel("latency (s)")
+    ax.legend(loc="upper right")
+    ax.set_title("latency quantiles")
+    fig.savefig(path, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    return path
+
+
+def rate_graph(history: Sequence[Op], path: str, dt: float = 10.0) -> str:
+    """Completions/sec by f and type (perf.clj:294-332)."""
+    plt, fig, ax = _plot_base(history)
+    series: Dict[Tuple[str, str], Dict[float, int]] = defaultdict(
+        lambda: defaultdict(int))
+    for op in history:
+        if op.is_client and op.is_completion and op.time is not None:
+            series[(op.f, op.type)][bucket_time(dt, op.time / 1e9)] += 1
+    for (f, typ), bucketed in sorted(series.items()):
+        ts = sorted(bucketed)
+        ax.plot(ts, [bucketed[t] / dt for t in ts], marker="o",
+                markersize=3, label=f"{f} {typ}",
+                color=None if typ == OK else TYPE_COLORS.get(typ))
+    ax.set_ylabel("throughput (hz)")
+    ax.legend(loc="upper right")
+    ax.set_title("rate")
+    fig.savefig(path, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    return path
+
+
+def _out_path(test, opts, name):
+    store = (opts or {}).get("store") or test.get("store_handle")
+    if store is None:
+        return None
+    sub = list((opts or {}).get("subdirectory", []))
+    return store.path(*sub, name)
+
+
+class LatencyGraph(Checker):
+    """Renders latency-raw.png + latency-quantiles.png
+    (checker.clj:390-396)."""
+
+    def check(self, test, model, history, opts=None) -> dict:
+        p = _out_path(test, opts, "latency-raw.png")
+        if p is None:
+            return {"valid": True, "skipped": "no store attached"}
+        point_graph(history, p)
+        quantiles_graph(history,
+                        _out_path(test, opts, "latency-quantiles.png"))
+        return {"valid": True}
+
+
+class RateGraph(Checker):
+    """Renders rate.png (checker.clj:398-404)."""
+
+    def check(self, test, model, history, opts=None) -> dict:
+        p = _out_path(test, opts, "rate.png")
+        if p is None:
+            return {"valid": True, "skipped": "no store attached"}
+        rate_graph(history, p)
+        return {"valid": True}
+
+
+def latency_graph() -> Checker:
+    return LatencyGraph()
+
+
+def rate_graph_checker() -> Checker:
+    return RateGraph()
+
+
+def perf() -> Checker:
+    """Composes latency + rate graphs (checker.clj:406-411)."""
+    from .core import compose
+    return compose({"latency-graph": latency_graph(),
+                    "rate-graph": rate_graph_checker()})

@@ -1,0 +1,86 @@
+"""HTML timeline: one column per process, one bar per operation.
+
+Mirrors jepsen/src/jepsen/checker/timeline.clj: pairs invocations with
+completions (timeline.clj:32-52) and renders an HTML/CSS grid where each
+op is a positioned block colored by completion type, with hover detail.
+"""
+from __future__ import annotations
+
+import html
+from typing import List, Optional, Sequence, Tuple
+
+from ..history.core import pairs
+from ..history.ops import Op, OK, FAIL, INFO
+from .core import Checker
+
+TYPE_COLORS = {OK: "#6DB6FE", INFO: "#FFAA26", FAIL: "#FEB5DA",
+               None: "#eeeeee"}
+
+STYLE = """
+body { font-family: sans-serif; }
+.ops { position: relative; }
+.op { position: absolute; padding: 2px; border-radius: 2px;
+      font-size: 9px; overflow: hidden; border: 1px solid #888;
+      box-sizing: border-box; width: 120px; }
+.label { position: absolute; font-size: 11px; font-weight: bold; }
+"""
+
+PX_PER_S = 100.0
+COL_W = 124
+
+
+def render_op(inv: Op, comp: Optional[Op], end_s: float, col: int) -> str:
+    t0 = (inv.time or 0) / 1e9
+    t1 = (comp.time / 1e9) if comp is not None and comp.time is not None \
+        else end_s
+    color = TYPE_COLORS.get(comp.type if comp is not None else None)
+    comp_desc = f"{comp.type} {comp.value!r}" if comp is not None else "?"
+    title = (f"{inv.process} {inv.f} {inv.value!r} → {comp_desc} "
+             f"[{t0:.3f}s – {t1:.3f}s]")
+    body = f"{html.escape(str(inv.f))} {html.escape(repr(inv.value))}"
+    if comp is not None and comp.value != inv.value:
+        body += f"<br>{html.escape(repr(comp.value))}"
+    top = t0 * PX_PER_S
+    height = max(12.0, (t1 - t0) * PX_PER_S)
+    left = (col + 1) * COL_W
+    return (f'<div class="op" title="{html.escape(title)}" '
+            f'style="left:{left}px;top:{top:.1f}px;'
+            f'height:{height:.1f}px;background:{color}">{body}</div>')
+
+
+def render_html(test: dict, history: Sequence[Op]) -> str:
+    client_ops = [op for op in history if op.is_client]
+    end_s = max(((op.time or 0) for op in history), default=0) / 1e9
+    # One column per distinct process, in order of first appearance
+    # (retired process ids get their own columns, as in the reference).
+    col_of = {}
+    for op in client_ops:
+        col_of.setdefault(op.process, len(col_of))
+    labels = [f'<div class="label" style="left:{(i + 1) * COL_W}px">'
+              f"process {p}</div>" for p, i in col_of.items()]
+    blocks = [render_op(inv, comp, end_s, col_of[inv.process])
+              for inv, comp in pairs(client_ops)]
+    return (f"<html><head><style>{STYLE}</style></head><body>"
+            f"<h1>{html.escape(str(test.get('name', 'test')))}</h1>"
+            f'<div class="ops" style="height:'
+            f"{end_s * PX_PER_S + 40:.0f}px\">"
+            + "".join(labels) + "".join(blocks)
+            + "</div></body></html>")
+
+
+class Timeline(Checker):
+    """Writes timeline.html into the run dir (timeline.clj:92-111)."""
+
+    def check(self, test, model, history, opts=None) -> dict:
+        store = (opts or {}).get("store") or test.get("store_handle")
+        if store is None:
+            return {"valid": True, "skipped": "no store attached"}
+        sub = list((opts or {}).get("subdirectory", []))
+        path = store.path(*sub, "timeline.html")
+        with open(path, "w") as f:
+            f.write(render_html(test, list(history)))
+        return {"valid": True}
+
+
+def html_timeline() -> Checker:
+    return Timeline()

@@ -1,0 +1,178 @@
+"""Command-line runner scaffolding for test suites.
+
+Mirrors jepsen/src/jepsen/cli.clj: suites call ``run_cli`` with a
+subcommand map; the standard ``test`` subcommand parses shared flags
+(nodes, ssh, "3n" concurrency units, time limit, test count), builds a
+test via the suite's test_fn, runs it ``--test-count`` times, and exits
+1 on the first invalid result. ``serve`` starts the results web UI.
+
+Exit codes (cli.clj:201-276): 0 ok, 1 invalid analysis, 254 bad
+arguments/usage, 255 crash.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import re
+import sys
+import traceback
+from typing import Callable, Dict, List, Optional, Sequence
+
+DEFAULT_NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def parse_concurrency(s: str, n_nodes: int) -> int:
+    """"5" → 5; "3n" → 3 * node count (cli.clj:27-42)."""
+    m = re.fullmatch(r"(\d+)(n?)", s.strip())
+    if not m:
+        raise ValueError(f"{s!r} should be an integer optionally followed "
+                         f"by n")
+    units = int(m.group(1))
+    return units * n_nodes if m.group(2) else units
+
+
+def add_test_opts(p: argparse.ArgumentParser) -> None:
+    """The standard test flag set (test-opt-spec, cli.clj:52-87)."""
+    p.add_argument("--nodes", default=",".join(DEFAULT_NODES),
+                   help="Comma-separated list of node hostnames")
+    p.add_argument("--nodes-file", default=None,
+                   help="File with node hostnames, one per line")
+    p.add_argument("--username", default="root", help="SSH username")
+    p.add_argument("--password", default=None, help="SSH password")
+    p.add_argument("--private-key-path", default=None,
+                   help="SSH identity file")
+    p.add_argument("--ssh-port", type=int, default=22)
+    p.add_argument("--strict-host-key-checking", action="store_true")
+    p.add_argument("--dummy-ssh", action="store_true",
+                   help="Stub the SSH transport (no real cluster)")
+    p.add_argument("--concurrency", default="1n",
+                   help='Worker count; "3n" means 3 * node count')
+    p.add_argument("--time-limit", type=float, default=60.0,
+                   help="Stop generating ops after this many seconds")
+    p.add_argument("--test-count", type=int, default=1,
+                   help="How many times to run the test")
+    p.add_argument("--seed", type=int, default=None,
+                   help="Deterministic generator seed")
+    p.add_argument("--no-store", action="store_true",
+                   help="Don't persist this run")
+
+
+def test_opts_to_map(opts: argparse.Namespace) -> dict:
+    """Parsed flags → the option slice of a test map (test-opt-fn,
+    cli.clj:114-197)."""
+    if opts.nodes_file:
+        with open(opts.nodes_file) as f:
+            nodes = [line.strip() for line in f if line.strip()]
+    else:
+        nodes = [n for n in opts.nodes.split(",") if n]
+    return {
+        "nodes": nodes,
+        "concurrency": parse_concurrency(opts.concurrency, len(nodes)),
+        "time_limit": opts.time_limit,
+        "seed": opts.seed,
+        "ssh": {
+            "username": opts.username,
+            "password": opts.password,
+            "private_key_path": opts.private_key_path,
+            "port": opts.ssh_port,
+            "strict_host_key_checking": opts.strict_host_key_checking,
+            "dummy": opts.dummy_ssh,
+        },
+    }
+
+
+def _run_test_cmd(opts: argparse.Namespace, test_fn: Callable) -> int:
+    from . import runtime, store as store_mod
+
+    base = test_opts_to_map(opts)
+    for i in range(opts.test_count):
+        # Suite flags ride along raw; the parsed/normalized test opts win.
+        test = test_fn({**vars(opts), **base, "run_index": i})
+        if not opts.no_store:
+            store_mod.attach(test)
+        handle = test.get("store_handle")
+        try:
+            test = runtime.run(test)
+        finally:
+            if handle is not None:
+                handle.stop_logging()
+        valid = (test.get("results") or {}).get("valid")
+        if valid is not True:
+            return 1
+    return 0
+
+
+def single_test_cmd(test_fn: Callable,
+                    opt_fn: Optional[Callable] = None,
+                    extra_opts: Optional[Callable] = None) -> dict:
+    """The standard "test" subcommand (cli.clj:295-329). ``extra_opts``
+    receives the argparse parser to add suite flags; ``test_fn`` maps the
+    option dict to a test map."""
+    return {"test": {"add_opts": lambda p: (add_test_opts(p),
+                                            extra_opts(p)
+                                            if extra_opts else None),
+                     "run": lambda opts: _run_test_cmd(opts, test_fn)}}
+
+
+def serve_cmd() -> dict:
+    """The results web server subcommand (cli.clj:278-293)."""
+    def add_opts(p):
+        p.add_argument("-b", "--host", default="0.0.0.0")
+        p.add_argument("-p", "--port", type=int, default=8080)
+
+    def run(opts):
+        from .web import serve
+        print(f"Listening on http://{opts.host}:{opts.port}/")
+        serve(host=opts.host, port=opts.port, block=True)
+        return 0
+
+    return {"serve": {"add_opts": add_opts, "run": run}}
+
+
+def run_cli(subcommands: Dict[str, dict],
+            argv: Optional[Sequence[str]] = None) -> None:
+    """Dispatch argv against a subcommand map and exit with the contract
+    above (cli.clj:201-276)."""
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s{%(threadName)s} %(levelname)s %(name)s - "
+               "%(message)s")
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = argparse.ArgumentParser(prog="jepsen-tpu")
+    sub = parser.add_subparsers(dest="command")
+    for name, spec in subcommands.items():
+        p = sub.add_parser(name)
+        if spec.get("add_opts"):
+            spec["add_opts"](p)
+
+    if not argv or argv[0] in ("-h", "--help"):
+        parser.print_help()
+        sys.exit(254 if not argv else 0)
+    if argv[0] not in subcommands:
+        print(f"Usage: jepsen-tpu COMMAND [OPTIONS ...]\n"
+              f"Commands: {', '.join(sorted(subcommands))}")
+        sys.exit(254)
+
+    try:
+        opts = parser.parse_args(argv)
+    except SystemExit as e:
+        sys.exit(0 if e.code == 0 else 254)
+
+    try:
+        code = subcommands[argv[0]]["run"](opts)
+        sys.exit(code or 0)
+    except SystemExit:
+        raise
+    except BaseException:
+        logging.getLogger("jepsen.cli").fatal(
+            "Oh jeez, I'm sorry, Jepsen broke. Here's why:\n%s",
+            traceback.format_exc())
+        sys.exit(255)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    run_cli(serve_cmd(), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -69,9 +69,7 @@ def lit(s: str) -> Literal:
     return Literal(s)
 
 
-PIPE = lit("|")
-REDIR = {">": ">", ">>": ">>", "<": "<"}
-_NEEDS_QUOTE = re.compile(r'[\\$`"\'\s(){}\[\]*?<>&;]')
+_NEEDS_QUOTE = re.compile(r'[\\$`"\'\s(){}\[\]*?<>&;|#~!]')
 
 
 def escape(s) -> str:
@@ -140,9 +138,18 @@ class SSHTransport(Transport):
         return f"{user}@{self.host}"
 
     def run(self, cmd: str, stdin: Optional[str]) -> Tuple[str, str, int]:
-        p = subprocess.run(self._base("ssh") + [self._target, cmd],
-                           input=stdin, capture_output=True, text=True,
-                           timeout=self.cfg.get("timeout", 600))
+        timeout = self.cfg.get("timeout", 600)
+        try:
+            p = subprocess.run(self._base("ssh") + [self._target, cmd],
+                               input=stdin, capture_output=True, text=True,
+                               timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            # Surface as an ordinary failed command (exit 124, as
+            # timeout(1) would) so callers' RemoteError handling and
+            # retry policies apply instead of an uncaught exception.
+            out = e.stdout.decode(errors="replace") if e.stdout else ""
+            err = e.stderr.decode(errors="replace") if e.stderr else ""
+            return out, err + f"\nssh command timed out after {timeout}s", 124
         return p.stdout, p.stderr, p.returncode
 
     def upload(self, local: str, remote: str) -> None:

@@ -15,12 +15,21 @@ from typing import Iterable, List
 from .ops import Op
 
 
+def _encode_kvs(v):
+    """Independent-key tuples must survive the round trip as KV, not
+    list — including nested occurrences."""
+    from ..independent import KV
+    if isinstance(v, KV):
+        return {"__kv__": [_encode_kvs(v[0]), _encode_kvs(v[1])]}
+    if isinstance(v, (list, tuple)):
+        return [_encode_kvs(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _encode_kvs(x) for k, x in v.items()}
+    return v
+
+
 def dumps_op(op: Op) -> str:
-    d = op.to_dict()
-    v = d.get("value")
-    # Independent-key tuples must survive the round trip as KV, not list.
-    if type(v).__name__ == "KV":
-        d["value"] = {"__kv__": [v[0], v[1]]}
+    d = {k: _encode_kvs(v) for k, v in op.to_dict().items()}
     return json.dumps(d, separators=(",", ":"), default=_default)
 
 

@@ -7,8 +7,7 @@ import time as _time
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from ..client import Client
-from ..control import core as c
-from ..control.core import exec_, on_many, on_nodes, su
+from ..control.core import exec_, on_nodes, su
 
 
 class Noop(Client):
@@ -25,10 +24,15 @@ noop = Noop()
 
 
 def snub_nodes(test: dict, dest, sources: Sequence) -> None:
-    """Drop all packets from sources as seen at dest (nemesis.clj:16-19)."""
+    """Drop all packets from sources as seen at dest (nemesis.clj:16-19).
+    Assumes dest's control session is bound (runs inside on_nodes); uses
+    the Net's batched per-node path when it has one."""
     net = test["net"]
-    for src in sources:
-        net.drop(test, src, dest)
+    if hasattr(net, "drop_local"):
+        net.drop_local(test, list(sources))
+    else:
+        for src in sources:
+            net.drop(test, src, dest)
 
 
 def partition(test: dict, grudge: Dict) -> None:

@@ -9,9 +9,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .control import core as c
 from .control.core import RemoteError, exec_, lit, on_nodes, su
-from .control import net_helpers
 
 TC = "/sbin/tc"
 
@@ -66,10 +64,25 @@ class IPTablesNet(Net):
 
     def drop(self, test, src, dest):
         def f(t, node):
-            with su():
-                exec_("iptables", "-A", "INPUT", "-s",
-                      net_helpers.ip(str(src)), "-j", "DROP", "-w")
+            self.drop_local(t, [src])
         on_nodes(test, f, [dest])
+
+    def drop_local(self, test, sources) -> None:
+        """Install drops against ``sources`` on the *current* node (its
+        control session already bound). One compound command resolves
+        every source IP and appends its rule — so a full partition costs
+        one SSH exec per node, not one per (src, dest) pair."""
+        if not sources:
+            return
+        from .control.core import escape, exec_star
+        parts = []
+        for src in sources:
+            h = escape(str(src))
+            parts.append(
+                f"ip=$(getent ahosts {h} | awk 'NR==1{{print $1}}'); "
+                f"iptables -A INPUT -s \"$ip\" -j DROP -w")
+        with su():
+            exec_star("; ".join(parts))
 
     def heal(self, test):
         def f(t, node):

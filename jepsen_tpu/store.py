@@ -1,0 +1,203 @@
+"""Persistence: run directories, history/results files, latest symlinks.
+
+Mirrors jepsen/src/jepsen/store.clj. Layout:
+
+    store/<test-name>/<timestamp>/
+        test.json       — the serializable slice of the test map
+        history.txt     — human-readable tab-separated op log
+        history.jsonl   — machine-readable history (codec.write_jsonl)
+        results.json    — checker output
+        jepsen.log      — per-run log file
+        <node>/...      — snarfed db log files
+    store/<test-name>/latest    → most recent run
+    store/latest                → most recent run of any test
+
+Persistence is two-phase like the reference's save-1!/save-2!
+(store.clj:279-302): the history lands before analysis begins, so a
+crashed checker still leaves a re-checkable run on disk; ``load``
+rehydrates a stored run for re-analysis (store.clj:165-171) — the replay
+seam the TPU batch checker consumes (load N histories, re-check on
+device).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+from .history.codec import read_jsonl, write_jsonl, write_txt
+from .history.ops import Op
+
+BASE = Path("store")
+
+# Test-map keys that are live objects, never serialized
+# (store.clj:155-163 default-nonserializable-keys).
+NONSERIALIZABLE_KEYS = {
+    "db", "os", "net", "client", "nemesis", "checker", "model", "generator",
+    "barrier", "clock", "rng", "sessions", "active_histories", "history",
+    "results", "store_handle", "ssh",
+}
+
+
+def _scrub(x):
+    try:
+        json.dumps(x)
+        return x
+    except TypeError:
+        return repr(x)
+
+
+class StoreHandle:
+    """One run's directory + file helpers."""
+
+    def __init__(self, dir: Path):
+        self.dir = Path(dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._log_handler: Optional[logging.Handler] = None
+
+    # ---------------------------------------------------------- paths
+    def path(self, *parts: str) -> str:
+        """A path inside the run dir, parents created (store.clj path!)."""
+        p = self.dir.joinpath(*[str(x) for x in parts])
+        p.parent.mkdir(parents=True, exist_ok=True)
+        return str(p)
+
+    # ---------------------------------------------------------- files
+    def write_json(self, parts, obj) -> None:
+        parts = [parts] if isinstance(parts, str) else list(parts)
+        with open(self.path(*parts), "w") as f:
+            json.dump(obj, f, indent=2, default=_scrub)
+
+    def read_json(self, *parts):
+        with open(self.path(*parts)) as f:
+            return json.load(f)
+
+    def write_history(self, parts, history: Sequence[Op]) -> None:
+        parts = [parts] if isinstance(parts, str) else list(parts)
+        write_txt(self.path(*parts[:-1], parts[-1] + ".txt"), history)
+        write_jsonl(self.path(*parts[:-1], parts[-1] + ".jsonl"), history)
+
+    # ------------------------------------------------------ lifecycle
+    def save_test(self, test: dict) -> None:
+        clean = {k: _scrub(v) for k, v in test.items()
+                 if k not in NONSERIALIZABLE_KEYS}
+        self.write_json("test.json", clean)
+
+    def save_history(self, history: Sequence[Op]) -> None:
+        """Phase 1: history lands before analysis (save-1!,
+        store.clj:279-290)."""
+        self.write_history("history", history)
+
+    def save_results(self, results: dict) -> None:
+        """Phase 2: analysis output (save-2!, store.clj:292-302)."""
+        self.write_json("results.json", results)
+
+    # -------------------------------------------------------- logging
+    def start_logging(self) -> None:
+        """Attach a per-run jepsen.log file handler (store.clj:304-318)."""
+        h = logging.FileHandler(self.path("jepsen.log"))
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s{%(threadName)s} %(levelname)s %(name)s - "
+            "%(message)s"))
+        logging.getLogger().addHandler(h)
+        self._log_handler = h
+
+    def stop_logging(self) -> None:
+        if self._log_handler is not None:
+            logging.getLogger().removeHandler(self._log_handler)
+            self._log_handler.close()
+            self._log_handler = None
+
+
+class Store:
+    """The store root: creates run dirs, symlinks, loads past runs."""
+
+    def __init__(self, base=BASE):
+        self.base = Path(base)
+
+    def create(self, test_name: str, ts: Optional[str] = None) -> StoreHandle:
+        if ts is None:
+            base = time.strftime("%Y%m%dT%H%M%S")
+            ts, n = base, 0
+            while (self.base / test_name / ts).exists():
+                n += 1
+                ts = f"{base}.{n}"
+        h = StoreHandle(self.base / test_name / ts)
+        self.update_symlinks(test_name, h.dir)
+        return h
+
+    def update_symlinks(self, test_name: str, target: Path) -> None:
+        """Maintain store/<name>/latest and store/latest
+        (store.clj:235-247)."""
+        for link in (self.base / test_name / "latest", self.base / "latest"):
+            link.parent.mkdir(parents=True, exist_ok=True)
+            if link.is_symlink() or link.exists():
+                link.unlink()
+            link.symlink_to(os.path.relpath(target, link.parent))
+
+    # ---------------------------------------------------------- browse
+    def tests(self) -> Dict[str, List[str]]:
+        """{test-name: [timestamps]} of stored runs (store.clj tests)."""
+        out: Dict[str, List[str]] = {}
+        if not self.base.exists():
+            return out
+        for name_dir in sorted(self.base.iterdir()):
+            if not name_dir.is_dir() or name_dir.name == "latest":
+                continue
+            runs = [d.name for d in sorted(name_dir.iterdir())
+                    if d.is_dir() and d.name != "latest"]
+            if runs:
+                out[name_dir.name] = runs
+        return out
+
+    def run_dir(self, test_name: str, ts: str = "latest") -> Path:
+        return self.base / test_name / ts
+
+    def load(self, test_name: str, ts: str = "latest") -> dict:
+        """Rehydrate a stored run: test map slice + history + results
+        (store.clj:165-171)."""
+        d = self.run_dir(test_name, ts)
+        out: dict = {}
+        tj = d / "test.json"
+        if tj.exists():
+            out.update(json.loads(tj.read_text()))
+        hist = d / "history.jsonl"
+        if hist.exists():
+            out["history"] = read_jsonl(hist)
+        res = d / "results.json"
+        if res.exists():
+            out["results"] = json.loads(res.read_text())
+        return out
+
+    def load_histories(self, test_name: str,
+                       timestamps: Optional[Sequence[str]] = None
+                       ) -> List[List[Op]]:
+        """Every stored history for a test — the batch-recheck seam."""
+        ts = timestamps if timestamps is not None else \
+            self.tests().get(test_name, [])
+        return [self.load(test_name, t)["history"] for t in ts]
+
+    def delete(self, test_name: str, ts: Optional[str] = None) -> None:
+        """Remove a run, or all of a test's runs (store.clj:328-345)."""
+        target = (self.base / test_name / ts) if ts else \
+            (self.base / test_name)
+        if target.exists():
+            shutil.rmtree(target)
+
+
+DEFAULT = Store()
+
+
+def attach(test: dict, store: Optional[Store] = None) -> dict:
+    """Give a test map a store handle + logging for its run; returns the
+    test (wired by the CLI and usable directly)."""
+    store = store or DEFAULT
+    h = store.create(test.get("name", "noname"))
+    test["store_handle"] = h
+    h.save_test(test)
+    h.start_logging()
+    return test

@@ -1,0 +1,163 @@
+"""Results web UI: browse stored runs, preview files, export zips.
+
+Mirrors jepsen/src/jepsen/web.clj on the stdlib http.server: a test
+table with validity color coding (web.clj:47-128), a store-dir browser
+with text/image previews (130-229), zip export of a run (231-271), and
+the path-escape guard (273-278).
+"""
+from __future__ import annotations
+
+import html
+import io
+import json
+import threading
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Optional
+from urllib.parse import quote, unquote, urlparse
+
+from .store import Store, DEFAULT
+
+TEXT_EXT = {".txt", ".json", ".jsonl", ".log", ".edn", ".html", ".svg", ".c"}
+IMG_EXT = {".png", ".jpg", ".jpeg", ".gif"}
+
+STYLE = """
+body { font-family: sans-serif; margin: 2em; }
+table { border-collapse: collapse; }
+td, th { padding: .3em .8em; border: 1px solid #ccc; text-align: left; }
+.valid-true  { background: #c3e6c3; }
+.valid-false { background: #f2b2b2; }
+.valid-unknown { background: #f5e6a9; }
+a { text-decoration: none; }
+pre { background: #f7f7f7; padding: 1em; overflow-x: auto; }
+"""
+
+
+def _validity(run_dir: Path):
+    try:
+        with open(run_dir / "results.json") as f:
+            return json.load(f).get("valid")
+    except Exception:
+        return None
+
+
+class Handler(BaseHTTPRequestHandler):
+    store: Store = DEFAULT
+
+    # ----------------------------------------------------------- plumbing
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _send(self, body, ctype="text/html; charset=utf-8", code=200,
+              headers=()):
+        data = body.encode() if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _page(self, title, body):
+        self._send(f"<html><head><title>{html.escape(title)}</title>"
+                   f"<style>{STYLE}</style></head>"
+                   f"<body><h1>{html.escape(title)}</h1>{body}</body></html>")
+
+    def _resolve(self, rel: str) -> Optional[Path]:
+        """Resolve a store-relative path, refusing escapes
+        (web.clj:273-278)."""
+        base = self.store.base.resolve()
+        p = (base / rel).resolve()
+        if p == base or base in p.parents:
+            return p
+        return None
+
+    # ------------------------------------------------------------- routes
+    def do_GET(self):
+        url = urlparse(self.path)
+        path = unquote(url.path)
+        if path == "/":
+            return self.index()
+        if path.startswith("/files/"):
+            return self.files(path[len("/files/"):])
+        if path.startswith("/zip/"):
+            return self.zip(path[len("/zip/"):])
+        self._send("not found", code=404, ctype="text/plain")
+
+    def index(self):
+        rows = []
+        for name, runs in sorted(self.store.tests().items()):
+            for ts in sorted(runs, reverse=True):
+                d = self.store.run_dir(name, ts)
+                v = _validity(d)
+                cls = {True: "valid-true", False: "valid-false"}.get(
+                    v, "valid-unknown")
+                vtxt = {True: "valid", False: "INVALID"}.get(
+                    v, "unknown" if v is not None else "—")
+                rel = f"{name}/{ts}"
+                rows.append(
+                    f'<tr class="{cls}">'
+                    f"<td>{html.escape(name)}</td>"
+                    f'<td><a href="/files/{quote(rel)}/">'
+                    f"{html.escape(ts)}</a></td>"
+                    f"<td>{vtxt}</td>"
+                    f'<td><a href="/zip/{quote(rel)}">zip</a></td></tr>')
+        table = ("<table><tr><th>test</th><th>run</th><th>valid?</th>"
+                 "<th>export</th></tr>" + "".join(rows) + "</table>")
+        self._page("Jepsen-TPU results", table)
+
+    def files(self, rel: str):
+        p = self._resolve(rel.rstrip("/"))
+        if p is None or not p.exists():
+            return self._send("not found", code=404, ctype="text/plain")
+        if p.is_dir():
+            entries = []
+            for child in sorted(p.iterdir()):
+                slash = "/" if child.is_dir() else ""
+                rp = quote(f"{rel.rstrip('/')}/{child.name}")
+                entries.append(f'<li><a href="/files/{rp}{slash}">'
+                               f"{html.escape(child.name)}{slash}</a></li>")
+            return self._page(rel or "store", f"<ul>{''.join(entries)}</ul>")
+        ext = p.suffix.lower()
+        if ext in IMG_EXT:
+            return self._send(p.read_bytes(), ctype=f"image/{ext[1:]}")
+        if ext in TEXT_EXT:
+            body = p.read_text(errors="replace")
+            return self._page(p.name, f"<pre>{html.escape(body)}</pre>")
+        # Unknown extensions (snarfed .gz logs, fressian blobs, ...) must
+        # download byte-exact, never as lossily-decoded text.
+        return self._send(
+            p.read_bytes(), ctype="application/octet-stream",
+            headers=[("Content-Disposition",
+                      f'attachment; filename="{p.name}"')])
+
+    def zip(self, rel: str):
+        p = self._resolve(rel)
+        if p is None or not p.is_dir():
+            return self._send("not found", code=404, ctype="text/plain")
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            for f in sorted(p.rglob("*")):
+                if f.is_file():
+                    z.write(f, f.relative_to(p.parent))
+        self._send(buf.getvalue(), ctype="application/zip",
+                   headers=[("Content-Disposition",
+                             f'attachment; filename="{p.name}.zip"')])
+
+
+def serve(host: str = "0.0.0.0", port: int = 8080,
+          store: Optional[Store] = None, block: bool = False):
+    """Start the results server (web.clj:315-320). Returns the server;
+    when block=True, serves forever."""
+    handler = type("BoundHandler", (Handler,),
+                   {"store": store or DEFAULT})
+    srv = ThreadingHTTPServer((host, port), handler)
+    if block:
+        srv.serve_forever()
+        return srv
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="jepsen web")
+    t.start()
+    return srv

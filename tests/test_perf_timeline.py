@@ -1,0 +1,96 @@
+"""Perf graph math + rendering (mirrors perf_test.clj and
+checker_test.clj:156-205) and the HTML timeline."""
+import random
+
+import pytest
+
+from jepsen_tpu.checkers.perf import (bucket_scale, bucket_time, buckets,
+                                      quantile, latencies_by_quantiles,
+                                      latency_graph, perf,
+                                      rate_graph_checker)
+from jepsen_tpu.checkers.timeline import html_timeline, render_html
+from jepsen_tpu.history.core import index
+from jepsen_tpu.history.ops import Op, invoke_op, ok_op, fail_op
+from jepsen_tpu.store import Store
+
+
+def test_bucket_math():
+    assert bucket_scale(2.0, 0) == 1.0
+    assert bucket_scale(2.0, 1) == 3.0
+    assert bucket_time(2.0, 0.5) == 1.0
+    assert bucket_time(2.0, 3.9) == 3.0
+    bs = buckets(2.0, [(0.1, "a"), (1.9, "b"), (2.1, "c")])
+    assert bs == {1.0: ["a", "b"], 3.0: ["c"]}
+
+
+def test_quantiles():
+    xs = list(range(1, 101))
+    assert quantile(0.5, xs) == 50
+    assert quantile(1.0, xs) == 100
+    assert quantile(0.0, xs) == 1
+    assert quantile(0.99, xs) == 99
+    with pytest.raises(ValueError):
+        quantile(0.5, [])
+
+
+def test_latencies_by_quantiles():
+    pts = [(t / 10, float(t % 10)) for t in range(100)]
+    out = latencies_by_quantiles(5.0, [0.5, 1.0], pts)
+    assert set(out) == {0.5, 1.0}
+    for q, series in out.items():
+        assert [t for t, _ in series] == [2.5, 7.5]
+    assert all(l == 9.0 for _, l in out[1.0])
+
+
+def random_timed_history(n=500, seed=3):
+    """A 10k-op-style randomized graph smoke history
+    (checker_test.clj:188-205)."""
+    rng = random.Random(seed)
+    h = []
+    t = 0
+    for i in range(n):
+        p = rng.randrange(4)
+        t += rng.randrange(10**7)
+        h.append(invoke_op(p, "read", None, time=t))
+        t += rng.randrange(10**8)
+        typ = rng.choice([ok_op, ok_op, ok_op, fail_op])
+        h.append(typ(p, "read", rng.randrange(5), time=t))
+    h.append(Op(process="nemesis", type="info", f="start", time=t // 3))
+    h.append(Op(process="nemesis", type="info", f="stop", time=2 * t // 3))
+    return index(h)
+
+
+def test_graphs_render(tmp_path):
+    store = Store(tmp_path)
+    h = store.create("perf-test")
+    test = {"name": "perf-test", "store_handle": h, "concurrency": 4}
+    hist = random_timed_history()
+    r = perf().check(test, None, hist)
+    assert r["valid"] is True
+    assert (h.dir / "latency-raw.png").exists()
+    assert (h.dir / "latency-quantiles.png").exists()
+    assert (h.dir / "rate.png").exists()
+
+
+def test_graphs_skip_without_store():
+    r = latency_graph().check({}, None, random_timed_history(50))
+    assert r["valid"] is True and "skipped" in r
+
+
+def test_timeline_html(tmp_path):
+    store = Store(tmp_path)
+    h = store.create("tl-test")
+    test = {"name": "tl-test", "store_handle": h, "concurrency": 2}
+    hist = index([
+        invoke_op(0, "write", 1, time=0),
+        invoke_op(1, "read", None, time=10**8),
+        ok_op(0, "write", 1, time=2 * 10**8),
+        ok_op(1, "read", 1, time=3 * 10**8),
+        invoke_op(2, "cas", [1, 2], time=4 * 10**8),  # retired process
+    ])
+    r = html_timeline().check(test, None, hist)
+    assert r["valid"] is True
+    html = (h.dir / "timeline.html").read_text()
+    assert "process 0" in html and "process 2" in html
+    assert html.count('class="op"') == 3
+    assert "write" in html and "cas" in html

@@ -1,0 +1,168 @@
+"""Store round-trips (mirrors store_test.clj:11-24), CLI contract, and
+the results web UI."""
+import json
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+import jepsen_tpu.gen as g
+from jepsen_tpu.checkers.linearizable import linearizable, wgl_check
+from jepsen_tpu.cli import parse_concurrency, run_cli, single_test_cmd
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.runtime import run
+from jepsen_tpu.store import Store, attach
+from jepsen_tpu.testing import atom_cas_test
+from jepsen_tpu.web import serve
+
+
+@pytest.fixture
+def store(tmp_path):
+    return Store(tmp_path / "store")
+
+
+def run_stored(store, **kw):
+    test = atom_cas_test(**kw)
+    h = store.create(test["name"])
+    test["store_handle"] = h
+    h.save_test(test)
+    return run(test), h
+
+
+def test_store_round_trip(store):
+    t, h = run_stored(store, n_ops=40, concurrency=3)
+    assert (h.dir / "history.jsonl").exists()
+    assert (h.dir / "history.txt").exists()
+    assert (h.dir / "results.json").exists()
+    assert (h.dir / "test.json").exists()
+
+    loaded = store.load("atom-cas")
+    assert loaded["results"]["valid"] is True
+    assert loaded["concurrency"] == 3
+    # the reloaded history re-checks to the same verdict (the replay seam)
+    r = wgl_check(cas_register(), loaded["history"])
+    assert r["valid"] is True
+    assert len(loaded["history"]) == len(t["history"])
+
+
+def test_latest_symlinks(store):
+    run_stored(store, n_ops=10, concurrency=2)
+    run_stored(store, n_ops=10, concurrency=2)
+    runs = store.tests()["atom-cas"]
+    assert len(runs) == 2
+    latest = store.run_dir("atom-cas", "latest")
+    assert latest.resolve().name == sorted(runs)[-1] or \
+        latest.resolve().name in runs
+    assert (store.base / "latest").resolve() == latest.resolve()
+
+
+def test_load_histories_batch_seam(store):
+    for _ in range(3):
+        run_stored(store, n_ops=10, concurrency=2)
+    hs = store.load_histories("atom-cas")
+    assert len(hs) == 3
+    assert all(len(h) == 20 for h in hs)
+
+
+def test_delete(store):
+    run_stored(store, n_ops=5, concurrency=1)
+    assert store.tests()
+    store.delete("atom-cas")
+    assert not store.tests()
+
+
+# ----------------------------------------------------------------- CLI
+
+def test_parse_concurrency():
+    assert parse_concurrency("5", 3) == 5
+    assert parse_concurrency("3n", 5) == 15
+    assert parse_concurrency("1n", 4) == 4
+    with pytest.raises(ValueError):
+        parse_concurrency("3x", 5)
+
+
+def _cli_exit(args, test_fn):
+    with pytest.raises(SystemExit) as e:
+        run_cli(single_test_cmd(test_fn), args)
+    return e.value.code
+
+
+def test_cli_runs_test_and_exit_codes(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+
+    def ok_fn(opts):
+        assert opts["nodes"] == ["a", "b"]
+        assert opts["concurrency"] == 4
+        return atom_cas_test(n_ops=10, concurrency=2)
+
+    code = _cli_exit(["test", "--nodes", "a,b", "--concurrency", "2n",
+                      "--no-store"], ok_fn)
+    assert code == 0
+
+    def bad_fn(opts):
+        # checker that always fails
+        from jepsen_tpu.checkers.core import FnChecker
+        return atom_cas_test(
+            n_ops=5, concurrency=1,
+            checker=FnChecker(lambda *a: {"valid": False}))
+
+    assert _cli_exit(["test", "--no-store"], bad_fn) == 1
+    assert _cli_exit(["bogus"], lambda o: None) == 254
+
+    def crash_fn(opts):
+        raise RuntimeError("kaboom")
+
+    assert _cli_exit(["test", "--no-store"], crash_fn) == 255
+
+
+def test_cli_store_attach(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+
+    def fn(opts):
+        return atom_cas_test(n_ops=5, concurrency=1)
+
+    assert _cli_exit(["test"], fn) == 0
+    store_dir = tmp_path / "store" / "atom-cas"
+    runs = [d for d in store_dir.iterdir()
+            if d.is_dir() and d.name != "latest"]
+    assert len(runs) == 1
+    assert (runs[0] / "results.json").exists()
+    assert (runs[0] / "jepsen.log").exists()
+
+
+# ----------------------------------------------------------------- web
+
+def test_web_ui(store):
+    run_stored(store, n_ops=10, concurrency=2)
+    srv = serve(host="127.0.0.1", port=0, store=store)
+    try:
+        port = srv.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}") as r:
+                return r.status, r.read()
+
+        status, body = get("/")
+        assert status == 200
+        assert b"atom-cas" in body and b"valid-true" in body
+
+        ts = store.tests()["atom-cas"][0]
+        status, body = get(f"/files/atom-cas/{ts}/")
+        assert status == 200 and b"history.jsonl" in body
+
+        status, body = get(f"/files/atom-cas/{ts}/results.json")
+        assert status == 200 and b"valid" in body
+
+        status, body = get(f"/zip/atom-cas/{ts}")
+        assert status == 200 and body[:2] == b"PK"
+
+        # path escape guard
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/files/../../etc/passwd")
+        assert e.value.code == 404
+    finally:
+        srv.shutdown()
