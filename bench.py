@@ -65,6 +65,20 @@ def main():
     n_invalid = int(sum(int((~v).sum()) for v, _ in outs))
     rate = n_checked / t_dev
 
+    # Native-CPU comparison point on a subsample (the host twin of the
+    # device kernel; scaled to a full-batch rate estimate).
+    native_rate = None
+    try:
+        from jepsen_tpu.native import check_batch_native, lib
+        lib()                                  # build/load outside timing
+        sub = hists[:min(64, B)]
+        check_batch_native(model, sub[:4])     # warm caches
+        t0 = time.time()
+        check_batch_native(model, sub)
+        native_rate = round(len(sub) / (time.time() - t0), 2)
+    except Exception:
+        pass
+
     print(json.dumps({
         "metric": "linearizability_check_throughput_1kop_cas",
         "value": round(rate, 2),
@@ -76,6 +90,7 @@ def main():
         "host_fallbacks": n_fallback,
         "buckets": [[b.V, b.W, b.batch] for b in buckets],
         "device": str(jax.devices()[0]),
+        "native_cpu_rate": native_rate,
         "device_time_s": round(t_dev, 3),
         "compile_time_s": round(t_compile, 2),
         "synth_time_s": round(t_synth, 2),

@@ -1,0 +1,276 @@
+"""Native (C++) engines: WGL search, batch driver, encoder walk.
+
+The runtime around the TPU compute path is native where the reference's
+is JVM: this package builds ``libjepsen_native.so`` from wgl.cpp with
+the system g++ on first import (cached until the source changes) and
+binds it with ctypes — no pybind11 required.
+
+The Python layer lowers prepared histories to flat int32 arrays
+(``lower_history``); the C++ side runs the packed config-set search
+(jt_wgl_check), a threaded batch driver (jt_wgl_check_batch), and the
+slot-table encoder walk (jt_encode) that feeds the TPU kernel.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..history.ops import Op, INVOKE, OK, INFO
+from ..models.core import Model
+from ..ops.statespace import (StateSpace, StateSpaceExplosion,
+                              enumerate_statespace, history_kinds, op_kind)
+
+_DIR = Path(__file__).resolve().parent
+_SRC = _DIR / "wgl.cpp"
+_LIB = _DIR / "libjepsen_native.so"
+
+# Event codes shared with wgl.cpp.
+EV_INVOKE, EV_OK, EV_INFO = 0, 1, 2
+
+_lock = threading.Lock()
+_lib = None
+
+
+def build(force: bool = False) -> Path:
+    """Compile the shared library if stale."""
+    if force or not _LIB.exists() or \
+            _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               "-o", str(_LIB), str(_SRC), "-lpthread"]
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(f"native build failed:\n{r.stderr}")
+    return _LIB
+
+
+def lib():
+    global _lib
+    with _lock:
+        if _lib is None:
+            build()
+            L = ctypes.CDLL(str(_LIB))
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            L.jt_wgl_check.restype = ctypes.c_int32
+            L.jt_wgl_check.argtypes = [
+                i32p, i32p, i32p, u8p, ctypes.c_int32, i32p,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int64, i32p]
+            L.jt_wgl_check_batch.restype = None
+            L.jt_wgl_check_batch.argtypes = [
+                i32p, i32p, i32p, u8p, i64p, i32p, i64p, i32p,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+                ctypes.c_int32, i32p]
+            L.jt_encode.restype = ctypes.c_int32
+            L.jt_encode.argtypes = [
+                i32p, i32p, i32p, u8p, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, i32p, i32p, i32p, i32p]
+            _lib = L
+    return _lib
+
+
+def _ptr(a: np.ndarray, typ):
+    return a.ctypes.data_as(ctypes.POINTER(typ))
+
+
+class Lowered:
+    """One prepared history as flat arrays + its state space."""
+
+    __slots__ = ("ev_type", "ev_proc", "ev_kind", "ev_noslot", "ev_opidx",
+                 "space", "n", "max_proc")
+
+    def __init__(self, ev_type, ev_proc, ev_kind, ev_noslot, ev_opidx,
+                 space, max_proc):
+        self.ev_type = ev_type
+        self.ev_proc = ev_proc
+        self.ev_kind = ev_kind
+        self.ev_noslot = ev_noslot
+        self.ev_opidx = ev_opidx
+        self.space = space
+        self.n = len(ev_type)
+        self.max_proc = max_proc
+
+
+def lower_history(model: Model, prepared: Sequence[Op], *,
+                  max_states: int = 64,
+                  space_cache: Optional[dict] = None) -> Lowered:
+    """Prepared history → flat event arrays + transition table.
+
+    Raises StateSpaceExplosion when the model's reachable space exceeds
+    ``max_states`` (callers fall back to the pure-Python engine, whose
+    config states are model objects, not table indices)."""
+    kinds = history_kinds(list(prepared))
+    key = (model, tuple(kinds))
+    space = space_cache.get(key) if space_cache is not None else None
+    if space is None:
+        space = enumerate_statespace(model, kinds, max_states)
+        if space_cache is not None:
+            space_cache[key] = space
+    identity = space.identity_kinds
+
+    # Which invocations complete ok? (identity drop rule needs this)
+    completion: Dict[object, int] = {}
+    open_inv: Dict[object, int] = {}
+    oks = set()
+    for pos, o in enumerate(prepared):
+        if o.type == INVOKE:
+            open_inv[o.process] = pos
+        elif o.is_completion and o.process in open_inv:
+            p = open_inv.pop(o.process)
+            if o.type == OK:
+                oks.add(p)
+
+    procs: Dict[object, int] = {}
+    ev_type = np.zeros(len(prepared), np.int32)
+    ev_proc = np.zeros(len(prepared), np.int32)
+    ev_kind = np.zeros(len(prepared), np.int32)
+    ev_noslot = np.zeros(len(prepared), np.uint8)
+    ev_opidx = np.zeros(len(prepared), np.int32)
+    n = 0
+    for pos, o in enumerate(prepared):
+        if o.type == INVOKE:
+            code = EV_INVOKE
+        elif o.type == OK:
+            code = EV_OK
+        elif o.type == INFO:
+            code = EV_INFO
+        else:
+            continue
+        ev_type[n] = code
+        ev_proc[n] = procs.setdefault(o.process, len(procs))
+        if o.type == INVOKE:
+            ki = space.kind_index[op_kind(o)]
+            ev_kind[n] = ki
+            ev_noslot[n] = 1 if (ki in identity and pos not in oks) else 0
+        ev_opidx[n] = o.index if o.index is not None else pos
+        n += 1
+    return Lowered(ev_type[:n], ev_proc[:n], ev_kind[:n], ev_noslot[:n],
+                   ev_opidx[:n], space, max(len(procs), 1))
+
+
+def _result(verdict: int, bad: int, low: Lowered, prepared) -> dict:
+    if verdict == 1:
+        return {"valid": True}
+    if verdict == -1:
+        return {"valid": "unknown", "error": "config-set explosion"}
+    op_index = int(low.ev_opidx[bad])
+    op = next((o for o in prepared if o.index == op_index), None)
+    return {"valid": False,
+            "op": op.to_dict() if op is not None else {"index": op_index}}
+
+
+def wgl_check_native(model: Model, history: Sequence[Op], *,
+                     max_configs: int = 2_000_000,
+                     max_states: int = 64,
+                     space_cache: Optional[dict] = None) -> dict:
+    """Exact linearizability decision, natively (the C++ twin of
+    checkers.linearizable.wgl_check; falls back to it on state-space
+    explosion)."""
+    from ..checkers.linearizable import prepare_history, wgl_check
+    from ..history.core import index as index_history
+    if any(op.index is None for op in history):
+        index_history(list(history))
+    prepared = prepare_history(list(history))
+    try:
+        low = lower_history(model, prepared, max_states=max_states,
+                            space_cache=space_cache)
+    except StateSpaceExplosion:
+        return wgl_check(model, list(history), max_configs=max_configs)
+    L = lib()
+    out = np.zeros(2, np.int32)
+    target = np.ascontiguousarray(low.space.target, np.int32)
+    if target.size == 0:
+        target = np.zeros((1, 1), np.int32)
+    verdict = L.jt_wgl_check(
+        _ptr(low.ev_type, ctypes.c_int32), _ptr(low.ev_proc, ctypes.c_int32),
+        _ptr(low.ev_kind, ctypes.c_int32), _ptr(low.ev_noslot, ctypes.c_uint8),
+        low.n, _ptr(target, ctypes.c_int32),
+        low.space.n_kinds, max(low.space.n_states, 1), low.max_proc,
+        max_configs, _ptr(out, ctypes.c_int32))
+    if verdict == -1:
+        # Window overflow or config explosion: exact Python fallback.
+        return wgl_check(model, list(history), max_configs=max_configs)
+    return _result(verdict, int(out[1]), low, prepared)
+
+
+def check_batch_native(model: Model, histories: Sequence[Sequence[Op]], *,
+                       max_configs: int = 2_000_000, max_states: int = 64,
+                       n_threads: Optional[int] = None) -> List[dict]:
+    """Threaded native batch check — the CPU twin of check_batch_tpu."""
+    from ..checkers.linearizable import prepare_history, wgl_check
+    from ..history.core import index as index_history
+
+    n_threads = n_threads or min(32, os.cpu_count() or 1)
+    cache: dict = {}
+    lows: List[Optional[Lowered]] = []
+    prepareds = []
+    for h in histories:
+        h = list(h)
+        if any(op.index is None for op in h):
+            index_history(h)
+        prepared = prepare_history(h)
+        prepareds.append(prepared)
+        try:
+            lows.append(lower_history(model, prepared,
+                                      max_states=max_states,
+                                      space_cache=cache))
+        except StateSpaceExplosion:
+            lows.append(None)
+
+    rows = [i for i, lo in enumerate(lows) if lo is not None]
+    results: List[Optional[dict]] = [None] * len(histories)
+    if rows:
+        ev_type = np.concatenate([lows[i].ev_type for i in rows])
+        ev_proc = np.concatenate([lows[i].ev_proc for i in rows])
+        ev_kind = np.concatenate([lows[i].ev_kind for i in rows])
+        ev_noslot = np.concatenate([lows[i].ev_noslot for i in rows])
+        offsets = np.zeros(len(rows) + 1, np.int64)
+        np.cumsum([lows[i].n for i in rows], out=offsets[1:])
+
+        tables, toffsets, dims = [], np.zeros(len(rows), np.int64), []
+        pos = 0
+        seen: Dict[int, int] = {}
+        for j, i in enumerate(rows):
+            sp = lows[i].space
+            k = id(sp)
+            if k not in seen:
+                seen[k] = pos
+                t = np.ascontiguousarray(sp.target, np.int32).ravel()
+                if t.size == 0:
+                    t = np.zeros(1, np.int32)
+                tables.append(t)
+                pos += t.size
+            toffsets[j] = seen[k]
+            dims += [sp.n_kinds, max(sp.n_states, 1)]
+        targets = np.concatenate(tables) if tables else np.zeros(1, np.int32)
+        dims = np.asarray(dims, np.int32)
+        max_proc = max(lows[i].max_proc for i in rows)
+        out = np.zeros((len(rows), 2), np.int32)
+
+        lib().jt_wgl_check_batch(
+            _ptr(ev_type, ctypes.c_int32), _ptr(ev_proc, ctypes.c_int32),
+            _ptr(ev_kind, ctypes.c_int32), _ptr(ev_noslot, ctypes.c_uint8),
+            _ptr(offsets, ctypes.c_int64), _ptr(targets, ctypes.c_int32),
+            _ptr(toffsets, ctypes.c_int64), _ptr(dims, ctypes.c_int32),
+            len(rows), max_proc, max_configs, n_threads,
+            _ptr(out, ctypes.c_int32))
+
+        for j, i in enumerate(rows):
+            v, bad = int(out[j, 0]), int(out[j, 1])
+            if v == -1:
+                results[i] = wgl_check(model, list(histories[i]),
+                                       max_configs=max_configs)
+            else:
+                results[i] = _result(v, bad, lows[i], prepareds[i])
+    for i, lo in enumerate(lows):
+        if lo is None:
+            results[i] = wgl_check(model, list(histories[i]),
+                                   max_configs=max_configs)
+    return results

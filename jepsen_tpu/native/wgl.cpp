@@ -1,0 +1,291 @@
+// Native WGL linearizability engine + event-stream encoder.
+//
+// The C++ twin of the Python host engine
+// (jepsen_tpu/checkers/linearizable.py) and of the slot-assignment walk
+// in jepsen_tpu/ops/encode.py — the host-side hot paths of the
+// framework. The Python layer lowers a prepared history to flat int32
+// arrays (event type/process/op-kind) plus the enumerated transition
+// table (jepsen_tpu.ops.statespace); this library runs the
+// configuration-set search and the encoder walk natively, and a
+// threaded batch driver fans histories across cores.
+//
+// Configurations are packed into one uint64: the model state in the top
+// byte, the linearized-pending-slot mask in the low 56 bits (pending
+// windows wider than 56 report "unbounded" and fall back to Python).
+// The config set is an open-addressed hash set rebuilt per event — the
+// same eager-closure WGL the TPU kernel runs densely
+// (jepsen_tpu/ops/linearize.py).
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 -o libjepsen_native.so wgl.cpp
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxMaskBits = 56;
+
+// Event type codes (shared contract with the Python lowering).
+constexpr int32_t EV_INVOKE = 0;
+constexpr int32_t EV_OK = 1;
+constexpr int32_t EV_INFO = 2;
+
+// Verdicts.
+constexpr int32_t VALID = 1;
+constexpr int32_t INVALID = 0;
+constexpr int32_t UNKNOWN = -1;  // exceeded max_configs or mask bits
+
+inline uint64_t pack(int32_t state, uint64_t mask) {
+  return (static_cast<uint64_t>(state) << kMaxMaskBits) | mask;
+}
+inline int32_t state_of(uint64_t c) {
+  return static_cast<int32_t>(c >> kMaxMaskBits);
+}
+inline uint64_t mask_of(uint64_t c) {
+  return c & ((1ULL << kMaxMaskBits) - 1);
+}
+
+// Open-addressed uint64 set. EMPTY (all ones) marks free buckets; the
+// initial config (state 0, mask 0) packs to 0, which is a valid key.
+class ConfigSet {
+ public:
+  static constexpr uint64_t kEmpty = ~0ULL;
+
+  explicit ConfigSet(size_t cap_hint = 64) { rehash(round_up(cap_hint * 2)); }
+
+  bool insert(uint64_t key) {  // true if newly added
+    if (size_ * 2 >= buckets_.size()) rehash(buckets_.size() * 2);
+    size_t i = slot(key);
+    while (buckets_[i] != kEmpty) {
+      if (buckets_[i] == key) return false;
+      i = (i + 1) & (buckets_.size() - 1);
+    }
+    buckets_[i] = key;
+    ++size_;
+    return true;
+  }
+
+  size_t size() const { return size_; }
+  const std::vector<uint64_t>& raw() const { return buckets_; }
+
+  void clear() {
+    std::fill(buckets_.begin(), buckets_.end(), kEmpty);
+    size_ = 0;
+  }
+
+ private:
+  static size_t round_up(size_t n) {
+    size_t p = 16;
+    while (p < n) p <<= 1;
+    return p;
+  }
+  static uint64_t hash(uint64_t x) {  // splitmix64 finalizer
+    x += 0x9e3779b97f4a7c15ULL;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+    return x ^ (x >> 31);
+  }
+  size_t slot(uint64_t key) const {
+    return hash(key) & (buckets_.size() - 1);
+  }
+  void rehash(size_t n) {
+    std::vector<uint64_t> old = std::move(buckets_);
+    buckets_.assign(n, kEmpty);
+    size_ = 0;
+    for (uint64_t k : old)
+      if (k != kEmpty) {
+        size_t i = slot(k);
+        while (buckets_[i] != kEmpty) i = (i + 1) & (n - 1);
+        buckets_[i] = k;
+        ++size_;
+      }
+  }
+
+  std::vector<uint64_t> buckets_;
+  size_t size_ = 0;
+};
+
+struct SlotState {
+  std::vector<int32_t> slot_kind;  // kind occupying each slot, -1 free
+  std::vector<int32_t> free_slots; // LIFO, low indices on top
+  std::vector<int32_t> slot_of_proc;
+  int live = 0, max_live = 0;
+
+  SlotState(int max_slots, int max_proc)
+      : slot_kind(max_slots, -1), slot_of_proc(max_proc, -1) {
+    for (int i = max_slots - 1; i >= 0; --i) free_slots.push_back(i);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Exact WGL decision for one lowered history.
+//
+// ev_type/ev_proc/ev_kind: [n] event stream (EV_* codes; proc ids are
+//   dense ints; kind indexes `target` rows). ev_noslot[i]=1 marks
+//   invokes that need no slot (total-identity ops that never complete —
+//   the encoder's drop rule).
+// target: [K, V] row-major next-state table, -1 = inconsistent.
+// out[0] = verdict, out[1] = index of first impossible ok event (-1).
+int32_t jt_wgl_check(const int32_t* ev_type, const int32_t* ev_proc,
+                     const int32_t* ev_kind, const uint8_t* ev_noslot,
+                     int32_t n, const int32_t* target, int32_t K, int32_t V,
+                     int32_t max_proc, int64_t max_configs, int32_t* out) {
+  (void)K;
+  out[0] = VALID;
+  out[1] = -1;
+
+  SlotState slots(kMaxMaskBits, max_proc);
+  ConfigSet configs, next;
+  configs.insert(pack(0, 0));
+
+  std::vector<int32_t> occupied;  // slots currently holding an op
+  std::vector<uint64_t> frontier, fresh;
+
+  for (int32_t i = 0; i < n; ++i) {
+    int32_t t = ev_type[i];
+    if (t == EV_INVOKE) {
+      if (ev_noslot && ev_noslot[i]) continue;
+      if (slots.free_slots.empty()) { out[0] = UNKNOWN; return UNKNOWN; }
+      int s = slots.free_slots.back();
+      slots.free_slots.pop_back();
+      slots.slot_kind[s] = ev_kind[i];
+      slots.slot_of_proc[ev_proc[i]] = s;
+      if (++slots.live > slots.max_live) slots.max_live = slots.live;
+    } else if (t == EV_INFO) {
+      // Indeterminate: slot stays pinned forever.
+      slots.slot_of_proc[ev_proc[i]] = -1;
+    } else if (t == EV_OK) {
+      int s = slots.slot_of_proc[ev_proc[i]];
+      if (s < 0) continue;  // completion with no open invocation
+
+      occupied.clear();
+      for (int j = 0; j < kMaxMaskBits; ++j)
+        if (slots.slot_kind[j] >= 0) occupied.push_back(j);
+
+      // Closure: expand configs under application of pending ops.
+      frontier.clear();
+      for (uint64_t c : configs.raw())
+        if (c != ConfigSet::kEmpty) frontier.push_back(c);
+      while (!frontier.empty()) {
+        fresh.clear();
+        for (uint64_t c : frontier) {
+          int32_t st = state_of(c);
+          uint64_t m = mask_of(c);
+          for (int j : occupied) {
+            uint64_t bit = 1ULL << j;
+            if (m & bit) continue;
+            int32_t nxt = target[slots.slot_kind[j] * V + st];
+            if (nxt < 0) continue;
+            uint64_t c2 = pack(nxt, m | bit);
+            if (configs.insert(c2)) fresh.push_back(c2);
+          }
+        }
+        if (static_cast<int64_t>(configs.size()) > max_configs) {
+          out[0] = UNKNOWN;
+          return UNKNOWN;
+        }
+        frontier.swap(fresh);
+      }
+
+      // Filter: keep configs with bit s, clear it.
+      uint64_t bit = 1ULL << s;
+      next.clear();
+      for (uint64_t c : configs.raw())
+        if (c != ConfigSet::kEmpty && (mask_of(c) & bit))
+          next.insert(c & ~bit);
+      if (next.size() == 0) {
+        out[0] = INVALID;
+        out[1] = i;
+        return INVALID;
+      }
+      std::swap(configs, next);
+
+      // Free the slot.
+      slots.slot_kind[s] = -1;
+      slots.slot_of_proc[ev_proc[i]] = -1;
+      slots.free_slots.push_back(s);
+      --slots.live;
+    }
+  }
+  return VALID;
+}
+
+// Threaded batch driver over flattened histories.
+// offsets: [B+1] into the ev_* arrays; targets likewise flattened with
+// per-history (K, V) in dims[2b], dims[2b+1] and toffsets into targets.
+void jt_wgl_check_batch(const int32_t* ev_type, const int32_t* ev_proc,
+                        const int32_t* ev_kind, const uint8_t* ev_noslot,
+                        const int64_t* offsets, const int32_t* targets,
+                        const int64_t* toffsets, const int32_t* dims,
+                        int32_t n_hist, int32_t max_proc,
+                        int64_t max_configs, int32_t n_threads,
+                        int32_t* out /* [B, 2] */) {
+  if (n_threads < 1) n_threads = 1;
+  std::vector<std::thread> pool;
+  std::vector<int32_t> counter(1, 0);
+  auto work = [&](int tid) {
+    for (int32_t b = tid; b < n_hist; b += n_threads) {
+      int64_t lo = offsets[b];
+      int32_t n = static_cast<int32_t>(offsets[b + 1] - lo);
+      jt_wgl_check(ev_type + lo, ev_proc + lo, ev_kind + lo,
+                   ev_noslot ? ev_noslot + lo : nullptr, n,
+                   targets + toffsets[b], dims[2 * b], dims[2 * b + 1],
+                   max_proc, max_configs, out + 2 * b);
+    }
+  };
+  for (int t = 0; t < n_threads; ++t) pool.emplace_back(work, t);
+  for (auto& th : pool) th.join();
+}
+
+// Encoder walk: lower an event stream to the TPU kernel's ok-event
+// arrays (the native twin of jepsen_tpu.ops.encode.encode_history).
+//
+// Outputs (caller-allocated):
+//   out_slot  [n]            completing slot per ok event
+//   out_slots [n * max_slots] slot-table snapshots (-1 = empty)
+//   out_opidx [n]            source event index per ok event
+//   out_meta  [2]            = {n_ok, max_live}
+// Returns 0 on success, -1 if the pending window exceeds max_slots.
+int32_t jt_encode(const int32_t* ev_type, const int32_t* ev_proc,
+                  const int32_t* ev_kind, const uint8_t* ev_noslot,
+                  int32_t n, int32_t max_proc, int32_t max_slots,
+                  int32_t* out_slot, int32_t* out_slots,
+                  int32_t* out_opidx, int32_t* out_meta) {
+  SlotState slots(max_slots, max_proc);
+  int32_t n_ok = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    int32_t t = ev_type[i];
+    if (t == EV_INVOKE) {
+      if (ev_noslot && ev_noslot[i]) continue;
+      if (slots.free_slots.empty()) return -1;
+      int s = slots.free_slots.back();
+      slots.free_slots.pop_back();
+      slots.slot_kind[s] = ev_kind[i];
+      slots.slot_of_proc[ev_proc[i]] = s;
+      if (++slots.live > slots.max_live) slots.max_live = slots.live;
+    } else if (t == EV_INFO) {
+      slots.slot_of_proc[ev_proc[i]] = -1;
+    } else if (t == EV_OK) {
+      int s = slots.slot_of_proc[ev_proc[i]];
+      if (s < 0) continue;
+      out_slot[n_ok] = s;
+      out_opidx[n_ok] = i;
+      std::memcpy(out_slots + static_cast<int64_t>(n_ok) * max_slots,
+                  slots.slot_kind.data(), max_slots * sizeof(int32_t));
+      ++n_ok;
+      slots.slot_kind[s] = -1;
+      slots.slot_of_proc[ev_proc[i]] = -1;
+      slots.free_slots.push_back(s);
+      --slots.live;
+    }
+  }
+  out_meta[0] = n_ok;
+  out_meta[1] = slots.max_live;
+  return 0;
+}
+
+}  // extern "C"

@@ -79,10 +79,13 @@ class IPTablesNet(Net):
         for src in sources:
             h = escape(str(src))
             parts.append(
-                f"ip=$(getent ahosts {h} | awk 'NR==1{{print $1}}'); "
+                f"ip=$(getent ahosts {h} | awk 'NR==1{{print $1}}') && "
+                f"test -n \"$ip\" && "
                 f"iptables -A INPUT -s \"$ip\" -j DROP -w")
         with su():
-            exec_star("; ".join(parts))
+            # && so any failed resolution/rule fails the whole exec — a
+            # partition that half-installed must not look installed.
+            exec_star(" && ".join(parts))
 
     def heal(self, test):
         def f(t, node):
@@ -126,10 +129,19 @@ class IPFilterNet(IPTablesNet):
 
     def drop(self, test, src, dest):
         def f(t, node):
-            with su():
-                exec_("echo", "block", "in", "from", str(src), "to", "any",
-                      lit("|"), "ipf", "-f", "-")
+            self.drop_local(t, [src])
         on_nodes(test, f, [dest])
+
+    def drop_local(self, test, sources) -> None:
+        # Must override the inherited iptables path: these nodes speak
+        # ipf. Same all-or-nothing discipline.
+        if not sources:
+            return
+        from .control.core import escape, exec_star
+        parts = [f"echo block in from {escape(str(src))} to any | ipf -f -"
+                 for src in sources]
+        with su():
+            exec_star(" && ".join(parts))
 
     def heal(self, test):
         def f(t, node):

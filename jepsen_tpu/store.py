@@ -176,10 +176,16 @@ class Store:
     def load_histories(self, test_name: str,
                        timestamps: Optional[Sequence[str]] = None
                        ) -> List[List[Op]]:
-        """Every stored history for a test — the batch-recheck seam."""
+        """Every stored history for a test — the batch-recheck seam.
+        Runs that crashed before writing a history are skipped."""
         ts = timestamps if timestamps is not None else \
             self.tests().get(test_name, [])
-        return [self.load(test_name, t)["history"] for t in ts]
+        out = []
+        for t in ts:
+            loaded = self.load(test_name, t)
+            if "history" in loaded:
+                out.append(loaded["history"])
+        return out
 
     def delete(self, test_name: str, ts: Optional[str] = None) -> None:
         """Remove a run, or all of a test's runs (store.clj:328-345)."""
