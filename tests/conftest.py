@@ -1,20 +1,7 @@
 """Test environment: force JAX onto a virtual 8-device CPU mesh so
 multi-chip sharding paths compile and execute without TPU hardware.
-Must run before jax is imported anywhere."""
-import os
+Must run before jax is imported anywhere (jepsen_tpu.provision is
+import-light; device benchmarking lives in bench.py)."""
+from jepsen_tpu.provision import provision_in_process
 
-# Force CPU even when the environment points at real accelerators
-# (JAX_PLATFORMS=axon etc.): unit tests exercise sharding on the virtual
-# mesh; device benchmarking lives in bench.py.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
-
-# The hosted-TPU plugin ("axon") overrides JAX_PLATFORMS at import, so
-# pin the platform through jax.config as well, before any backend init.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+provision_in_process(8)
