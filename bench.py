@@ -27,6 +27,9 @@ def main():
     baseline_rate = 10_000 / 60.0  # north-star target, histories/sec
 
     import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
     import numpy as np
     from jepsen_tpu.checkers.linearizable import prepare_history
     from jepsen_tpu.models.core import cas_register
@@ -46,38 +49,55 @@ def main():
     t_encode = time.time() - t0
     n_fallback = sum(len(b.failures) for b in buckets)
 
+    # The tail of info-heavy (large-W) cost classes is a handful of rows:
+    # route buckets below the threshold to the native CPU engine rather
+    # than paying an XLA compile + widest-frontier scan for each.
+    min_dev = int(os.environ.get("JT_BENCH_MIN_DEVICE_BATCH", "32"))
+    dev_buckets = [b for b in buckets if b.batch >= min_dev]
+    cpu_rows = [i for b in buckets if b.batch < min_dev for i in b.indices]
+    cpu_hists = [hists[i] for i in cpu_rows]
+    try:
+        from jepsen_tpu.native import check_batch_native, lib as _native_lib
+        _native_lib()                          # build/load outside timing
+    except Exception:
+        check_batch_native = None
+        cpu_rows, cpu_hists = [], []
+        dev_buckets = buckets
+
     def run_all():
-        return [run_encoded_batch(b) for b in buckets]
+        outs = [run_encoded_batch(b) for b in dev_buckets]
+        if cpu_hists:
+            n_bad = sum(1 for r in check_batch_native(model, cpu_hists)
+                        if r["valid"] is not True)
+        else:
+            n_bad = 0
+        return outs, n_bad
 
     # Warmup / compile.
     t0 = time.time()
-    outs = run_all()
+    outs, cpu_bad = run_all()
     t_compile = time.time() - t0
 
     times = []
     for _ in range(repeats):
         t0 = time.time()
-        outs = run_all()
+        outs, cpu_bad = run_all()
         times.append(time.time() - t0)
     t_dev = min(times)
 
     n_checked = sum(b.batch for b in buckets)
-    n_invalid = int(sum(int((~v).sum()) for v, _ in outs))
+    n_invalid = int(sum(int((~v).sum()) for v, _, _ in outs)) + cpu_bad
     rate = n_checked / t_dev
 
     # Native-CPU comparison point on a subsample (the host twin of the
     # device kernel; scaled to a full-batch rate estimate).
     native_rate = None
-    try:
-        from jepsen_tpu.native import check_batch_native, lib
-        lib()                                  # build/load outside timing
+    if check_batch_native is not None:
         sub = hists[:min(64, B)]
         check_batch_native(model, sub[:4])     # warm caches
         t0 = time.time()
         check_batch_native(model, sub)
         native_rate = round(len(sub) / (time.time() - t0), 2)
-    except Exception:
-        pass
 
     print(json.dumps({
         "metric": "linearizability_check_throughput_1kop_cas",

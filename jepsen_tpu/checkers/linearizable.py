@@ -44,14 +44,41 @@ def prepare_history(history: List[Op]) -> List[Op]:
     return h
 
 
+def _droppable_invocations(model: Model, h: List[Op],
+                           space_cache: Optional[dict] = None) -> set:
+    """Never-ok total-identity invocations (jepsen_tpu.ops.encode
+    .dropped_invocations — the shared rule that keeps every engine's
+    config sets identical). Empty when the state space is unbounded
+    (those histories never reach the TPU path, so parity is moot);
+    ``space_cache`` memoizes the enumeration (None = exploded) across a
+    batch sharing one op vocabulary."""
+    from ..ops.encode import dropped_invocations
+    from ..ops.statespace import (StateSpaceExplosion, enumerate_statespace,
+                                  history_kinds)
+    kinds = history_kinds(h)
+    key = (model, tuple(kinds))
+    if space_cache is not None and key in space_cache:
+        space = space_cache[key]
+    else:
+        try:
+            space = enumerate_statespace(model, kinds, 64)
+        except StateSpaceExplosion:
+            space = None
+        if space_cache is not None:
+            space_cache[key] = space
+    return dropped_invocations(space, h) if space is not None else set()
+
+
 def wgl_check(model: Model, history: List[Op],
-              max_configs: int = 2_000_000) -> dict:
+              max_configs: int = 2_000_000,
+              space_cache: Optional[dict] = None) -> dict:
     """Exact linearizability decision for one history.
 
     Returns {"valid": bool|"unknown", "op": first-impossible-op,
              "configs": sample of surviving configs before failure}.
     """
     h = prepare_history(history)
+    dropped = _droppable_invocations(model, h, space_cache)
 
     configs = {(model, frozenset())}
     pending: dict = {}            # op-id -> op (with observed value)
@@ -77,8 +104,10 @@ def wgl_check(model: Model, history: List[Op],
         return seen
 
     try:
-        for op in h:
+        for pos, op in enumerate(h):
             if op.type == INVOKE:
+                if pos in dropped:
+                    continue
                 oid = op.index if op.index is not None else id(op)
                 pending[oid] = op
                 open_by_process[op.process] = oid
@@ -106,10 +135,12 @@ def wgl_check(model: Model, history: List[Op],
 
 
 def _sample_configs(configs, n: int = 10):
-    out = []
-    for m, s in list(configs)[:n]:
-        out.append({"model": repr(m), "pending": sorted(s)})
-    return out
+    """Bounded, deterministic config sample (the reference truncates
+    equivalent output to 10 — checker.clj:104-107). Sorted so the host,
+    native, and TPU engines produce comparable samples."""
+    out = [{"model": repr(m), "pending": sorted(s)} for m, s in configs]
+    out.sort(key=lambda c: (c["model"], c["pending"]))
+    return out[:n]
 
 
 class LinearizableChecker(Checker):

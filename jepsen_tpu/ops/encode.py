@@ -36,9 +36,13 @@ from ..models.core import Model
 from .statespace import (StateSpace, StateSpaceExplosion, enumerate_statespace,
                          history_kinds, op_kind)
 
-# Event type codes (kernel-side contract).
+# Event type codes (kernel-side contract). EV_CLOSE is the final "flush"
+# event: it closes the frontier under the end-of-history pending table
+# (crashed/indeterminate ops) so the surviving config set matches the
+# host engine's exactly; it never filters.
 EV_PAD = 0
 EV_OK = 2
+EV_CLOSE = 3
 
 # Slot-table entry for an empty slot; remapped to the all-invalid sentinel
 # row of the padded transition table at stacking time.
@@ -49,6 +53,7 @@ EMPTY = -1
 class EncodedHistory:
     """One history lowered to kernel inputs (unpadded lengths)."""
 
+    ev_type: np.ndarray    # [n] int32 — EV_OK, final entry EV_CLOSE
     ev_slot: np.ndarray    # [n] int32 — completing slot per ok event
     ev_slots: np.ndarray   # [n, max_live] int32 — slot-table snapshot
                            #   (op-kind index per slot, EMPTY when free)
@@ -69,6 +74,41 @@ class EncodedHistory:
 @dataclass
 class EncodeFailure:
     reason: str
+
+
+def completion_types(prepared: Sequence[Op]) -> Dict[int, str]:
+    """Map invocation position -> its completion's type (missing when the
+    op never completes). One walk, shared by the encoder, the replay
+    helper, and the host engine's drop rule."""
+    out: Dict[int, str] = {}
+    open_inv: Dict[object, int] = {}
+    for pos, o in enumerate(prepared):
+        if o.type == INVOKE:
+            open_inv[o.process] = pos
+        elif o.is_completion and o.process in open_inv:
+            out[open_inv.pop(o.process)] = o.type
+    return out
+
+
+def dropped_invocations(space: StateSpace, prepared: Sequence[Op],
+                        completion: Optional[Dict[int, str]] = None) -> set:
+    """Positions of invocations that never complete ok and whose
+    transition is the total identity over the reachable space (e.g. a
+    timed-out read that observed nothing). They constrain no
+    configuration — firing one changes no state, and no completion ever
+    filters on it — so every engine drops them: the device encoder to
+    keep the pending window W (cost 2^W) proportional to real
+    concurrency, the host engine to keep config sets identical across
+    engines."""
+    identity = space.identity_kinds
+    if not identity:
+        return set()
+    if completion is None:
+        completion = completion_types(prepared)
+    return {pos for pos, o in enumerate(prepared)
+            if o.type == INVOKE
+            and space.kind_index[op_kind(o)] in identity
+            and completion.get(pos) != OK}
 
 
 def encode_history(model: Model, prepared: List[Op], *,
@@ -93,17 +133,9 @@ def encode_history(model: Model, prepared: List[Op], *,
             return EncodeFailure(str(e))
         if space_cache is not None:
             space_cache[key] = space
-    identity = space.identity_kinds
+    dropped = dropped_invocations(space, prepared)
 
-    # Which invocations never complete ok? (info or missing completion)
-    completion_type: Dict[int, str] = {}   # invoke position -> type
-    open_inv: Dict[object, int] = {}
-    for pos, o in enumerate(prepared):
-        if o.type == INVOKE:
-            open_inv[o.process] = pos
-        elif o.is_completion and o.process in open_inv:
-            completion_type[open_inv.pop(o.process)] = o.type
-
+    ev_type: List[int] = []
     ev_slot: List[int] = []
     ev_slots: List[List[int]] = []
     ev_opidx: List[int] = []
@@ -116,21 +148,21 @@ def encode_history(model: Model, prepared: List[Op], *,
 
     for pos, o in enumerate(prepared):
         if o.type == INVOKE:
-            ki = space.kind_index[op_kind(o)]
-            if ki in identity and completion_type.get(pos) != OK:
-                continue   # total-identity op that never completes: drop
+            if pos in dropped:
+                continue
             if not free:
                 return EncodeFailure(
                     f"more than {max_slots} concurrently-pending ops")
             slot = free.pop()
             slot_of[o.process] = slot
-            table[slot] = ki
+            table[slot] = space.kind_index[op_kind(o)]
             live += 1
             max_live = max(max_live, live)
         elif o.type == OK:
             slot = slot_of.pop(o.process, None)
             if slot is None:
                 continue  # completion with no open invocation
+            ev_type.append(EV_OK)
             ev_slot.append(slot)
             ev_slots.append(table.copy())   # snapshot WITH the op pending
             ev_opidx.append(o.index if o.index is not None else pos)
@@ -141,17 +173,65 @@ def encode_history(model: Model, prepared: List[Op], *,
             # Indeterminate: stays pending to the end; slot stays pinned.
             slot_of.pop(o.process, None)
 
+    # Final flush: close the frontier under the end-of-history pending
+    # table (pinned info/crashed ops) so the surviving config set matches
+    # the host engine's final closure exactly.
+    ev_type.append(EV_CLOSE)
+    ev_slot.append(0)
+    ev_slots.append(table.copy())
+    ev_opidx.append(-1)
+
     n = len(ev_slot)
     w = max(max_live, 1)
     return EncodedHistory(
+        ev_type=np.asarray(ev_type, dtype=np.int32),
         ev_slot=np.asarray(ev_slot, dtype=np.int32),
-        ev_slots=(np.asarray(ev_slots, dtype=np.int32)[:, :w]
-                  if n else np.zeros((0, w), np.int32)),
+        ev_slots=np.asarray(ev_slots, dtype=np.int32)[:, :w],
         ev_opidx=np.asarray(ev_opidx, dtype=np.int32),
         space=space,
         max_live=max_live,
         n_events=n,
     )
+
+
+def slot_ops_at_event(space: StateSpace, prepared: List[Op],
+                      event_index: Optional[int] = None, *,
+                      max_slots: int = 32) -> Dict[int, int]:
+    """Replay the encode walk to recover ``{slot: op history-index}`` —
+    the pending table as of encoded event ``event_index`` (the snapshot
+    the device saw, including the completing op), or the final pending
+    table when ``event_index`` is None. Host-side, O(n); used only to
+    decode frontier masks into config samples for result reporting.
+
+    ``max_slots`` defaults to 32, the frontier mask width — allocation
+    pops the lowest free slot, so a larger pool assigns the same slots
+    as any smaller pool the history actually fit in.
+    """
+    dropped = dropped_invocations(space, prepared)
+
+    table_op: Dict[int, int] = {}
+    free = list(range(max_slots - 1, -1, -1))
+    slot_of: Dict[object, int] = {}
+    e = 0
+    for pos, o in enumerate(prepared):
+        if o.type == INVOKE:
+            if pos in dropped or not free:
+                continue
+            slot = free.pop()
+            slot_of[o.process] = slot
+            table_op[slot] = o.index if o.index is not None else pos
+        elif o.type == OK:
+            slot = slot_of.pop(o.process, None)
+            if slot is None:
+                continue
+            if event_index is not None and e == event_index:
+                return dict(table_op)
+            del table_op[slot]
+            free.append(slot)
+            e += 1
+        elif o.type == INFO:
+            slot_of.pop(o.process, None)
+    return dict(table_op)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -171,7 +251,8 @@ class EncodedBatch:
       ev_opidx — int32 [B, N]
       target   — int32 [B, K + 1, V]; final row = all-invalid sentinel
     ``indices`` maps batch rows back to positions in the caller's history
-    list; ``failures`` lists (position, reason) needing host fallback.
+    list; ``spaces`` holds each row's StateSpace (for result decoding);
+    ``failures`` lists (position, reason) needing host fallback.
     """
 
     ev_type: np.ndarray
@@ -183,6 +264,7 @@ class EncodedBatch:
     W: int
     indices: List[int]
     failures: List[Tuple[int, str]]
+    spaces: List[StateSpace] = None
 
     @property
     def batch(self) -> int:
@@ -221,9 +303,10 @@ def stack_encoded(encs: Sequence[Tuple[int, EncodedHistory]],
         z = np.zeros((0, 0), np.int32)
         return EncodedBatch(z, z, np.zeros((0, 0, min_w), np.int32), z,
                             target=np.zeros((0, 1, min_v), np.int32),
-                            V=min_v, W=min_w, indices=[], failures=failures)
+                            V=min_v, W=min_w, indices=[], failures=failures,
+                            spaces=[])
 
-    V = _round_up(max(max(e.n_states for _, e in encs), min_v), 4)
+    V = _round_up(max(max(e.n_states for _, e in encs), min_v), 8)
     W = max(max(max(e.max_live for _, e in encs), min_w), 1)
     K = max(max(e.n_kinds for _, e in encs), 1)
     N = _round_up(max(max(e.n_events for _, e in encs), 1), 8)
@@ -237,18 +320,18 @@ def stack_encoded(encs: Sequence[Tuple[int, EncodedHistory]],
     target = np.full((Bp, K + 1, V), -1, np.int32)
 
     for row, (_, e) in enumerate(encs):
-        n, w = e.n_events, e.ev_slots.shape[1] if e.n_events else 0
-        ev_type[row, :n] = EV_OK
+        n, w = e.n_events, e.ev_slots.shape[1]
+        ev_type[row, :n] = e.ev_type
         ev_slot[row, :n] = e.ev_slot
-        if n:
-            snap = e.ev_slots.astype(np.int64)
-            ev_slots[row, :n, :w] = np.where(snap == EMPTY, K, snap)
+        snap = e.ev_slots.astype(np.int64)
+        ev_slots[row, :n, :w] = np.where(snap == EMPTY, K, snap)
         ev_opidx[row, :n] = e.ev_opidx
         target[row] = e.space.padded_target(V, K)
 
     return EncodedBatch(ev_type=ev_type, ev_slot=ev_slot, ev_slots=ev_slots,
                         ev_opidx=ev_opidx, target=target, V=V, W=W,
-                        indices=[i for i, _ in encs], failures=failures)
+                        indices=[i for i, _ in encs], failures=failures,
+                        spaces=[e.space for _, e in encs])
 
 
 def batch_encode(model: Model, prepared_histories: Sequence[List[Op]], *,
@@ -268,16 +351,19 @@ def bucket_encode(model: Model, prepared_histories: Sequence[List[Op]], *,
                   min_v: int = 8, min_w: int = 4) -> List[EncodedBatch]:
     """Encode histories grouped into (V, W) cost-class buckets.
 
-    Kernel cost scales with V * 2^W * events: one info-heavy history
-    (large pending window W) must not inflate the frontier of thousands
-    of clean ones, so each bucket pads only to its own class. Failures
-    ride on the first bucket."""
+    Kernel cost scales with 2^W * events: one info-heavy history (large
+    pending window W) must not inflate the frontier of thousands of
+    clean ones, so each bucket pads only to its own class. W buckets are
+    exact — every extra pending slot doubles frontier cost, so rounding
+    W up is far more expensive than an extra compile. V (which only sets
+    the kernel's unroll count) rounds to multiples of 8. Failures ride
+    on the first bucket."""
     encs, failures = encode_all(model, prepared_histories,
                                 max_states=max_states, max_slots=max_slots)
     groups: Dict[Tuple[int, int], List[Tuple[int, EncodedHistory]]] = {}
     for i, e in encs:
-        key = (_round_up(max(e.n_states, min_v), 4),
-               _round_up(max(e.max_live, min_w), 4))
+        key = (_round_up(max(e.n_states, min_v), 8),
+               max(e.max_live, min_w))
         groups.setdefault(key, []).append((i, e))
     out = []
     for j, (key, group) in enumerate(sorted(groups.items())):

@@ -1,11 +1,12 @@
-"""Dense-frontier WGL linearizability kernel.
+"""Packed-frontier WGL linearizability kernel.
 
 The WGL configuration set (see jepsen_tpu.checkers.linearizable for the
 algorithm spec; the reference delegates the same search to Knossos at
-jepsen/src/jepsen/checker.clj:82-107) is represented densely as a boolean
-frontier
+jepsen/src/jepsen/checker.clj:82-107) is represented as a *state-packed*
+boolean frontier: config (state s, linearized-pending-set m) is bit
+``s % 32`` of word
 
-    F[s, m] = 1  iff  config (state s, linearized-pending-set m) reachable
+    F[s // 32][m]        # one uint32 array of length M = 2^W per word
 
 with ``m`` ranging over all 2^W subsets of the W pending-op slots. The
 host encoder (jepsen_tpu.ops.encode) reduces the history to ok-completion
@@ -13,23 +14,26 @@ events, each carrying a precomputed snapshot of the pending-slot table;
 a ``lax.scan`` drives one event per step:
 
   * close F under application of pending ops: for each occupied slot i,
-    (s, m w/o i) → (target[s], m | i). One application is a static
-    reshape splitting mask-bit i plus a V×V one-hot "transition matmul"
-    on the state axis; closure iterates to fixpoint via
-    ``lax.while_loop`` (monotone OR, ≤ live-slots iterations;
-    re-running converged lanes under vmap is idempotent);
+    (s, m w/o i) → (target[s], m | i). One application splits mask bit i
+    with a static reshape and applies the transition as V unrolled
+    bit-extract / select-row / OR steps over packed words — pure VPU work
+    on full 32-config lanes (V×V one-hot matmuls with V≈8 cannot feed
+    the MXU; the packed formulation replaces them outright). Closure
+    iterates to fixpoint via ``lax.while_loop`` (monotone OR, ≤ live
+    slots iterations; re-running converged lanes under vmap is
+    idempotent);
   * keep exactly the configs whose mask holds the completing slot's bit,
-    clear it (a dynamic gather along the mask axis — no per-slot
-    branching). An empty survivor set means the completed op cannot be
-    linearized: the history is invalid and the event index is recorded
-    (it maps back to the offending op for Knossos-parity counterexample
-    reporting).
+    clear it — a ``lax.switch`` over W static shift-halves of the mask
+    axis (no gathers). An empty survivor set means the completed op
+    cannot be linearized: the history is invalid, the event index is
+    recorded, and the pre-completion frontier is latched so the host can
+    decode a Knossos-parity counterexample config sample.
 
-Shapes are fully static: [V, 2^W] per history, vmapped over the batch and
-shardable over the device mesh on the batch axis (jepsen_tpu.parallel).
-The mask axis provides long 128-lane vectors for the VPU and the
-transition matmuls batch onto the MXU. Cost scales with V * 2^W * events,
-so callers bucket histories by (V, W) cost class before batching.
+Shapes are fully static: [words(V), 2^W] per history, vmapped over the
+batch and shardable over the device mesh on the batch axis
+(jepsen_tpu.parallel). The mask axis provides long 128-lane vectors for
+the VPU. Cost scales with 2^W * events, so callers bucket histories by
+(V, W) cost class before batching.
 """
 from __future__ import annotations
 
@@ -43,79 +47,158 @@ import numpy as np
 
 from ..history.ops import Op
 from ..models.core import Model
-from .encode import (EV_OK, EncodedBatch, EncodeFailure,
-                     batch_encode, bucket_encode, encode_history)
+from .encode import (EV_CLOSE, EV_OK, EncodedBatch, EncodeFailure,
+                     batch_encode, bucket_encode, encode_history,
+                     slot_ops_at_event)
 
 INT32_MAX = np.int32(2**31 - 1)
 
+# Widest state space the packed kernel accepts: two 32-state words.
+MAX_PACKED_STATES = 64
 
-def _apply_slot(F: jnp.ndarray, i: int, tgt_i: jnp.ndarray,
-                V: int, M: int) -> jnp.ndarray:
+
+def n_state_words(V: int) -> int:
+    return (V + 31) // 32
+
+
+def pack_rows(target: jnp.ndarray, V: int) -> Tuple[jnp.ndarray, ...]:
+    """Lower a transition table to packed one-hot target rows.
+
+    target: [K+1, V] int32 (-1 = inconsistent; final row = empty-slot
+    sentinel, all -1). Returns one [K+1, V] uint32 array per state word:
+    rows[w][k, s] has bit (target[k, s] - 32w) set when the target state
+    lands in word w, else 0.
+    """
+    out = []
+    for w in range(n_state_words(V)):
+        t = target - 32 * w
+        in_word = (t >= 0) & (t < 32)
+        shift = jnp.clip(t, 0, 31).astype(jnp.uint32)
+        out.append(jnp.where(in_word, jnp.uint32(1) << shift, jnp.uint32(0)))
+    return tuple(out)
+
+
+def transition(src: Tuple[jnp.ndarray, ...], rows_i: Tuple[jnp.ndarray, ...],
+               V: int) -> Tuple[jnp.ndarray, ...]:
+    """Apply one op to every packed config: out = ⋃_s {src has state s} ·
+    rows_i[s]. ``src`` words share any shape; ``rows_i`` is [V] per word.
+    Empty slots carry all-zero rows, making their application a no-op.
+    """
+    out = [None] * len(src)
+    for s in range(V):
+        bit = (src[s >> 5] >> jnp.uint32(s & 31)) & jnp.uint32(1)
+        for w in range(len(src)):
+            contrib = bit * rows_i[w][s]
+            out[w] = contrib if out[w] is None else out[w] | contrib
+    return tuple(out)
+
+
+def _apply_slot(F: Tuple[jnp.ndarray, ...], i: int,
+                rows_i: Tuple[jnp.ndarray, ...], V: int,
+                M: int) -> Tuple[jnp.ndarray, ...]:
     """Close F one step under the op in slot ``i``: every config without
-    bit i spawns (target-state, mask | bit i). ``tgt_i`` is the op's [V]
-    transition vector (-1 where inconsistent; all -1 for empty slots)."""
+    bit i spawns (target-state, mask | bit i)."""
     hi, lo = M >> (i + 1), 1 << i
-    Fr = F.reshape(V, hi, 2, lo)
-    src = Fr[:, :, 0, :].reshape(V, hi * lo)
-    onehot = tgt_i[:, None] == jnp.arange(V, dtype=jnp.int32)[None, :]
-    G = jnp.matmul(onehot.astype(jnp.bfloat16).T,
-                   src.astype(jnp.bfloat16),
-                   preferred_element_type=jnp.float32) > 0
-    out1 = Fr[:, :, 1:, :] | G.reshape(V, hi, 1, lo)
-    return jnp.concatenate([Fr[:, :, :1, :], out1], axis=2).reshape(V, M)
+    Fr = [f.reshape(hi, 2, lo) for f in F]
+    src = tuple(fr[:, 0, :] for fr in Fr)
+    new = transition(src, rows_i, V)
+    return tuple(
+        jnp.concatenate([fr[:, :1, :], fr[:, 1:, :] | n[:, None, :]], axis=1)
+           .reshape(M)
+        for fr, n in zip(Fr, new))
 
 
-def _complete_slot(F: jnp.ndarray, slot: jnp.ndarray, M: int) -> jnp.ndarray:
+def _complete_slot(F: Tuple[jnp.ndarray, ...], slot: jnp.ndarray, M: int,
+                   W: int) -> Tuple[jnp.ndarray, ...]:
     """OK-completion of the op in (dynamic) slot: keep configs whose mask
-    has the slot bit set, with the bit cleared."""
-    idx = jnp.arange(M, dtype=jnp.int32)
-    bit = jnp.int32(1) << slot
-    survivors = jnp.take(F, idx | bit, axis=1)
-    return jnp.where((idx & bit) == 0, survivors, False)
+    has the slot bit set, with the bit cleared. Static mask-axis reshape
+    per branch; ``lax.switch`` picks the branch."""
+    def make(i):
+        def branch(F):
+            hi, lo = M >> (i + 1), 1 << i
+            out = []
+            for f in F:
+                fr = f.reshape(hi, 2, lo)
+                out.append(jnp.concatenate(
+                    [fr[:, 1:, :], jnp.zeros_like(fr[:, 1:, :])],
+                    axis=1).reshape(M))
+            return tuple(out)
+        return branch
+
+    return lax.switch(slot, [make(i) for i in range(W)], F)
+
+
+def _union(F: Tuple[jnp.ndarray, ...]) -> jnp.ndarray:
+    acc = F[0]
+    for f in F[1:]:
+        acc = acc | f
+    return acc
+
+
+def _changed(Fa, Fb) -> jnp.ndarray:
+    acc = (Fa[0] != Fb[0]).any()
+    for a, b in zip(Fa[1:], Fb[1:]):
+        acc = acc | (a != b).any()
+    return acc
 
 
 def make_kernel(V: int, W: int):
     """Build the single-history checker for static bounds (V, W).
 
-    Returns ``check(ev_type, ev_slot, ev_slots, target) -> (valid, bad)``
-    where ``bad`` is the event index of the first impossible completion
-    (INT32_MAX when valid). vmap/shard over a leading batch axis.
+    Returns ``check(ev_type, ev_slot, ev_slots, target) ->
+    (valid, bad, frontier)`` where ``bad`` is the event index of the
+    first impossible completion (INT32_MAX when valid) and ``frontier``
+    is the packed [words(V), 2^W] config set — the pre-failure closure
+    when invalid, the final config set when valid (counterexample /
+    result decoding: ``decode_frontier``). vmap/shard over a leading
+    batch axis.
     """
+    assert V <= MAX_PACKED_STATES, "packed kernel bound; use host fallback"
     M = 1 << W
+    NW = n_state_words(V)
 
-    def closure(F, slots_row, target):
-        tgt = target[slots_row]  # [W, V]; empty slots gather the
-                                 # all-invalid sentinel row.
+    def closure(F, slots_row, rows):
+        tgt = tuple(r[slots_row] for r in rows)  # [W, V] per word; empty
+                                                 # slots gather zero rows.
 
         def body(carry):
             F0, _ = carry
             Fn = F0
             for i in range(W):
-                Fn = _apply_slot(Fn, i, tgt[i], V, M)
-            return Fn, (Fn != F0).any()
+                Fn = _apply_slot(Fn, i, tuple(t[i] for t in tgt), V, M)
+            return Fn, _changed(Fn, F0)
 
         F, _ = lax.while_loop(lambda c: c[1], body, (F, jnp.bool_(True)))
         return F
 
     def check(ev_type, ev_slot, ev_slots, target):
+        rows = pack_rows(target, V)
+
         def step(carry, ev):
-            F, valid, bad = carry
+            F, Fbad, valid, bad = carry
             typ, slot, slots_row, idx = ev
             is_ok = typ == EV_OK
-            Fc = closure(F, slots_row, target)
-            F_ok = _complete_slot(Fc, slot, M)
-            empty = is_ok & ~F_ok.any()
-            F2 = jnp.where(is_ok, F_ok, F)
-            return (F2, valid & ~empty,
+            is_close = typ == EV_CLOSE  # final flush: keep the closure
+            Fc = closure(F, slots_row, rows)
+            F_ok = _complete_slot(Fc, slot, M, W)
+            empty = is_ok & ~(_union(F_ok) != 0).any()
+            first = empty & valid
+            F2 = tuple(jnp.where(is_ok, a, jnp.where(is_close, c, b))
+                       for a, c, b in zip(F_ok, Fc, F))
+            Fb2 = tuple(jnp.where(first, c, b) for c, b in zip(Fc, Fbad))
+            return (F2, Fb2, valid & ~empty,
                     jnp.minimum(bad, jnp.where(empty, idx, INT32_MAX))), None
 
         N = ev_type.shape[0]
-        F0 = jnp.zeros((V, M), jnp.bool_).at[0, 0].set(True)
-        carry = (F0, jnp.bool_(True), jnp.int32(INT32_MAX))
-        (F, valid, bad), _ = lax.scan(
+        Fz = tuple(jnp.zeros((M,), jnp.uint32) for _ in range(NW))
+        F0 = (Fz[0].at[0].set(jnp.uint32(1)),) + Fz[1:]
+        carry = (F0, Fz, jnp.bool_(True), jnp.int32(INT32_MAX))
+        (F, Fbad, valid, bad), _ = lax.scan(
             step, carry, (ev_type, ev_slot, ev_slots,
                           jnp.arange(N, dtype=jnp.int32)))
-        return valid, bad
+        frontier = jnp.stack(
+            [jnp.where(valid, a, b) for a, b in zip(F, Fbad)])
+        return valid, bad, frontier
 
     return check
 
@@ -133,66 +216,133 @@ def batch_kernel(V: int, W: int):
     return k
 
 
-# Frontier-elements budget per device dispatch: B * V * 2^W bools. Keeps
-# the scan carry (plus XLA's temporaries) well inside one chip's HBM even
-# for info-heavy windows (W=16 → 0.5 MB/history).
-MAX_FRONTIER_ELEMENTS = 1 << 27
+# Frontier-words budget per device dispatch: B * words(V) * 2^W uint32.
+# Keeps the scan carry (plus XLA's temporaries) well inside one chip's
+# HBM even for info-heavy windows (W=16 → 0.5 MB/history).
+MAX_FRONTIER_ELEMENTS = 1 << 26
 
 
-def run_encoded_batch(batch: EncodedBatch) -> Tuple[np.ndarray, np.ndarray]:
-    """Device-check an encoded batch. Returns (valid [B] bool, bad [B]).
-    Large batches are chunked to bound device memory."""
+def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
+    """Device-check an encoded batch. Returns (valid [B] bool, bad [B],
+    frontier) — frontier is [B, words(V), 2^W] uint32 when requested and
+    None otherwise (skipping the device→host transfer, which hot paths
+    that only need verdicts shouldn't pay). Large batches are chunked to
+    bound device memory."""
     if batch.batch == 0:
-        return np.zeros((0,), bool), np.zeros((0,), np.int32)
+        z = np.zeros((0,), bool)
+        return (z, np.zeros((0,), np.int32),
+                np.zeros((0, 1, 1 << batch.W), np.uint32)
+                if return_frontier else None)
     kern = batch_kernel(batch.V, batch.W)
-    per_hist = batch.V << batch.W
+    per_hist = n_state_words(batch.V) << batch.W
     chunk = max(1, MAX_FRONTIER_ELEMENTS // per_hist)
-    valids, bads = [], []
+    valids, bads, fronts = [], [], []
     for lo in range(0, batch.batch, chunk):
         hi = min(lo + chunk, batch.batch)
-        valid, bad = kern(batch.ev_type[lo:hi], batch.ev_slot[lo:hi],
-                          batch.ev_slots[lo:hi], batch.target[lo:hi])
+        valid, bad, front = kern(
+            batch.ev_type[lo:hi], batch.ev_slot[lo:hi],
+            batch.ev_slots[lo:hi], batch.target[lo:hi])
         valids.append(np.asarray(valid))
         bads.append(np.asarray(bad))
-    return np.concatenate(valids), np.concatenate(bads)
+        if return_frontier:
+            fronts.append(np.asarray(front))
+    return (np.concatenate(valids), np.concatenate(bads),
+            np.concatenate(fronts) if return_frontier else None)
+
+
+def decode_frontier(frontier: np.ndarray, space, slot_to_op: Dict[int, int],
+                    n: int = 10) -> List[dict]:
+    """Decode a packed [words, M] frontier into a bounded, deterministic
+    config sample matching the host engine's shape
+    (checkers.linearizable._sample_configs): ``{"model": repr(state),
+    "pending": sorted linearized op indices}``, sorted, truncated to n —
+    the reference's truncate-to-10 discipline (checker.clj:104-107)."""
+    words, masks = np.nonzero(np.asarray(frontier))
+    configs = []
+    for w, m in zip(words.tolist(), masks.tolist()):
+        bits = int(frontier[w, m])
+        s = 0
+        while bits:
+            if bits & 1:
+                state = 32 * w + s
+                if state < len(space.states):
+                    pend = sorted(slot_to_op[i] for i in range(32)
+                                  if (m >> i) & 1 and i in slot_to_op)
+                    configs.append({"model": repr(space.states[state]),
+                                    "pending": pend})
+            bits >>= 1
+            s += 1
+    configs.sort(key=lambda c: (c["model"], c["pending"]))
+    return configs[:n]
 
 
 def _result_for(row: int, batch: EncodedBatch, valid: np.ndarray,
-                bad: np.ndarray, prepared: List[Op]) -> dict:
+                bad: np.ndarray, frontier: np.ndarray, model: Model,
+                prepared: List[Op]) -> dict:
+    space = batch.spaces[row] if batch.spaces else None
     if bool(valid[row]):
-        return {"valid": True}
+        out = {"valid": True}
+        if space is not None:
+            table = slot_ops_at_event(space, prepared, None)
+            out["configs"] = decode_frontier(frontier[row], space, table)
+        return out
     ev = int(bad[row])
     op_index = int(batch.ev_opidx[row, ev])
     op = next((o for o in prepared if o.index == op_index), None)
-    return {"valid": False,
-            "op": op.to_dict() if op is not None else {"index": op_index}}
+    out = {"valid": False,
+           "op": op.to_dict() if op is not None else {"index": op_index}}
+    if space is not None:
+        table = slot_ops_at_event(space, prepared, ev)
+        out["configs"] = decode_frontier(frontier[row], space, table)
+    return out
 
 
 def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
-                    max_states: int = 64, max_slots: int = 16,
-                    host_fallback=None) -> List[dict]:
+                    max_states: int = MAX_PACKED_STATES, max_slots: int = 16,
+                    host_fallback=None, min_device_batch: int = 1) -> List[dict]:
     """Check many raw histories on device; per-history result dicts.
 
     Histories the encoder cannot bound (state-space explosion, pending
     window overflow) are delegated to ``host_fallback(model, history)``
-    (default: the exact host engine).
+    (default: the exact host engine). Cost-class buckets smaller than
+    ``min_device_batch`` go to the native CPU engine instead — the tail
+    of info-heavy (large-W) histories is typically a handful of rows,
+    not worth an XLA compile or the widest frontier.
     """
     from ..checkers.linearizable import prepare_history, wgl_check
     from ..history.core import index as index_history
-    host_fallback = host_fallback or wgl_check
+    if host_fallback is None:
+        _cache: dict = {}
+
+        def host_fallback(m, h):
+            return wgl_check(m, h, space_cache=_cache)
 
     for h in histories:
         if any(op.index is None for op in h):
             index_history(h)
     prepared = [prepare_history(h) for h in histories]
     buckets = bucket_encode(model, prepared,
-                            max_states=max_states, max_slots=max_slots)
+                            max_states=min(max_states, MAX_PACKED_STATES),
+                            max_slots=max_slots)
 
     results: List[Optional[dict]] = [None] * len(histories)
     for batch in buckets:
-        valid, bad = run_encoded_batch(batch)
-        for row, i in enumerate(batch.indices):
-            results[i] = _result_for(row, batch, valid, bad, prepared[i])
+        if 0 < batch.batch < min_device_batch:
+            try:
+                from ..native import check_batch_native
+                rs = check_batch_native(model,
+                                        [histories[i] for i in batch.indices])
+            except Exception:
+                rs = [host_fallback(model, histories[i])
+                      for i in batch.indices]
+            for i, r in zip(batch.indices, rs):
+                results[i] = r
+        else:
+            valid, bad, front = run_encoded_batch(batch,
+                                                  return_frontier=True)
+            for row, i in enumerate(batch.indices):
+                results[i] = _result_for(row, batch, valid, bad, front,
+                                         model, prepared[i])
         for i, reason in batch.failures:
             r = host_fallback(model, histories[i])
             r.setdefault("fallback", reason)

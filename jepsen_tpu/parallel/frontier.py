@@ -1,19 +1,20 @@
 """Frontier-sharded WGL checking — sequence parallelism for histories.
 
-One history's WGL configuration frontier F[V, 2^W] can exceed a single
-core's VMEM when the pending window W is large (long histories under
-heavy fault injection accumulate indeterminate ops, each pinning a slot —
-SURVEY.md §5 "long-context"). The fix is the sequence-parallel analog for
-this domain: split the mask axis across D = 2^log2D devices, so device d
-holds the configs whose top log2D mask bits equal d.
+One history's WGL configuration frontier (packed words over 2^W mask
+configs — jepsen_tpu.ops.linearize) can exceed a single core's VMEM when
+the pending window W is large (long histories under heavy fault injection
+accumulate indeterminate ops, each pinning a slot — SURVEY.md §5
+"long-context"). The fix is the sequence-parallel analog for this domain:
+split the mask axis across D = 2^log2D devices, so device d holds the
+configs whose top log2D mask bits equal d.
 
   * applies/completions on slots < W_local touch only local mask bits —
     no communication;
   * an apply on top bit b maps configs (s, m w/o bit b) — which live
     entirely on devices with axis-index bit b clear — to (target s,
     m | bit b) on the partner device: a hypercube `lax.ppermute` exchange
-    of the transitioned block;
-  * a completion on top bit b moves the surviving blocks from bit-set
+    of the transitioned words;
+  * a completion on top bit b moves the surviving words from bit-set
     devices to their bit-clear partners (the mask with the bit cleared);
   * emptiness and closure-convergence checks are `lax.psum` reductions
     over the frontier axis.
@@ -25,9 +26,6 @@ proportional to how hard the history actually is.
 """
 from __future__ import annotations
 
-import functools
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -38,45 +36,49 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
-from ..ops.encode import EV_OK
-from ..ops.linearize import _apply_slot, _complete_slot, INT32_MAX
+from ..ops.encode import EV_CLOSE, EV_OK
+from ..ops.linearize import (INT32_MAX, MAX_PACKED_STATES, _apply_slot,
+                             _complete_slot, _changed, _union,
+                             n_state_words, pack_rows, transition)
 
 
-def _top_apply(F, b, tgt_b, V, D):
-    """Close one step under the op in top-bit slot b (cross-device)."""
+def _top_apply(F, b, rows_b, V, D):
+    """Close one step under the op in top-bit slot b (cross-device):
+    every config on a bit-clear device spawns its transitioned twin on
+    the bit-set partner."""
     bit = 1 << b
     ax = lax.axis_index("frontier")
     is_clear = (ax & bit) == 0
-    onehot = tgt_b[:, None] == jnp.arange(V, dtype=jnp.int32)[None, :]
-    G = jnp.matmul(onehot.astype(jnp.bfloat16).T, F.astype(jnp.bfloat16),
-                   preferred_element_type=jnp.float32) > 0
-    G = jnp.where(is_clear, G, False)          # only bit-clear configs spawn
+    new = transition(F, rows_b, V)
+    new = tuple(jnp.where(is_clear, n, jnp.uint32(0)) for n in new)
     perm = [(d, d | bit) for d in range(D) if not (d & bit)]
-    recv = lax.ppermute(G, "frontier", perm)   # non-receivers get zeros
-    return F | recv
+    recv = tuple(lax.ppermute(n, "frontier", perm) for n in new)
+    return tuple(f | r for f, r in zip(F, recv))
 
 
 def _top_complete(F, b, D):
-    """OK-completion of the op in top-bit slot b: surviving blocks move
+    """OK-completion of the op in top-bit slot b: surviving words move
     from bit-set devices to their bit-clear partners."""
     bit = 1 << b
     perm = [(d | bit, d) for d in range(D) if not (d & bit)]
-    recv = lax.ppermute(F, "frontier", perm)
+    recv = tuple(lax.ppermute(f, "frontier", perm) for f in F)
     ax = lax.axis_index("frontier")
-    return jnp.where((ax & bit) == 0, recv, False)
+    is_clear = (ax & bit) == 0
+    return tuple(jnp.where(is_clear, r, jnp.uint32(0)) for r in recv)
 
 
-def _pany(x, axes=("frontier",)) -> jnp.ndarray:
-    """Global any() over the given mesh axes."""
-    return lax.psum(x.any().astype(jnp.int32), axes) > 0
+def _pbool(x, axes=("frontier",)) -> jnp.ndarray:
+    """Global any() of a local boolean over the given mesh axes."""
+    return lax.psum(x.astype(jnp.int32), axes) > 0
 
 
 def make_frontier_kernel(V: int, W: int, D: int,
                          sync_axes=("data", "frontier")):
     """Single-history checker with the frontier split over D devices.
 
-    W is the *global* slot count; each device holds [V, 2^(W - log2 D)].
-    Must run inside a shard_map binding axis name "frontier".
+    W is the *global* slot count; each device holds packed words over
+    2^(W - log2 D) local mask configs. Must run inside a shard_map
+    binding axis name "frontier".
 
     ``sync_axes``: the closure's convergence flag must reduce over EVERY
     mesh axis, not just "frontier" — a data-dependent while_loop that
@@ -85,23 +87,27 @@ def make_frontier_kernel(V: int, W: int, D: int,
     sequence). The global psum makes all devices run the global-max
     iteration count; extra iterations on converged shards are idempotent.
     """
+    assert V <= MAX_PACKED_STATES
     log2d = D.bit_length() - 1
     assert 1 << log2d == D, "frontier axis size must be a power of two"
     W_local = W - log2d
     assert W_local >= 1
     M_local = 1 << W_local
+    NW = n_state_words(V)
 
-    def closure(F, slots_row, target):
-        tgt = target[slots_row]  # [W, V]
+    def closure(F, slots_row, rows):
+        tgt = tuple(r[slots_row] for r in rows)  # [W, V] per word
 
         def body(carry):
             F0, _ = carry
             Fn = F0
             for i in range(W_local):
-                Fn = _apply_slot(Fn, i, tgt[i], V, M_local)
+                Fn = _apply_slot(Fn, i, tuple(t[i] for t in tgt),
+                                 V, M_local)
             for b in range(log2d):
-                Fn = _top_apply(Fn, b, tgt[W_local + b], V, D)
-            return Fn, _pany(Fn != F0, sync_axes)
+                Fn = _top_apply(Fn, b, tuple(t[W_local + b] for t in tgt),
+                                V, D)
+            return Fn, _pbool(_changed(Fn, F0), sync_axes)
 
         # F arrives varying over every mesh axis (the scan carry is
         # pcast below); the convergence flag is invariant — the psum in
@@ -110,31 +116,40 @@ def make_frontier_kernel(V: int, W: int, D: int,
         return F
 
     def complete(F, slot):
-        out = _complete_slot(F, jnp.minimum(slot, W_local - 1), M_local)
+        out = _complete_slot(F, jnp.minimum(slot, W_local - 1), M_local,
+                             W_local)
         for b in range(log2d):
-            out = jnp.where(slot == W_local + b, _top_complete(F, b, D), out)
+            top = _top_complete(F, b, D)
+            out = tuple(jnp.where(slot == W_local + b, t, o)
+                        for t, o in zip(top, out))
         return out
 
     def check(ev_type, ev_slot, ev_slots, target):
+        rows = pack_rows(target, V)
+
         def step(carry, ev):
             F, valid, bad = carry
             typ, slot, slots_row, idx = ev
             is_ok = typ == EV_OK
-            Fc = closure(F, slots_row, target)
+            is_close = typ == EV_CLOSE
+            Fc = closure(F, slots_row, rows)
             F_ok = complete(Fc, slot)
-            empty = is_ok & ~_pany(F_ok)
-            F2 = jnp.where(is_ok, F_ok, F)
+            empty = is_ok & ~_pbool((_union(F_ok) != 0).any())
+            F2 = tuple(jnp.where(is_ok, a, jnp.where(is_close, c, b))
+                       for a, c, b in zip(F_ok, Fc, F))
             return (F2, valid & ~empty,
                     jnp.minimum(bad, jnp.where(empty, idx, INT32_MAX))), None
 
         N = ev_type.shape[0]
         ax = lax.axis_index("frontier")
-        F0 = jnp.zeros((V, M_local), jnp.bool_)
-        F0 = F0.at[0, 0].set(ax == 0)    # global config (state 0, mask 0)
+        Fz = tuple(jnp.zeros((M_local,), jnp.uint32) for _ in range(NW))
+        # Global config (state 0, mask 0) lives on frontier device 0.
+        F0 = (Fz[0].at[0].set(jnp.where(ax == 0, jnp.uint32(1),
+                                        jnp.uint32(0))),) + Fz[1:]
         # The scan consumes data-sharded events, so its carry is varying
         # over "data" — widen the initial carry's type to match.
         extra = tuple(a for a in sync_axes if a != "frontier")
-        carry = (lax.pcast(F0, extra, to="varying"),
+        carry = (tuple(lax.pcast(f, extra, to="varying") for f in F0),
                  lax.pcast(jnp.bool_(True), extra, to="varying"),
                  lax.pcast(jnp.int32(INT32_MAX), extra, to="varying"))
         (F, valid, bad), _ = lax.scan(

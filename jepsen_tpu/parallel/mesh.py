@@ -36,14 +36,14 @@ def checker_mesh(n_data: Optional[int] = None, n_frontier: int = 1,
 def data_sharded_kernel(V: int, W: int, mesh: Mesh):
     """Compile the batched checker with the batch axis sharded over the
     mesh's "data" axis. Returns check(ev_type [B,N], ev_slot [B,N],
-    ev_slots [B,N,W], target [B,K+1,V]) -> (valid [B], bad [B]);
-    B must divide by the data-axis size."""
+    ev_slots [B,N,W], target [B,K+1,V]) -> (valid [B], bad [B],
+    frontier [B, words(V), 2^W]); B must divide by the data-axis size."""
     batch_spec = NamedSharding(mesh, P("data"))
     out_spec = NamedSharding(mesh, P("data"))
     kern = jax.vmap(make_kernel(V, W), in_axes=(0, 0, 0, 0))
     return jax.jit(kern,
                    in_shardings=(batch_spec,) * 4,
-                   out_shardings=(out_spec, out_spec))
+                   out_shardings=(out_spec, out_spec, out_spec))
 
 
 def summarize_verdicts(valid: jnp.ndarray) -> dict:

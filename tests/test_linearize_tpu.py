@@ -17,7 +17,7 @@ from jepsen_tpu.checkers.linearizable import prepare_history, wgl_check
 from jepsen_tpu.ops.statespace import (enumerate_statespace, history_kinds,
                                        StateSpaceExplosion)
 from jepsen_tpu.ops.encode import (encode_history, EncodeFailure,
-                                   batch_encode, EMPTY)
+                                   batch_encode, EMPTY, EV_OK, EV_CLOSE)
 from jepsen_tpu.ops.linearize import check_batch_tpu, check_one_tpu
 
 
@@ -66,8 +66,10 @@ def test_encode_slot_assignment():
                ok_op(2, "write", 3)])
     e = encode_history(cas_register(), prepare_history(h))
     assert not isinstance(e, EncodeFailure)
-    # one device event per ok completion, completing slots 0, 1, 0
-    assert list(e.ev_slot) == [0, 1, 0]
+    # one device event per ok completion (completing slots 0, 1, 0),
+    # plus the trailing close/flush event
+    assert list(e.ev_type) == [EV_OK, EV_OK, EV_OK, EV_CLOSE]
+    assert list(e.ev_slot[:-1]) == [0, 1, 0]
     assert e.max_live == 2
     k_w1 = e.space.kind_index[("write", 1)]
     k_w2 = e.space.kind_index[("write", 2)]
@@ -76,6 +78,8 @@ def test_encode_slot_assignment():
     assert list(e.ev_slots[0]) == [k_w1, k_w2]
     assert list(e.ev_slots[1]) == [k_w3, k_w2]
     assert list(e.ev_slots[2]) == [k_w3, EMPTY]
+    # the close event carries the (empty) end-of-history pending table
+    assert list(e.ev_slots[3]) == [EMPTY, EMPTY]
 
 
 def test_encode_info_pins_slot():
@@ -85,10 +89,12 @@ def test_encode_info_pins_slot():
                ok_op(1, "write", 2)])
     e = encode_history(cas_register(), prepare_history(h))
     # the timed-out write still occupies slot 0 at the ok snapshot
-    assert list(e.ev_slot) == [1]
+    assert list(e.ev_slot[:-1]) == [1]
     k_w1 = e.space.kind_index[("write", 1)]
     k_w2 = e.space.kind_index[("write", 2)]
     assert list(e.ev_slots[0]) == [k_w1, k_w2]
+    # ...and stays pinned in the final close-event table
+    assert list(e.ev_slots[1]) == [k_w1, EMPTY]
     assert e.max_live == 2
 
 
@@ -101,7 +107,7 @@ def test_encode_drops_identity_info_ops():
                ok_op(1, "write", 2)])
     e = encode_history(cas_register(), prepare_history(h))
     assert e.max_live == 1
-    assert list(e.ev_slot) == [0]
+    assert list(e.ev_slot[:-1]) == [0]
 
 
 def test_encode_window_overflow():
@@ -121,7 +127,50 @@ def check_parity(model, histories):
         if a["valid"] is False:
             assert a["op"]["index"] == b["op"]["index"], \
                 f"history {i}: bad-op host={a['op']} tpu={b['op']}"
+            # Counterexample parity: both engines walk the same exact
+            # config set and sample it with the same sort/truncate
+            # discipline, so the pre-failure samples must be identical.
+            assert a["configs"] == b["configs"], \
+                f"history {i}: configs host={a['configs']} tpu={b['configs']}"
     return host
+
+
+def test_valid_config_sample_parity():
+    # No pending ops remain at the end, so the host's final closure is
+    # the identity and both engines report the same final config set.
+    h = index([invoke_op(0, "write", 1),
+               invoke_op(1, "write", 2),
+               ok_op(0, "write", 1),
+               ok_op(1, "write", 2)])
+    a = wgl_check(cas_register(), h)
+    b = check_one_tpu(cas_register(), h)
+    assert a["valid"] is True and b["valid"] is True
+    assert a["configs"] == b["configs"]
+
+
+def test_valid_config_parity_with_trailing_pending():
+    # An op invoked after the last completion stays pending; the close
+    # event must flush the device frontier so both engines report the
+    # same closed config set.
+    h = index([invoke_op(0, "write", 1),
+               ok_op(0, "write", 1),
+               invoke_op(1, "write", 2)])
+    a = wgl_check(cas_register(), h)
+    b = check_one_tpu(cas_register(), h)
+    assert a["valid"] is True and b["valid"] is True
+    assert a["configs"] == b["configs"] and len(a["configs"]) == 2
+
+
+def test_invalid_config_sample_parity():
+    h = index([invoke_op(0, "write", 1),
+               invoke_op(1, "write", 2),
+               ok_op(0, "write", 1),
+               ok_op(1, "write", 2),
+               invoke_op(2, "read", None), ok_op(2, "read", 7)])
+    a = wgl_check(cas_register(), h)
+    b = check_one_tpu(cas_register(), h)
+    assert a["valid"] is False and b["valid"] is False
+    assert a["configs"] == b["configs"] and len(a["configs"]) > 0
 
 
 def test_sequential_valid():

@@ -32,7 +32,7 @@ def test_data_sharded_matches_host(batch16):
     enc, host = batch16
     mesh = checker_mesh(n_data=8, n_frontier=1)
     kern = data_sharded_kernel(enc.V, enc.W, mesh)
-    valid, bad = kern(enc.ev_type, enc.ev_slot, enc.ev_slots, enc.target)
+    valid, bad, _ = kern(enc.ev_type, enc.ev_slot, enc.ev_slots, enc.target)
     assert np.array_equal(np.asarray(valid), host)
     s = summarize_verdicts(valid)
     assert s["invalid"] == int((~host).sum())
