@@ -1,0 +1,99 @@
+"""Columnar histories — the TPU-native batch data model.
+
+The Op-list history (jepsen_tpu.history.core) is the general interface
+between execution and analysis, but at checker-benchmark scale (10k
+histories × 1k lines — BASELINE.md north star) per-op Python objects
+dominate the wall clock. A ``ColumnarOps`` holds a *batch* of histories
+as padded 2-D arrays, one row per history, and the whole host pipeline
+(synthesis → encode → device tensors) runs as vectorized numpy over the
+batch axis. The reference has no analog — its JVM harness materializes
+every op as a map (jepsen/src/jepsen/core.clj:153-172); the columnar
+form is what makes "histories as tensors" hold end-to-end.
+
+Contract: a ColumnarOps is already *prepared* in the sense of
+checkers.linearizable.prepare_history —
+
+  * failed ops never happened: both their lines are PAD;
+  * observed values are propagated: each invocation line carries the
+    final op-kind index (e.g. ("read", observed-value)) in ``kind``;
+  * never-ok total-identity ops (timed-out unconstrained reads) are
+    dropped: PAD (the rule shared by every engine —
+    jepsen_tpu.ops.encode.dropped_invocations).
+
+Producers: workloads.synth.synth_cas_columnar (vectorized batch synth);
+``ops_to_columnar``/``columnar_to_ops`` convert to/from Op lists (Python
+walks — for tests and for routing individual rows to host engines).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .ops import Op, invoke_op, ok_op, info_op
+
+# Line type codes.
+PAD = -1
+C_INVOKE = 0
+C_OK = 1
+C_INFO = 2
+
+
+@dataclass
+class ColumnarOps:
+    """A prepared batch of histories as padded columnar arrays.
+
+    type    — int8  [B, N]: C_INVOKE / C_OK / C_INFO / PAD
+    process — int16 [B, N]: logical process per line (< n_procs)
+    kind    — int32 [B, N]: op-kind index into ``kinds`` (invoke lines;
+              -1 elsewhere)
+    kinds   — the shared op-kind vocabulary, index-aligned with the
+              transition table callers build via
+              ops.statespace.enumerate_statespace(model, kinds, ...)
+    """
+
+    type: np.ndarray
+    process: np.ndarray
+    kind: np.ndarray
+    kinds: List[Tuple]
+
+    @property
+    def batch(self) -> int:
+        return int(self.type.shape[0])
+
+    @property
+    def n_lines(self) -> int:
+        return int(self.type.shape[1])
+
+
+def _kind_value(kind: Tuple):
+    f, cv = kind
+    return list(cv) if isinstance(cv, tuple) else cv
+
+
+def columnar_to_ops(cols: ColumnarOps, row: int) -> List[Op]:
+    """One row as an indexed Op-list history (host-engine routing and
+    oracle tests). Invoke values are un-propagated where the semantics
+    require (a read invokes with value None, observes on completion)."""
+    out: List[Op] = []
+    pending = {}
+    for j in range(cols.n_lines):
+        t = int(cols.type[row, j])
+        if t == PAD:
+            continue
+        p = int(cols.process[row, j])
+        if t == C_INVOKE:
+            kind = cols.kinds[int(cols.kind[row, j])]
+            f, v = kind[0], _kind_value(kind)
+            pending[p] = (f, v)
+            op = invoke_op(p, f, None if f == "read" else v)
+        elif t == C_OK:
+            f, v = pending.pop(p)
+            op = ok_op(p, f, v)
+        else:
+            f, v = pending.pop(p)
+            op = info_op(p, f, None if f == "read" else v, error="timeout")
+        op.index = j
+        out.append(op)
+    return out

@@ -108,14 +108,24 @@ class ConfigSet {
 
 struct SlotState {
   std::vector<int32_t> slot_kind;  // kind occupying each slot, -1 free
-  std::vector<int32_t> free_slots; // LIFO, low indices on top
+  uint64_t free_mask;              // bit s set = slot s free
   std::vector<int32_t> slot_of_proc;
   int live = 0, max_live = 0;
 
   SlotState(int max_slots, int max_proc)
       : slot_kind(max_slots, -1), slot_of_proc(max_proc, -1) {
-    for (int i = max_slots - 1; i >= 0; --i) free_slots.push_back(i);
+    free_mask = max_slots >= 64 ? ~0ULL : ((1ULL << max_slots) - 1);
   }
+  // Lowest-free-first allocation: the shared discipline across the
+  // Python, columnar, and native encoders (keeps slot indices
+  // < peak-live and clusters hot slots at low mask bits).
+  bool exhausted() const { return free_mask == 0; }
+  int alloc() {
+    int s = __builtin_ctzll(free_mask);
+    free_mask &= free_mask - 1;
+    return s;
+  }
+  void release(int s) { free_mask |= 1ULL << s; }
 };
 
 }  // namespace
@@ -149,9 +159,8 @@ int32_t jt_wgl_check(const int32_t* ev_type, const int32_t* ev_proc,
     int32_t t = ev_type[i];
     if (t == EV_INVOKE) {
       if (ev_noslot && ev_noslot[i]) continue;
-      if (slots.free_slots.empty()) { out[0] = UNKNOWN; return UNKNOWN; }
-      int s = slots.free_slots.back();
-      slots.free_slots.pop_back();
+      if (slots.exhausted()) { out[0] = UNKNOWN; return UNKNOWN; }
+      int s = slots.alloc();
       slots.slot_kind[s] = ev_kind[i];
       slots.slot_of_proc[ev_proc[i]] = s;
       if (++slots.live > slots.max_live) slots.max_live = slots.live;
@@ -207,7 +216,7 @@ int32_t jt_wgl_check(const int32_t* ev_type, const int32_t* ev_proc,
       // Free the slot.
       slots.slot_kind[s] = -1;
       slots.slot_of_proc[ev_proc[i]] = -1;
-      slots.free_slots.push_back(s);
+      slots.release(s);
       --slots.live;
     }
   }
@@ -261,9 +270,8 @@ int32_t jt_encode(const int32_t* ev_type, const int32_t* ev_proc,
     int32_t t = ev_type[i];
     if (t == EV_INVOKE) {
       if (ev_noslot && ev_noslot[i]) continue;
-      if (slots.free_slots.empty()) return -1;
-      int s = slots.free_slots.back();
-      slots.free_slots.pop_back();
+      if (slots.exhausted()) return -1;
+      int s = slots.alloc();
       slots.slot_kind[s] = ev_kind[i];
       slots.slot_of_proc[ev_proc[i]] = s;
       if (++slots.live > slots.max_live) slots.max_live = slots.live;
@@ -279,7 +287,7 @@ int32_t jt_encode(const int32_t* ev_type, const int32_t* ev_proc,
       ++n_ok;
       slots.slot_kind[s] = -1;
       slots.slot_of_proc[ev_proc[i]] = -1;
-      slots.free_slots.push_back(s);
+      slots.release(s);
       --slots.live;
     }
   }

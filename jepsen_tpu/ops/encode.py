@@ -141,7 +141,7 @@ def encode_history(model: Model, prepared: List[Op], *,
     ev_opidx: List[int] = []
 
     table = [EMPTY] * max_slots
-    free = list(range(max_slots - 1, -1, -1))  # stack; low slots first
+    free = (1 << max_slots) - 1   # bitmask; lowest-free-first allocation
     slot_of: Dict[object, int] = {}
     live = 0
     max_live = 0
@@ -153,7 +153,8 @@ def encode_history(model: Model, prepared: List[Op], *,
             if not free:
                 return EncodeFailure(
                     f"more than {max_slots} concurrently-pending ops")
-            slot = free.pop()
+            slot = (free & -free).bit_length() - 1
+            free &= free - 1
             slot_of[o.process] = slot
             table[slot] = space.kind_index[op_kind(o)]
             live += 1
@@ -167,7 +168,7 @@ def encode_history(model: Model, prepared: List[Op], *,
             ev_slots.append(table.copy())   # snapshot WITH the op pending
             ev_opidx.append(o.index if o.index is not None else pos)
             table[slot] = EMPTY
-            free.append(slot)
+            free |= 1 << slot
             live -= 1
         elif o.type == INFO:
             # Indeterminate: stays pending to the end; slot stays pinned.
@@ -204,20 +205,21 @@ def slot_ops_at_event(space: StateSpace, prepared: List[Op],
     decode frontier masks into config samples for result reporting.
 
     ``max_slots`` defaults to 32, the frontier mask width — allocation
-    pops the lowest free slot, so a larger pool assigns the same slots
+    picks the lowest free slot, so a larger pool assigns the same slots
     as any smaller pool the history actually fit in.
     """
     dropped = dropped_invocations(space, prepared)
 
     table_op: Dict[int, int] = {}
-    free = list(range(max_slots - 1, -1, -1))
+    free = (1 << max_slots) - 1
     slot_of: Dict[object, int] = {}
     e = 0
     for pos, o in enumerate(prepared):
         if o.type == INVOKE:
             if pos in dropped or not free:
                 continue
-            slot = free.pop()
+            slot = (free & -free).bit_length() - 1
+            free &= free - 1
             slot_of[o.process] = slot
             table_op[slot] = o.index if o.index is not None else pos
         elif o.type == OK:
@@ -227,7 +229,7 @@ def slot_ops_at_event(space: StateSpace, prepared: List[Op],
             if event_index is not None and e == event_index:
                 return dict(table_op)
             del table_op[slot]
-            free.append(slot)
+            free |= 1 << slot
             e += 1
         elif o.type == INFO:
             slot_of.pop(o.process, None)
@@ -344,6 +346,112 @@ def batch_encode(model: Model, prepared_histories: Sequence[List[Op]], *,
                                 max_states=max_states, max_slots=max_slots)
     return stack_encoded(encs, failures, min_v=min_v, min_w=min_w,
                          pad_batch_to=pad_batch_to)
+
+
+def encode_columnar(space: StateSpace, cols, *,
+                    max_slots: int = 16, min_v: int = 8,
+                    min_w: int = 4) -> Tuple[List[EncodedBatch],
+                                             List[Tuple[int, str]]]:
+    """Vectorized twin of ``bucket_encode`` for a ColumnarOps batch: the
+    slot walk runs once over the line axis with every history advancing
+    in lockstep (numpy row vectors), then rows bucket by exact pending
+    window W. Returns (buckets, failures) where failures are
+    (row, reason) pairs for histories overflowing ``max_slots`` —
+    callers route those to a host engine via columnar_to_ops.
+
+    ``space`` must be enumerated over ``cols.kinds`` (index-aligned).
+    The columnar contract (jepsen_tpu.history.columnar) has already
+    applied failure-removal, value propagation, and the identity-drop
+    rule, so every line here maps 1:1 onto the walk.
+    """
+    from ..history.columnar import C_INVOKE, C_OK
+    B, N = cols.type.shape
+    S = max_slots
+    assert S <= 32
+    K = space.n_kinds
+    P = int(cols.process.max(initial=0)) + 1
+
+    table = np.full((B, S), K, np.int32)        # K = empty sentinel
+    free = np.full(B, (1 << S) - 1, np.uint32)
+    slot_of = np.full((B, P), -1, np.int8)
+    live = np.zeros(B, np.int32)
+    max_live = np.zeros(B, np.int32)
+    cnt = np.zeros(B, np.int32)
+    overflow = np.zeros(B, bool)
+
+    # ok events + close, rounded up so the per-bucket event axis (also
+    # rounded to 8) can never exceed the buffer width
+    E = _round_up(N // 2 + 1, 8)
+    ev_slot = np.zeros((B, E), np.int32)
+    ev_slots = np.full((B, E, S), K, np.int32)
+    ev_opidx = np.full((B, E), -1, np.int32)
+
+    rows = np.arange(B)
+    for j in range(N):
+        t = cols.type[:, j]
+        sel = (t == C_INVOKE) & ~overflow
+        if sel.any():
+            i = rows[sel]
+            fm = free[i]
+            of = fm == 0
+            overflow[i[of]] = True
+            i, fm = i[~of], fm[~of]
+            bit = fm & (~fm + np.uint32(1))      # lowest free slot
+            slot = np.log2(bit).astype(np.int8)
+            free[i] = fm & ~bit
+            p = cols.process[i, j]
+            slot_of[i, p] = slot
+            table[i, slot] = cols.kind[i, j]
+            live[i] += 1
+            max_live[i] = np.maximum(max_live[i], live[i])
+        sel = (t == C_OK) & ~overflow
+        if sel.any():
+            i = rows[sel]
+            p = cols.process[i, j]
+            slot = slot_of[i, p]
+            ok = slot >= 0
+            i, p, slot = i[ok], p[ok], slot[ok]
+            c = cnt[i]
+            ev_slot[i, c] = slot
+            ev_slots[i, c, :] = table[i, :]
+            ev_opidx[i, c] = j
+            table[i, slot] = K
+            free[i] |= np.uint32(1) << slot.astype(np.uint32)
+            slot_of[i, p] = -1
+            cnt[i] += 1
+            live[i] -= 1
+        # C_INFO lines change nothing the walk tracks: the pending slot
+        # stays pinned (allocated at invoke) and the process is free to
+        # invoke again, which overwrites slot_of.
+
+    # Trailing close/flush event per row.
+    ev_slots[rows, cnt, :] = table
+    n_events = cnt + 1
+
+    failures = [(int(r), f"more than {max_slots} concurrently-pending ops")
+                for r in rows[overflow]]
+    keep = ~overflow
+    V = _round_up(max(space.n_states, min_v), 8)
+    W_row = np.maximum(max_live, min_w)
+
+    out: List[EncodedBatch] = []
+    for W in sorted(set(W_row[keep].tolist())):
+        r = rows[keep & (W_row == W)]
+        Nev = _round_up(int(n_events[r].max()), 8)
+        ar = np.arange(Nev)
+        etype = np.full((len(r), Nev), EV_PAD, np.int32)
+        etype[ar[None, :] < cnt[r, None]] = EV_OK
+        etype[np.arange(len(r)), cnt[r]] = EV_CLOSE
+        tgt = np.broadcast_to(space.padded_target(V, K),
+                              (len(r), K + 1, V)).copy()
+        out.append(EncodedBatch(
+            ev_type=etype, ev_slot=ev_slot[r, :Nev],
+            ev_slots=ev_slots[r, :Nev, :W], ev_opidx=ev_opidx[r, :Nev],
+            target=tgt, V=V, W=int(W), indices=r.tolist(),
+            failures=[], spaces=[space] * len(r)))
+    if out:
+        out[0].failures = failures
+    return out, failures
 
 
 def bucket_encode(model: Model, prepared_histories: Sequence[List[Op]], *,

@@ -353,3 +353,36 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
 def check_one_tpu(model: Model, history: List[Op], **kw) -> dict:
     """Single-history device check (the Checker-protocol TPU backend)."""
     return check_batch_tpu(model, [history], **kw)[0]
+
+
+def check_columnar(model: Model, cols, *, max_slots: int = 16,
+                   host_fallback=None):
+    """Device-check a ColumnarOps batch end-to-end at tensor speed.
+
+    Returns (valid [B] bool, bad [B] int32) — ``bad`` is the line index
+    of the first impossible completion (INT32_MAX when valid). Rows the
+    encoder cannot bound are converted to Op lists and routed to
+    ``host_fallback`` (default: the exact host engine).
+    """
+    from ..checkers.linearizable import wgl_check
+    from ..history.columnar import columnar_to_ops
+    from .encode import encode_columnar
+    from .statespace import enumerate_statespace
+
+    space = enumerate_statespace(model, cols.kinds, MAX_PACKED_STATES)
+    buckets, failures = encode_columnar(space, cols, max_slots=max_slots)
+    valid = np.ones(cols.batch, bool)
+    bad = np.full(cols.batch, INT32_MAX, np.int32)
+    for batch in buckets:
+        v, b, _ = run_encoded_batch(batch)
+        idx = np.asarray(batch.indices)
+        valid[idx] = v
+        rows = idx[~v]
+        bad[rows] = batch.ev_opidx[np.nonzero(~v)[0], b[~v]]
+    host_fallback = host_fallback or wgl_check
+    for row, _ in failures:
+        r = host_fallback(model, columnar_to_ops(cols, row))
+        valid[row] = r["valid"] is True
+        if r["valid"] is False:
+            bad[row] = r["op"].get("index", -1)
+    return valid, bad

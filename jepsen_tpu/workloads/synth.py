@@ -14,6 +14,8 @@ from __future__ import annotations
 import random
 from typing import List, Optional
 
+import numpy as np
+
 from ..history.core import index
 from ..history.ops import Op, invoke_op, ok_op, fail_op, info_op
 
@@ -91,3 +93,138 @@ def synth_cas_history(seed: int, *, n_procs: int = 5, n_ops: int = 40,
 def synth_cas_batch(n: int, seed0: int = 0, **kw) -> List[List[Op]]:
     """n seeded histories: seeds seed0..seed0+n-1."""
     return [synth_cas_history(seed0 + i, **kw) for i in range(n)]
+
+
+def cas_kind_vocabulary(n_values: int):
+    """The shared op-kind vocabulary for a CAS-register value domain:
+    read(None), read(v), write(v), cas(a, b) — index-aligned with the
+    columnar ``kind`` arrays below."""
+    kinds = [("read", None)]
+    kinds += [("read", v) for v in range(n_values)]
+    kinds += [("write", v) for v in range(n_values)]
+    kinds += [("cas", (a, b)) for a in range(n_values)
+              for b in range(n_values)]
+    return kinds
+
+
+def synth_cas_columnar(n: int, seed: int = 0, *, n_procs: int = 5,
+                       n_ops: int = 40, n_values: int = 5,
+                       corrupt: float = 0.0, p_info: float = 0.0):
+    """Vectorized batch twin of ``synth_cas_history``: simulate ``n``
+    register histories in lockstep with one numpy step loop (every
+    iteration advances every unfinished history by one line). Returns a
+    prepared ColumnarOps (history.columnar contract: failed ops and
+    never-ok identity reads are PAD; invoke lines carry final op kinds).
+
+    One (n, seed, params) tuple ↦ one deterministic batch — the
+    north-star batch mode's workload generator at tensor speed.
+    """
+    from ..history.columnar import (ColumnarOps, C_INVOKE, C_OK, C_INFO,
+                                    PAD)
+    rng = np.random.default_rng(seed)
+    B, P, N = n, n_procs, 2 * n_ops
+    READ0 = 0                     # kind ids: read(None)=0, read(v)=1+v
+    WRITE0 = 1 + n_values         # write(v)
+    CAS0 = 1 + 2 * n_values      # cas(a,b) = CAS0 + a*n_values + b
+
+    typ = np.full((B, N), PAD, np.int8)
+    proc = np.zeros((B, N), np.int16)
+    kind = np.full((B, N), -1, np.int32)
+
+    reg = np.full(B, -1, np.int32)          # -1 = None (never written)
+    busy_f = np.full((B, P), -1, np.int8)   # 0=read 1=write 2=cas
+    busy_a = np.zeros((B, P), np.int32)
+    busy_b = np.zeros((B, P), np.int32)
+    inv_pos = np.zeros((B, P), np.int32)
+    started = np.zeros(B, np.int32)
+    n_live = np.zeros(B, np.int32)
+    pos = np.zeros(B, np.int32)
+    rows = np.arange(B)
+
+    for _ in range(N):
+        active = (started < n_ops) | (n_live > 0)
+        if not active.any():
+            break
+        can_start = active & (n_live < P) & (started < n_ops)
+        do_start = can_start & ((n_live == 0) | (rng.random(B) < 0.6))
+        do_complete = active & ~do_start & (n_live > 0)
+
+        i = rows[do_start]
+        if len(i):
+            # random free process: max random score over free slots
+            score = rng.random((len(i), P))
+            score[busy_f[i] != -1] = -1.0
+            p = score.argmax(1).astype(np.int16)
+            f = rng.integers(0, 3, len(i)).astype(np.int8)
+            a = rng.integers(0, n_values, len(i)).astype(np.int32)
+            b = rng.integers(0, n_values, len(i)).astype(np.int32)
+            typ[i, pos[i]] = C_INVOKE
+            proc[i, pos[i]] = p
+            busy_f[i, p] = f
+            busy_a[i, p] = a
+            busy_b[i, p] = b
+            inv_pos[i, p] = pos[i]
+            started[i] += 1
+            n_live[i] += 1
+            pos[i] += 1
+
+        i = rows[do_complete]
+        if len(i):
+            score = rng.random((len(i), P))
+            score[busy_f[i] == -1] = -1.0
+            p = score.argmax(1).astype(np.int16)
+            f = busy_f[i, p]
+            a, b = busy_a[i, p], busy_b[i, p]
+            is_info = rng.random(len(i)) < p_info
+            applies = rng.random(len(i)) < 0.5     # info ops: took effect?
+            ip = inv_pos[i, p]
+            j = pos[i]
+            typ[i, j] = C_OK
+            proc[i, j] = p
+
+            rd, wr, cs = f == 0, f == 1, f == 2
+            # read: observes reg; info-read observed nothing -> identity
+            # -> drop both lines (the shared never-ok identity rule)
+            obs = reg[i]
+            kind[i, ip] = np.where(obs < 0, READ0, READ0 + 1 + obs)
+            drop = rd & is_info
+            typ[i[drop], j[drop]] = PAD
+            typ[i[drop], ip[drop]] = PAD
+            kind[i[drop], ip[drop]] = -1
+            # write: reg = v on ok; on info, half apply
+            kind[i[wr], ip[wr]] = WRITE0 + a[wr]
+            w_apply = wr & (~is_info | applies)
+            reg[i[w_apply]] = a[w_apply]
+            # cas: ok iff reg == a (else FAIL: both lines PAD);
+            # info: half apply when it would have matched
+            kind[i[cs], ip[cs]] = CAS0 + a[cs] * n_values + b[cs]
+            match = reg[i] == a
+            c_apply = cs & match & (~is_info | applies)
+            reg[i[c_apply]] = b[c_apply]
+            fail = cs & ~match & ~is_info
+            typ[i[fail], j[fail]] = PAD
+            typ[i[fail], ip[fail]] = PAD
+            kind[i[fail], ip[fail]] = -1
+            info = is_info & ~rd
+            typ[i[info], j[info]] = C_INFO
+
+            busy_f[i, p] = -1
+            n_live[i] -= 1
+            pos[i] += 1
+
+    if corrupt > 0:
+        # perturb one observed read per selected row -> likely invalid
+        hit = rng.random(B) < corrupt
+        is_read_inv = (typ == C_INVOKE) & (kind >= READ0) & \
+                      (kind < READ0 + 1 + n_values)
+        score = rng.random((B, N))
+        score[~is_read_inv] = -1.0
+        col = score.argmax(1)
+        hit &= score[rows, col] > 0          # row actually has a read
+        i, c = rows[hit], col[hit]
+        old = kind[i, c] - (READ0 + 1)       # -1 when read(None)
+        delta = rng.integers(1, n_values, len(i))
+        kind[i, c] = READ0 + 1 + (old + delta) % n_values
+
+    return ColumnarOps(type=typ, process=proc, kind=kind,
+                       kinds=cas_kind_vocabulary(n_values))

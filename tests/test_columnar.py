@@ -1,0 +1,119 @@
+"""Columnar batch pipeline: vectorized synth + encode vs the Op-list
+path and the host oracle.
+
+The columnar path must be a pure speedup: identical slot walks,
+identical verdicts. The Op-list converter (columnar_to_ops) bridges the
+two worlds for the comparison.
+"""
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.linearizable import prepare_history, wgl_check
+from jepsen_tpu.history.columnar import (C_INVOKE, C_OK, C_INFO, PAD,
+                                         columnar_to_ops)
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.ops.encode import bucket_encode, encode_columnar
+from jepsen_tpu.ops.linearize import check_columnar, INT32_MAX
+from jepsen_tpu.ops.statespace import enumerate_statespace
+from jepsen_tpu.workloads.synth import synth_cas_columnar
+
+
+@pytest.fixture(scope="module")
+def cols():
+    return synth_cas_columnar(48, seed=21, n_procs=4, n_ops=25, n_values=3,
+                              corrupt=0.3, p_info=0.12)
+
+
+def test_columnar_shape_and_contract(cols):
+    assert cols.batch == 48
+    t = cols.type
+    # every invoke line carries a kind; pads and completions don't
+    assert (cols.kind[t == C_INVOKE] >= 0).all()
+    assert (cols.kind[t != C_INVOKE] == -1).all()
+    # invokes and completions balance per row (failed pairs padded out)
+    n_inv = (t == C_INVOKE).sum(1)
+    n_done = ((t == C_OK) | (t == C_INFO)).sum(1)
+    assert (n_done <= n_inv).all()
+
+
+def test_columnar_to_ops_roundtrip_verdicts(cols):
+    """Host-oracle verdicts over converted rows exercise both outcomes."""
+    model = cas_register()
+    verdicts = {wgl_check(model, columnar_to_ops(cols, r))["valid"]
+                for r in range(cols.batch)}
+    assert verdicts == {True, False}
+
+
+def test_columnar_encode_matches_oplist_encoder(cols):
+    """The vectorized walk must produce the same slots, snapshots, and
+    windows as the per-history Python encoder on converted rows."""
+    model = cas_register()
+    space = enumerate_statespace(model, cols.kinds, 64)
+    buckets, failures = encode_columnar(space, cols)
+    assert not failures
+
+    prepared = [prepare_history(columnar_to_ops(cols, r))
+                for r in range(cols.batch)]
+    ref = bucket_encode(model, prepared)
+    ref_by_row = {}
+    for b in ref:
+        for row, i in enumerate(b.indices):
+            ref_by_row[i] = (b, row)
+
+    for b in buckets:
+        for row, i in enumerate(b.indices):
+            rb, rr = ref_by_row[i]
+            assert b.W == rb.W, f"row {i}: W {b.W} != {rb.W}"
+            n = int((rb.ev_type[rr] != 0).sum())
+            assert (b.ev_type[row, :n] == rb.ev_type[rr, :n]).all()
+            assert (b.ev_slot[row, :n] == rb.ev_slot[rr, :n]).all()
+            # snapshots: kind indices agree (shared vocabulary is a
+            # superset; empty sentinel differs, so compare via kinds)
+            own = b.ev_slots[row, :n]
+            refs = rb.ev_slots[rr, :n]
+            # empty-slot sentinel in a stacked batch is the bucket's
+            # padded kind count (the target table's final row)
+            own_k = np.where(own == b.target.shape[1] - 1, -1, own)
+            ref_space = rb.spaces[rr]
+            refk = np.where(refs == rb.target.shape[1] - 1, -1, refs)
+            for e in range(n):
+                for s in range(b.W):
+                    a, c = int(own_k[e, s]), int(refk[e, s])
+                    if c == -1 or a == -1:
+                        assert a == c, (i, e, s)
+                    else:
+                        assert space.kinds[a] == ref_space.kinds[c], (i, e, s)
+
+
+def test_check_columnar_matches_host(cols):
+    model = cas_register()
+    valid, bad = check_columnar(model, cols)
+    host = np.array([wgl_check(model, columnar_to_ops(cols, r))["valid"]
+                     is True for r in range(cols.batch)])
+    assert np.array_equal(valid, host)
+    # invalid rows point at a real completion line
+    for r in np.nonzero(~valid)[0]:
+        j = int(bad[r])
+        assert 0 <= j < cols.n_lines
+        assert cols.type[r, j] == C_OK
+
+
+def test_columnar_overflow_routes_to_host():
+    # 10 concurrent processes with a 4-slot window: some rows overflow
+    # and must route to the host engine (which has no window bound)
+    cols = synth_cas_columnar(8, seed=3, n_procs=10, n_ops=30, n_values=3,
+                              p_info=0.05)
+    model = cas_register()
+    valid, _ = check_columnar(model, cols, max_slots=4)
+    host = np.array([wgl_check(model, columnar_to_ops(cols, r))["valid"]
+                     is True for r in range(cols.batch)])
+    assert np.array_equal(valid, host)
+
+
+def test_columnar_full_completion_rounding():
+    # Rows that complete every op have n_events = n_ops + 1; the event
+    # axis rounds to 8 and must never exceed the walk's buffers
+    # (regression: slice truncation crashed lax.scan).
+    cols = synth_cas_columnar(32, seed=2, n_procs=3, n_ops=20, n_values=3)
+    valid, _ = check_columnar(cas_register(), cols)
+    assert valid.all()
