@@ -174,6 +174,47 @@ class SSHTransport(Transport):
                        capture_output=True, text=True)
 
 
+class LocalTransport(Transport):
+    """Run commands as local subprocesses — the real-process twin of
+    dummy mode. The "node" is a logical name; suites parameterize ports
+    and directories per node. Every control-plane helper
+    (install_archive, start_daemon, grepkill, the clock-tool compile
+    path) executes against genuine local processes, which is the CI seam
+    for suite integration tests in environments without SSH-able
+    cluster nodes (enable with ssh: {"local": True})."""
+
+    def __init__(self, host, cfg: dict):
+        self.host = host
+        self.cfg = cfg
+
+    def run(self, cmd: str, stdin: Optional[str]) -> Tuple[str, str, int]:
+        timeout = self.cfg.get("timeout", 600)
+        try:
+            p = subprocess.run(["bash", "-c", cmd], input=stdin,
+                               capture_output=True, text=True,
+                               timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            out = e.stdout.decode(errors="replace") if e.stdout else ""
+            err = e.stderr.decode(errors="replace") if e.stderr else ""
+            return out, err + f"\ncommand timed out after {timeout}s", 124
+        return p.stdout, p.stderr, p.returncode
+
+    def upload(self, local: str, remote: str) -> None:
+        p = subprocess.run(["cp", "-r", local, remote],
+                           capture_output=True, text=True)
+        if p.returncode != 0:
+            raise RemoteError(f"cp {local}", self.host, p.returncode,
+                              p.stdout, p.stderr)
+
+    def download(self, remote: str, local: str) -> None:
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        p = subprocess.run(["cp", "-r", remote, local],
+                           capture_output=True, text=True)
+        if p.returncode != 0:
+            raise RemoteError(f"cp {remote}", self.host, p.returncode,
+                              p.stdout, p.stderr)
+
+
 class DummyTransport(Transport):
     """No SSH at all: records commands, acknowledges everything
     (control.clj:15,274-277). ``responder`` may map a command to fake
@@ -225,6 +266,8 @@ def session(host, ssh_cfg: Optional[dict] = None,
     cfg = {**DEFAULT_SSH, **(ssh_cfg or {})}
     if cfg.get("dummy"):
         t: Transport = DummyTransport(host, responder)
+    elif cfg.get("local"):
+        t = LocalTransport(host, cfg)
     else:
         t = SSHTransport(host, cfg)
     return Session(host=host, transport=t,

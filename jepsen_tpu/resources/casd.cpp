@@ -1,0 +1,244 @@
+// casd — a minimal CAS key-value server speaking the etcd v2 keys API
+// subset the etcd suite's client uses (GET/PUT/DELETE on /v2/keys/<key>,
+// conditional PUT via prevValue). It is the in-CI stand-in for a real
+// etcd node: a genuine compiled binary that the framework installs via
+// its own tarball deploy, starts with start-stop-daemon + pidfile,
+// pauses with SIGSTOP, and kills — so the control plane, daemon
+// helpers, and nemesis paths are exercised against real processes in
+// environments with no cluster and no network egress.
+//
+// Semantics knob for fault-detection tests: state is in-memory by
+// default, so kill+restart wipes the register and the linearizability
+// checker must flag post-restart reads (a real consistency violation a
+// real single-node etcd would not exhibit with its WAL). With
+// --persist FILE, writes go through an fsync'd log replayed on boot,
+// and restarts are harmless — valid histories stay valid.
+//
+// Usage: casd --port P [--persist FILE] [--delay-ms N]
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+
+namespace {
+
+std::mutex g_mu;
+std::map<std::string, std::string> g_store;
+long g_index = 0;
+std::string g_persist_path;
+int g_delay_ms = 0;
+
+void persist(const std::string& key, const std::string& value, bool del) {
+  if (g_persist_path.empty()) return;
+  std::ofstream f(g_persist_path, std::ios::app);
+  f << (del ? "D" : "S") << " " << key << " " << value << "\n";
+  f.flush();
+}
+
+void replay() {
+  if (g_persist_path.empty()) return;
+  std::ifstream f(g_persist_path);
+  std::string op, key, value;
+  while (f >> op >> key) {
+    if (op == "S") {
+      std::getline(f, value);
+      if (!value.empty() && value[0] == ' ') value.erase(0, 1);
+      g_store[key] = value;
+    } else {
+      std::getline(f, value);
+      g_store.erase(key);
+    }
+    ++g_index;
+  }
+}
+
+// --------------------------------------------------------- tiny HTTP
+
+struct Request {
+  std::string method, path, body;
+  std::map<std::string, std::string> form;  // urlencoded body/query
+};
+
+std::string url_decode(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '%' && i + 2 < s.size()) {
+      out += static_cast<char>(std::stoi(s.substr(i + 1, 2), nullptr, 16));
+      i += 2;
+    } else if (s[i] == '+') {
+      out += ' ';
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+void parse_form(const std::string& s, std::map<std::string, std::string>* out) {
+  std::istringstream is(s);
+  std::string pair;
+  while (std::getline(is, pair, '&')) {
+    auto eq = pair.find('=');
+    if (eq != std::string::npos)
+      (*out)[url_decode(pair.substr(0, eq))] = url_decode(pair.substr(eq + 1));
+  }
+}
+
+bool read_request(int fd, Request* req) {
+  std::string buf;
+  char chunk[4096];
+  size_t header_end = std::string::npos;
+  while (header_end == std::string::npos) {
+    ssize_t n = read(fd, chunk, sizeof(chunk));
+    if (n <= 0) return false;
+    buf.append(chunk, n);
+    header_end = buf.find("\r\n\r\n");
+    if (buf.size() > 1 << 20) return false;
+  }
+  std::istringstream head(buf.substr(0, header_end));
+  std::string version;
+  head >> req->method >> req->path >> version;
+  size_t content_length = 0;
+  std::string line;
+  std::getline(head, line);
+  while (std::getline(head, line)) {
+    if (strncasecmp(line.c_str(), "content-length:", 15) == 0)
+      content_length = std::stoul(line.substr(15));
+  }
+  req->body = buf.substr(header_end + 4);
+  while (req->body.size() < content_length) {
+    ssize_t n = read(fd, chunk, sizeof(chunk));
+    if (n <= 0) return false;
+    req->body.append(chunk, n);
+  }
+  auto q = req->path.find('?');
+  if (q != std::string::npos) {
+    parse_form(req->path.substr(q + 1), &req->form);
+    req->path.resize(q);
+  }
+  parse_form(req->body, &req->form);
+  return true;
+}
+
+void respond(int fd, int status, const std::string& json) {
+  const char* reason = status == 200 ? "OK"
+                       : status == 201 ? "Created"
+                       : status == 404 ? "Not Found"
+                       : status == 412 ? "Precondition Failed"
+                                       : "Bad Request";
+  std::ostringstream os;
+  os << "HTTP/1.1 " << status << " " << reason << "\r\n"
+     << "Content-Type: application/json\r\n"
+     << "Content-Length: " << json.size() << "\r\n"
+     << "Connection: close\r\n\r\n"
+     << json;
+  std::string s = os.str();
+  size_t off = 0;
+  while (off < s.size()) {
+    ssize_t n = write(fd, s.data() + off, s.size() - off);
+    if (n <= 0) break;
+    off += n;
+  }
+}
+
+std::string node_json(const std::string& key, const std::string& value,
+                      long index) {
+  std::ostringstream os;
+  os << "{\"key\":\"/" << key << "\",\"value\":\"" << value
+     << "\",\"modifiedIndex\":" << index << "}";
+  return os.str();
+}
+
+void handle(int fd) {
+  Request req;
+  if (read_request(fd, &req)) {
+    if (g_delay_ms > 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(g_delay_ms));
+    const std::string prefix = "/v2/keys/";
+    if (req.path == "/health") {
+      respond(fd, 200, "{\"health\":\"true\"}");
+    } else if (req.path.compare(0, prefix.size(), prefix) != 0) {
+      respond(fd, 400, "{\"errorCode\":400,\"message\":\"bad path\"}");
+    } else {
+      std::string key = req.path.substr(prefix.size());
+      std::lock_guard<std::mutex> lock(g_mu);
+      auto it = g_store.find(key);
+      if (req.method == "GET") {
+        if (it == g_store.end()) {
+          respond(fd, 404,
+                  "{\"errorCode\":100,\"message\":\"Key not found\"}");
+        } else {
+          respond(fd, 200, "{\"action\":\"get\",\"node\":" +
+                               node_json(key, it->second, g_index) + "}");
+        }
+      } else if (req.method == "PUT") {
+        auto pv = req.form.find("prevValue");
+        if (pv != req.form.end() &&
+            (it == g_store.end() || it->second != pv->second)) {
+          respond(fd, 412,
+                  "{\"errorCode\":101,\"message\":\"Compare failed\"}");
+        } else {
+          g_store[key] = req.form["value"];
+          ++g_index;
+          persist(key, req.form["value"], false);
+          respond(fd, it == g_store.end() ? 201 : 200,
+                  "{\"action\":\"set\",\"node\":" +
+                      node_json(key, req.form["value"], g_index) + "}");
+        }
+      } else if (req.method == "DELETE") {
+        g_store.erase(key);
+        ++g_index;
+        persist(key, "", true);
+        respond(fd, 200, "{\"action\":\"delete\"}");
+      } else {
+        respond(fd, 400, "{\"errorCode\":400,\"message\":\"bad method\"}");
+      }
+    }
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 2379;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
+    if (!strcmp(argv[i], "--persist")) g_persist_path = argv[i + 1];
+    if (!strcmp(argv[i], "--delay-ms")) g_delay_ms = atoi(argv[i + 1]);
+  }
+  replay();
+  signal(SIGPIPE, SIG_IGN);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(port);
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  listen(srv, 128);
+  fprintf(stderr, "casd listening on 127.0.0.1:%d\n", port);
+  while (true) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(handle, fd).detach();
+  }
+}

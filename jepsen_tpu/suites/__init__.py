@@ -1,0 +1,17 @@
+"""Per-database test suites (the reference's L8 layer).
+
+Each suite module exposes ``*_test(...)`` builders returning test maps
+that runtime.run executes — DB automation, wire-protocol clients,
+workloads, nemesis wiring, and checker composition for one real system
+(reference: etcd/, hazelcast/, aerospike/, rabbitmq/, cockroachdb/, ...
+each an independent Leiningen project over the jepsen library).
+
+Suites run in two modes:
+
+  * **cluster** — real nodes over SSH, the reference's deployment shape;
+  * **local**   — the same suite against real local processes through
+    the LocalTransport (control.core), with per-node ports/directories.
+    This is the CI mode: daemons really start, get SIGSTOPped, killed,
+    and restarted, and the checkers really catch the violations those
+    faults induce.
+"""

@@ -1,0 +1,358 @@
+"""etcd suite: CAS-register linearizability over independent keys.
+
+Mirrors the reference's canonical modern suite
+(etcd/src/jepsen/etcd.clj): DB automation at 45-99 (tarball install,
+daemon start with cluster flags, log collection), the HTTP client with
+exception→fail/info mapping at 101-136, and the workload wiring at
+149-180 (independent concurrent keys × CAS mix, linearizable checker +
+timeline + perf, partitioning nemesis). North-star config #1.
+
+Two DBs share the client and workload:
+
+  * ``EtcdDB``  — real etcd on cluster nodes over SSH (v2 keys API).
+  * ``CasdDB``  — the in-CI stand-in: jepsen_tpu/resources/casd.cpp, a
+    compiled CAS server speaking the same v2 subset, deployed by
+    compiling the shipped source on the "node" (the same
+    upload-and-gcc discipline as the clock tools, nemesis/time.clj
+    pattern), started under start-stop-daemon with a pidfile. In-memory
+    by default — kill+restart wipes state, which the checker must
+    catch; ``persist=True`` adds a replayed write log, making restarts
+    harmless.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import urllib.error
+import urllib.parse
+import urllib.request
+from pathlib import Path
+from typing import Optional
+
+from .. import gen as g
+from .. import independent
+from ..checkers.core import compose
+from ..checkers.linearizable import linearizable
+from ..checkers.perf import perf
+from ..checkers.timeline import html_timeline
+from ..client import Client
+from ..control import core as c
+from ..control import util as cu
+from ..db import DB
+from ..models.core import cas_register
+from ..nemesis import core as nem
+from ..os_ import NoopOS
+from ..testing import noop_test
+
+log = logging.getLogger("jepsen.etcd")
+
+ETCD_VERSION = "v3.5.12"
+ETCD_URL = ("https://github.com/etcd-io/etcd/releases/download/"
+            f"{ETCD_VERSION}/etcd-{ETCD_VERSION}-linux-amd64.tar.gz")
+DIR = "/opt/etcd"
+
+
+def client_url(test: dict, node) -> str:
+    """Base URL of a node's client API. Suites populate
+    test["client_urls"]; defaults to the node's 2379."""
+    urls = test.get("client_urls") or {}
+    return urls.get(node, f"http://{node}:2379")
+
+
+def peer_url(node) -> str:
+    return f"http://{node}:2380"
+
+
+class EtcdDB(DB):
+    """Real etcd on a cluster node (etcd.clj:45-99): install the release
+    tarball, start with static initial-cluster bootstrap, tear down by
+    killing and wiping the data dir."""
+
+    def setup(self, test, node):
+        with c.su():
+            cu.install_archive(test.get("etcd_url", ETCD_URL), DIR)
+            initial = ",".join(f"{n}={peer_url(n)}"
+                               for n in test["nodes"])
+            cu.start_daemon(
+                {"logfile": f"{DIR}/etcd.log", "pidfile": f"{DIR}/etcd.pid",
+                 "chdir": DIR},
+                f"{DIR}/etcd",
+                "--name", str(node),
+                "--listen-peer-urls", peer_url(node),
+                "--listen-client-urls", f"http://0.0.0.0:2379",
+                "--advertise-client-urls", client_url(test, node),
+                "--initial-advertise-peer-urls", peer_url(node),
+                "--initial-cluster-state", "new",
+                "--initial-cluster", initial,
+                "--enable-v2")
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.grepkill("etcd")
+            c.exec_("rm", "-rf", DIR)
+
+    def log_files(self, test, node):
+        return [f"{DIR}/etcd.log"]
+
+
+class CasdDB(DB):
+    """The local-mode stand-in: compile the shipped casd source on the
+    node and run it under start-stop-daemon. One instance per logical
+    node, ports from test["casd_ports"]."""
+
+    def __init__(self, persist: bool = True):
+        self.persist = persist
+
+    def _dir(self, test, node) -> str:
+        return f"{test.get('casd_dir', '/tmp/jepsen/casd')}/{node}"
+
+    def setup(self, test, node):
+        d = self._dir(test, node)
+        src = Path(__file__).resolve().parent.parent / "resources/casd.cpp"
+        c.exec_("mkdir", "-p", d)
+        c.upload(str(src), f"{d}/casd.cpp")
+        if not cu.exists(f"{d}/casd"):
+            c.exec_("g++", "-O2", "-std=c++17", "-o", f"{d}/casd",
+                    f"{d}/casd.cpp", "-lpthread")
+        port = test["casd_ports"][node]
+        args = ["--port", port]
+        if self.persist:
+            args += ["--persist", f"{d}/casd.wal"]
+        cu.start_daemon(
+            {"logfile": f"{d}/casd.log", "pidfile": f"{d}/casd.pid",
+             "chdir": d},
+            f"{d}/casd", *args)
+        # Wait for the listener before declaring the node up.
+        c.exec_star(
+            f"for i in $(seq 50); do "
+            f"curl -sf http://127.0.0.1:{port}/health >/dev/null && exit 0; "
+            f"sleep 0.1; done; echo casd never came up; exit 1")
+
+    def teardown(self, test, node):
+        d = self._dir(test, node)
+        cu.stop_daemon(f"{d}/casd.pid")
+        c.exec_("rm", "-rf", d)
+
+    def log_files(self, test, node):
+        return [f"{self._dir(test, node)}/casd.log"]
+
+
+class EtcdClient(Client):
+    """CAS register over the v2 keys HTTP API with the reference's
+    exception mapping (etcd.clj:101-136): indeterminate network faults
+    on mutating ops are :info, definite rejections and safe read faults
+    are :fail."""
+
+    def __init__(self, timeout: float = 1.0):
+        self.timeout = timeout
+        self.node = None
+        self.base = None
+
+    def setup(self, test, node):
+        cl = EtcdClient(self.timeout)
+        cl.node = node
+        cl.base = client_url(test, node)
+        return cl
+
+    # -- HTTP ----------------------------------------------------------
+    def _req(self, method: str, key, form: Optional[dict] = None):
+        url = f"{self.base}/v2/keys/jepsen-{key}"
+        data = urllib.parse.urlencode(form).encode() if form else None
+        req = urllib.request.Request(url, data=data, method=method)
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read().decode())
+
+    def invoke(self, test, op):
+        f = op["f"]
+        k, v = op["value"] if independent.is_kv(op["value"]) \
+            else (None, op["value"])
+
+        def done(typ, value=v, **extra):
+            out = {**op, "type": typ, **extra}
+            out["value"] = independent.tuple_(k, value) if k is not None \
+                else value
+            return out
+
+        try:
+            if f == "read":
+                try:
+                    body = self._req("GET", k)
+                    return done("ok", int(body["node"]["value"]))
+                except urllib.error.HTTPError as e:
+                    if e.code == 404:
+                        return done("ok", None)
+                    raise
+            elif f == "write":
+                self._req("PUT", k, {"value": v})
+                return done("ok")
+            elif f == "cas":
+                old, new = v
+                try:
+                    self._req("PUT", k, {"value": new, "prevValue": old})
+                    return done("ok")
+                except urllib.error.HTTPError as e:
+                    if e.code == 412:          # compare failed
+                        return done("fail", error="cas-mismatch")
+                    raise
+            raise ValueError(f"unknown op {f}")
+        except (socket.timeout, TimeoutError) as e:
+            # Timeout: a read definitely observed nothing (fail is safe);
+            # a mutation may or may not have applied (info).
+            typ = "fail" if f == "read" else "info"
+            return done(typ, error="timeout")
+        except (ConnectionError, urllib.error.URLError) as e:
+            reason = getattr(e, "reason", e)
+            if isinstance(reason, (socket.timeout, TimeoutError)):
+                typ = "fail" if f == "read" else "info"
+                return done(typ, error="timeout")
+            # Connection refused/reset before a response: refused means
+            # the request never reached a server (fail); reset
+            # mid-flight on a mutation is indeterminate.
+            if isinstance(reason, ConnectionRefusedError) or f == "read":
+                return done("fail", error=str(reason))
+            return done("info", error=str(reason))
+
+
+def workload(test_opts: dict) -> dict:
+    """Independent-keys CAS workload + checker composition
+    (etcd.clj:149-180): n threads per key, a stagger'd read/write/cas
+    mix limited per key, checked by the device-batched linearizable
+    checker with timeline + perf."""
+    per_key = test_opts.get("ops_per_key", 300)
+    threads = test_opts.get("threads_per_key", 5)
+    backend = test_opts.get("checker_backend", "tpu")
+
+    generator = independent.concurrent_generator(
+        threads, iter(range(10**9)),
+        lambda k: g.limit(per_key,
+                          g.stagger(1 / 50,
+                                    g.cas_gen(test_opts.get(
+                                        "n_values", 5)))))
+    checker = compose({
+        "independent": independent.batch_checker()
+        if backend == "tpu" else independent.checker(linearizable()),
+        "timeline": html_timeline(),
+        "perf": perf(),
+    })
+    return {"generator": generator, "checker": checker,
+            "model": cas_register()}
+
+
+def _with_nemesis(test: dict, nemesis_gen, time_limit: float) -> None:
+    """Route client ops vs the nemesis schedule and bound the WHOLE run
+    — the time limit must cover the (infinite) nemesis stream too, or
+    the nemesis worker never exits (the reference wraps the combined
+    generator: etcd.clj:167-179)."""
+    client_gen = test["generator"]
+    combined = g.nemesis(nemesis_gen, client_gen) \
+        if nemesis_gen is not None else g.clients(client_gen)
+    test["generator"] = g.time_limit(time_limit, combined)
+
+
+def etcd_test(**opts) -> dict:
+    """The real-cluster etcd test (etcd.clj:149-180): 5 nodes, random
+    half partitions on a 5s cadence."""
+    nodes = opts.get("nodes", ["n1", "n2", "n3", "n4", "n5"])
+    test = noop_test(
+        name="etcd",
+        nodes=nodes,
+        concurrency=opts.get("concurrency", 3 * len(nodes)),
+        db=EtcdDB(),
+        client=EtcdClient(),
+        nemesis=nem.partition_random_halves(),
+        **workload(opts))
+    import itertools
+    _with_nemesis(test,
+                  g.seq(itertools.cycle([{"type": "info", "f": "start"},
+                                         g.sleep(5),
+                                         {"type": "info", "f": "stop"},
+                                         g.sleep(5)])),
+                  opts.get("time_limit", 30))
+    test.update({k: v for k, v in opts.items()
+                 if k not in ("nodes", "concurrency")})
+    return test
+
+
+def _casd_pauser(test) -> Client:
+    """SIGSTOP/SIGCONT one node's casd (hammer-time semantics,
+    nemesis.clj:227-241, targeted per port so only that logical node
+    stalls)."""
+    def start(test, node):
+        c.exec_star(f"pkill -STOP -f '[c]asd --port "
+                    f"{test['casd_ports'][node]}'")
+        return "paused"
+
+    def stop(test, node):
+        c.exec_star(f"pkill -CONT -f '[c]asd --port "
+                    f"{test['casd_ports'][node]}' || true")
+        return "resumed"
+
+    import random as _r
+    return nem.node_start_stopper(lambda nodes: _r.choice(nodes),
+                                  start, stop)
+
+
+def _casd_restarter(db: CasdDB) -> Client:
+    """Kill -9 one node's casd and restart it — with persist=False this
+    wipes the register, a real consistency violation the checker must
+    flag."""
+    def start(test, node):
+        c.exec_star(f"pkill -9 -f '[c]asd --port "
+                    f"{test['casd_ports'][node]}' || true")
+        return "killed"
+
+    def stop(test, node):
+        db.setup(test, node)
+        return "restarted"
+
+    import random as _r
+    return nem.node_start_stopper(lambda nodes: _r.choice(nodes),
+                                  start, stop)
+
+
+def casd_test(nemesis_mode: str = "pause", persist: bool = True,
+              **opts) -> dict:
+    """The local-mode etcd-suite test: N real casd processes on
+    localhost ports, driven through the LocalTransport. ``nemesis_mode``:
+    "pause" (SIGSTOP hammer), "restart" (kill -9 + restart), or None.
+
+    casd nodes don't replicate (real etcd does), so with n_nodes > 1
+    every client routes to the primary's store for correctness while the
+    other nodes still run real daemons — multi-node setup/teardown/log
+    paths get exercised without pretending unreplicated stores form one
+    register. Single-node tests exercise the fault semantics."""
+    n = opts.get("n_nodes", 1)
+    nodes = [f"n{i + 1}" for i in range(n)]
+    base = opts.get("base_port", 23790)
+    ports = {node: base + i for i, node in enumerate(nodes)}
+    db = CasdDB(persist=persist)
+    test = noop_test(
+        name=opts.get("name", "etcd-casd"),
+        nodes=nodes,
+        concurrency=opts.get("concurrency", 2 * n),
+        ssh={"local": True},
+        os=NoopOS(),
+        db=db,
+        client=EtcdClient(timeout=opts.get("client_timeout", 0.5)),
+        casd_ports=ports,
+        casd_dir=opts.get("casd_dir", "/tmp/jepsen/casd"),
+        client_urls={node: f"http://127.0.0.1:{ports[nodes[0]]}"
+                     for node in nodes},
+        **workload(opts))
+    if nemesis_mode == "pause":
+        test["nemesis"] = _casd_pauser(test)
+    elif nemesis_mode == "restart":
+        test["nemesis"] = _casd_restarter(db)
+    nem_gen = None
+    if test.get("nemesis"):
+        import itertools
+        cadence = opts.get("nemesis_cadence", 2.0)
+        nem_gen = g.seq(itertools.cycle([g.sleep(cadence),
+                                         {"type": "info", "f": "start"},
+                                         g.sleep(cadence),
+                                         {"type": "info", "f": "stop"}]))
+    _with_nemesis(test, nem_gen, opts.get("time_limit", 30))
+    test.update({k: v for k, v in opts.items()
+                 if k not in ("n_nodes", "concurrency", "name")})
+    return test
