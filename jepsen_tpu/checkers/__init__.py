@@ -4,7 +4,7 @@ from .core import (
     check_safe,
     merge_valid,
     compose,
-    unbridled_optimism,
+    always_valid,
     VALID_PRIORITIES,
 )
 from .simple import (

@@ -69,6 +69,12 @@ def _droppable_invocations(model: Model, h: List[Op],
     return dropped_invocations(space, h) if space is not None else set()
 
 
+# Default memo for the droppable-invocation state-space enumeration:
+# callers that don't thread their own cache still pay the enumeration at
+# most once per (model, op-vocabulary) instead of once per call.
+_DEFAULT_SPACE_CACHE: dict = {}
+
+
 def wgl_check(model: Model, history: List[Op],
               max_configs: int = 2_000_000,
               space_cache: Optional[dict] = None) -> dict:
@@ -76,8 +82,17 @@ def wgl_check(model: Model, history: List[Op],
 
     Returns {"valid": bool|"unknown", "op": first-impossible-op,
              "configs": sample of surviving configs before failure}.
+
+    Divergence from the reference's Knossos output: invocations that can
+    never linearize to an observable effect (the identity-drop rule,
+    jepsen_tpu.ops.encode.dropped_invocations) are removed before the
+    search, so they do not appear in reported ``pending`` config
+    samples. Knossos keeps them pending; validity verdicts are
+    unaffected — only the config-sample cosmetics differ.
     """
     h = prepare_history(history)
+    if space_cache is None:
+        space_cache = _DEFAULT_SPACE_CACHE
     dropped = _droppable_invocations(model, h, space_cache)
 
     configs = {(model, frozenset())}

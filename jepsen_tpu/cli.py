@@ -165,8 +165,8 @@ def run_cli(subcommands: Dict[str, dict],
         raise
     except BaseException:
         logging.getLogger("jepsen.cli").fatal(
-            "Oh jeez, I'm sorry, Jepsen broke. Here's why:\n%s",
-            traceback.format_exc())
+            "The test harness itself crashed (not the system under "
+            "test). Cause:\n%s", traceback.format_exc())
         sys.exit(255)
 
 

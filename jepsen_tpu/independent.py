@@ -118,17 +118,16 @@ class _ConcurrentGenerator(g.Generator):
                 f"equal the number of integer threads ({tc})")
         if self.n > tc:
             raise AssertionError(
-                f"With {tc} worker threads, this concurrent-generator cannot "
-                f"run a key with {self.n} threads concurrently. Consider "
-                f"raising your test's concurrency to at least {self.n}.")
+                f"concurrent-generator needs {self.n} threads per key but "
+                f"the test only has {tc} worker threads; raise concurrency "
+                f"to at least {self.n}.")
         groups = tc // self.n
         if groups * self.n != tc:
             raise AssertionError(
-                f"This concurrent-generator has {tc} threads to work with, "
-                f"but can only use {groups * self.n} of those threads to run "
-                f"{groups} concurrent keys with {self.n} threads apiece. "
-                f"Consider raising or lowering the test's concurrency to a "
-                f"multiple of {self.n}.")
+                f"concurrency ({tc}) must be a multiple of {self.n} "
+                f"(the threads-per-key group size): {tc} threads can only "
+                f"host {groups} full groups, stranding "
+                f"{tc - groups * self.n} threads.")
         self._group_threads = [tuple(threads[i * self.n:(i + 1) * self.n])
                                for i in range(groups)]
         self._active = []

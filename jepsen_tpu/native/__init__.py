@@ -37,24 +37,48 @@ _lock = threading.Lock()
 _lib = None
 
 
+_STAMP = _DIR / ".libjepsen_native.srchash"
+
+
+def _src_hash() -> str:
+    import hashlib
+    return hashlib.sha256(_SRC.read_bytes()).hexdigest()
+
+
 def build(force: bool = False) -> Path:
-    """Compile the shared library if stale."""
-    if force or not _LIB.exists() or \
-            _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+    """Compile the shared library if stale.
+
+    Staleness is decided by a content hash of the source recorded at
+    build time (mtime survives git checkouts in the wrong order and
+    says nothing about what the .so was actually built from)."""
+    h = _src_hash()
+    if force or not _LIB.exists() or not _STAMP.exists() or \
+            _STAMP.read_text().strip() != h:
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
                "-o", str(_LIB), str(_SRC), "-lpthread"]
         r = subprocess.run(cmd, capture_output=True, text=True)
         if r.returncode != 0:
             raise RuntimeError(f"native build failed:\n{r.stderr}")
+        _STAMP.write_text(h + "\n")
     return _LIB
+
+
+def _load() -> ctypes.CDLL:
+    build()
+    try:
+        return ctypes.CDLL(str(_LIB))
+    except OSError:
+        # A corrupt or foreign-ABI .so (e.g. copied between machines):
+        # rebuild from source once before giving up.
+        build(force=True)
+        return ctypes.CDLL(str(_LIB))
 
 
 def lib():
     global _lib
     with _lock:
         if _lib is None:
-            build()
-            L = ctypes.CDLL(str(_LIB))
+            L = _load()
             i32p = ctypes.POINTER(ctypes.c_int32)
             u8p = ctypes.POINTER(ctypes.c_uint8)
             i64p = ctypes.POINTER(ctypes.c_int64)

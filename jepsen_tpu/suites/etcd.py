@@ -47,6 +47,14 @@ from ..testing import noop_test
 
 log = logging.getLogger("jepsen.etcd")
 
+# A read observing "key absent" is a REAL observation, distinct from the
+# no-observation None (which the model treats as a wildcard, the knossos
+# nil-read convention). Without the distinction a state-wiping restart
+# is invisible: every post-wipe read would look like an unknown read.
+# The workload model starts as CASRegister(ABSENT) so pre-first-write
+# reads linearize, and a post-write ABSENT read is a violation.
+ABSENT = "absent"
+
 ETCD_VERSION = "v3.5.12"
 ETCD_URL = ("https://github.com/etcd-io/etcd/releases/download/"
             f"{ETCD_VERSION}/etcd-{ETCD_VERSION}-linux-amd64.tar.gz")
@@ -181,7 +189,7 @@ class EtcdClient(Client):
                     return done("ok", int(body["node"]["value"]))
                 except urllib.error.HTTPError as e:
                     if e.code == 404:
-                        return done("ok", None)
+                        return done("ok", ABSENT)
                     raise
             elif f == "write":
                 self._req("PUT", k, {"value": v})
@@ -194,6 +202,8 @@ class EtcdClient(Client):
                 except urllib.error.HTTPError as e:
                     if e.code == 412:          # compare failed
                         return done("fail", error="cas-mismatch")
+                    if e.code == 404:          # key absent: definitely no-op
+                        return done("fail", error="key-absent")
                     raise
             raise ValueError(f"unknown op {f}")
         except (socket.timeout, TimeoutError) as e:
@@ -236,7 +246,7 @@ def workload(test_opts: dict) -> dict:
         "perf": perf(),
     })
     return {"generator": generator, "checker": checker,
-            "model": cas_register()}
+            "model": cas_register(ABSENT)}
 
 
 def _with_nemesis(test: dict, nemesis_gen, time_limit: float) -> None:
@@ -279,8 +289,10 @@ def _casd_pauser(test) -> Client:
     nemesis.clj:227-241, targeted per port so only that logical node
     stalls)."""
     def start(test, node):
+        # casd may be absent mid-restart; pkill's exit 1 must not abort
+        # the nemesis worker.
         c.exec_star(f"pkill -STOP -f '[c]asd --port "
-                    f"{test['casd_ports'][node]}'")
+                    f"{test['casd_ports'][node]}' || true")
         return "paused"
 
     def stop(test, node):
@@ -296,15 +308,21 @@ def _casd_pauser(test) -> Client:
 def _casd_restarter(db: CasdDB) -> Client:
     """Kill -9 one node's casd and restart it — with persist=False this
     wipes the register, a real consistency violation the checker must
-    flag."""
+    flag.
+
+    Kill and restart happen within ONE nemesis op so the node's dead
+    window is just the daemon's own startup time; independent keys are
+    short-lived, and a long dead window would let every key die (as
+    fail/info timeouts) before the wipe becomes observable, hiding the
+    violation from the checker."""
     def start(test, node):
         c.exec_star(f"pkill -9 -f '[c]asd --port "
                     f"{test['casd_ports'][node]}' || true")
-        return "killed"
+        db.setup(test, node)
+        return "killed+restarted"
 
     def stop(test, node):
-        db.setup(test, node)
-        return "restarted"
+        return "nop"
 
     import random as _r
     return nem.node_start_stopper(lambda nodes: _r.choice(nodes),
@@ -327,10 +345,20 @@ def casd_test(nemesis_mode: str = "pause", persist: bool = True,
     base = opts.get("base_port", 23790)
     ports = {node: base + i for i, node in enumerate(nodes)}
     db = CasdDB(persist=persist)
+    # The concurrent generator requires concurrency to be a multiple of
+    # threads_per_key; derive the default from it (>= 2n workers) and
+    # validate explicit pairs up front rather than at first poll.
+    tpk = opts.get("threads_per_key", 5)
+    concurrency = opts.get("concurrency",
+                           tpk * max(1, -(-2 * n // tpk)))
+    if concurrency % tpk != 0:
+        raise ValueError(
+            f"concurrency ({concurrency}) must be a multiple of "
+            f"threads_per_key ({tpk})")
     test = noop_test(
         name=opts.get("name", "etcd-casd"),
         nodes=nodes,
-        concurrency=opts.get("concurrency", 2 * n),
+        concurrency=concurrency,
         ssh={"local": True},
         os=NoopOS(),
         db=db,

@@ -147,7 +147,7 @@ class Handler(BaseHTTPRequestHandler):
                              f'attachment; filename="{p.name}.zip"')])
 
 
-def serve(host: str = "0.0.0.0", port: int = 8080,
+def serve(host: str = "127.0.0.1", port: int = 8080,
           store: Optional[Store] = None, block: bool = False):
     """Start the results server (web.clj:315-320). Returns the server;
     when block=True, serves forever."""

@@ -79,7 +79,7 @@ def test_casd_pause_nemesis_stays_valid(tmp_path):
     linearizability violation — the hard indeterminate case."""
     test = etcd.casd_test(nemesis_mode="pause", persist=True,
                           **_base_opts(tmp_path, base_port=23890,
-                                       n_nodes=1, concurrency=3))
+                                       n_nodes=1, concurrency=4))
     result = run_stored(test, tmp_path)
     assert result["results"]["independent"]["valid"] is True
     hist = result["history"]
@@ -94,6 +94,7 @@ def test_casd_restart_without_persistence_detected_invalid(tmp_path):
     test = etcd.casd_test(nemesis_mode="restart", persist=False,
                           **_base_opts(tmp_path, base_port=23990,
                                        time_limit=8, n_nodes=1,
+                                       ops_per_key=200,
                                        nemesis_cadence=1.0,
                                        n_values=3))
     result = run_stored(test, tmp_path)
