@@ -57,19 +57,23 @@ def main():
     buckets, failures = encode()
     t_encode = time.time() - t0
 
-    # Tail cost classes below the threshold go to the native CPU engine
-    # (a handful of info-heavy rows isn't worth an XLA compile), as do
-    # encoder-overflow rows.
-    dev_buckets = [b for b in buckets if b.batch >= min_dev]
-    cpu_rows = [i for b in buckets if b.batch < min_dev for i in b.indices]
-    cpu_rows += [i for i, _ in failures]
     try:
         from jepsen_tpu.native import check_batch_native, lib as _native_lib
         _native_lib()                          # build/load outside timing
     except Exception:
         check_batch_native = None
-    if check_batch_native is None:
-        dev_buckets, cpu_rows = buckets, [i for i, _ in failures]
+
+    def route(bkts, fails):
+        """Tail cost classes below the threshold go to the native CPU
+        engine (a handful of info-heavy rows isn't worth an XLA
+        compile), as do encoder-overflow rows."""
+        if check_batch_native is None:
+            return bkts, [i for i, _ in fails]
+        dev = [b for b in bkts if b.batch >= min_dev]
+        cpu = [i for b in bkts if b.batch < min_dev for i in b.indices]
+        return dev, cpu + [i for i, _ in fails]
+
+    dev_buckets, cpu_rows = route(buckets, failures)
     cpu_hists = [columnar_to_ops(cols, i) for i in cpu_rows]
 
     def run_all():
@@ -121,6 +125,43 @@ def main():
         check_batch_native(model, sub)
         native_rate = round(len(sub) / (time.time() - t0), 2)
 
+    # Converted-history extra: recorded Op-list histories ride the fast
+    # path end-to-end (native ingest walk + vectorized encode + device).
+    # Reconstruction to Op lists is setup (they stand in for histories
+    # the runtime recorded); conversion onward is the timed path.
+    from jepsen_tpu.history.columnar import ops_to_columnar
+    C = min(int(os.environ.get("JT_BENCH_CONVERTED", "2000")), B)
+    conv_hists = [columnar_to_ops(cols, r) for r in range(C)]
+    ops_to_columnar(model, conv_hists[:2])       # warm the native build
+
+    def run_converted():
+        ccols = ops_to_columnar(model, conv_hists)
+        space_c = enumerate_statespace(model, ccols.kinds, 64)
+        cbuckets, cfails = encode_columnar(space_c, ccols, max_slots=16)
+        cdev, ccpu = route(cbuckets, cfails)
+        cvalid = np.ones(C, bool)
+        for b in cdev:
+            v, _, _ = run_encoded_batch(b)
+            cvalid[np.asarray(b.indices)] = v
+        if ccpu:
+            rs = (check_batch_native(model,
+                                     [conv_hists[i] for i in ccpu])
+                  if check_batch_native is not None else
+                  [wgl_check(model, conv_hists[i]) for i in ccpu])
+            for i, r in zip(ccpu, rs):
+                cvalid[i] = r["valid"] is True
+        return cvalid
+
+    run_converted()                              # warm compiles
+    t0 = time.time()
+    cvalid = run_converted()
+    t_conv = time.time() - t0
+    converted_rate = C / t_conv
+    # Compare against the main run's verdicts where both were on-device.
+    cmp_rows = np.array([r for r in range(C) if r not in skip], int)
+    converted_match = bool(
+        (cvalid[cmp_rows] == dev_valid[cmp_rows]).all())
+
     print(json.dumps({
         "metric": "linearizability_check_throughput_1kop_cas_e2e",
         "value": round(rate, 2),
@@ -134,6 +175,9 @@ def main():
         "buckets": [[b.V, b.W, b.batch] for b in buckets],
         "device": str(jax.devices()[0]),
         "native_cpu_rate": native_rate,
+        "converted_e2e_rate": round(converted_rate, 2),
+        "converted_histories": C,
+        "converted_verdict_match": converted_match,
         "device_rate": round(n_checked / t_dev, 2),
         "device_time_s": round(t_dev, 3),
         "encode_time_s": round(t_encode, 3),

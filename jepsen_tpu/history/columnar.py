@@ -51,12 +51,17 @@ class ColumnarOps:
     kinds   — the shared op-kind vocabulary, index-aligned with the
               transition table callers build via
               ops.statespace.enumerate_statespace(model, kinds, ...)
+    index   — optional int32 [B, N]: each line's index in the history it
+              was converted from (-1 on PAD); present on converted
+              batches (``ops_to_columnar``) so verdict line positions
+              map back to original op indices
     """
 
     type: np.ndarray
     process: np.ndarray
     kind: np.ndarray
     kinds: List[Tuple]
+    index: Optional[np.ndarray] = None
 
     @property
     def batch(self) -> int:
@@ -66,16 +71,205 @@ class ColumnarOps:
     def n_lines(self) -> int:
         return int(self.type.shape[1])
 
+    def op_index(self, row: int, line: int) -> int:
+        """Original-history op index for a line (the line itself when the
+        batch was synthesized rather than converted)."""
+        if self.index is None:
+            return int(line)
+        return int(self.index[row, line])
+
 
 def _kind_value(kind: Tuple):
     f, cv = kind
     return list(cv) if isinstance(cv, tuple) else cv
 
 
+def _walk_py(histories: Sequence[Sequence[Op]], vocab: dict,
+             all_kinds: List[Tuple]):
+    """Pure-Python twin of the native ingest walk (native/ingest.cpp):
+    pairing, failure retraction, and value propagation over recorded
+    histories, emitting flat line buffers. The oracle for the native
+    walk's parity tests and the fallback when it can't build."""
+    from ..ops.statespace import canonical_value
+
+    code: List[int] = []
+    proc: List[int] = []
+    kind: List[int] = []
+    oidx: List[int] = []
+    okflag: List[int] = []
+    link: List[int] = []
+    rowlen: List[int] = []
+    for h in histories:
+        rowstart = len(code)
+        open_line: dict = {}     # process -> flat invoke-line index
+        open_fv: dict = {}       # process -> (f, value)
+        dense: dict = {}         # process -> per-row dense id
+        for pos, op in enumerate(h):
+            p = op.process
+            if not isinstance(p, int):
+                continue
+            t = op.type
+            if t == "invoke":
+                open_line[p] = len(code)
+                open_fv[p] = (op.f, op.value)
+                code.append(C_INVOKE)
+                proc.append(dense.setdefault(p, len(dense)))
+                kind.append(-1)
+                oidx.append(op.index if op.index is not None else pos)
+                okflag.append(0)
+                link.append(-1)
+            elif t == "ok" or t == "info":
+                j = open_line.pop(p, None)
+                if j is None:
+                    continue
+                f, v = open_fv.pop(p)
+                if v is None and t == "ok":
+                    # Only ok completions propagate observations
+                    # (history.core.complete semantics): an info op's
+                    # value is not an observation.
+                    v = op.value
+                k = (f, canonical_value(v))
+                ki = vocab.get(k)
+                if ki is None:
+                    ki = vocab[k] = len(all_kinds)
+                    all_kinds.append(k)
+                kind[j] = ki
+                if t == "ok":
+                    okflag[j] = 1
+                    code.append(C_OK)
+                    link.append(-1)
+                else:
+                    code.append(C_INFO)
+                    link.append(j)
+                proc.append(proc[j])
+                kind.append(-1)
+                oidx.append(op.index if op.index is not None else pos)
+                okflag.append(0)
+            elif t == "fail":
+                # Definitely didn't happen: retract the invoke line.
+                j = open_line.pop(p, None)
+                open_fv.pop(p, None)
+                if j is not None:
+                    code[j] = PAD
+        # Crashed invocations (no completion): kind from the invoke.
+        for p, j in open_line.items():
+            f, v = open_fv[p]
+            k = (f, canonical_value(v))
+            ki = vocab.get(k)
+            if ki is None:
+                ki = vocab[k] = len(all_kinds)
+                all_kinds.append(k)
+            kind[j] = ki
+        rowlen.append(len(code) - rowstart)
+    return (np.asarray(code, np.int8), np.asarray(proc, np.int32),
+            np.asarray(kind, np.int32), np.asarray(oidx, np.int32),
+            np.asarray(okflag, np.int8), np.asarray(link, np.int32),
+            np.asarray(rowlen, np.int64))
+
+
+def ops_to_columnar(model, histories: Sequence[Sequence[Op]], *,
+                    kinds: Optional[List[Tuple]] = None,
+                    max_states: int = 64,
+                    native: bool = True) -> ColumnarOps:
+    """Convert recorded/stored Op-list histories into one prepared
+    ColumnarOps — the ingest ramp onto the columnar fast path for
+    histories the framework actually executed or reloaded
+    (store.load_histories, independent subhistories; the reference's
+    re-check seam is jepsen/src/jepsen/store.clj:165-171).
+
+    One fused walk per history applies the full prepared-history
+    contract (checkers.linearizable.prepare_history + the identity-drop
+    rule of ops.encode.dropped_invocations):
+
+      * non-client ops are skipped;
+      * failed ops never happened — neither line is emitted;
+      * observed values are propagated — each invoke line carries the
+        final (f, value) op-kind (a read's observation, not None);
+      * never-ok total-identity invocations (and their info completions)
+        are dropped, keeping the pending window proportional to real
+        concurrency.
+
+    ``kinds`` seeds the shared vocabulary (indices preserved); new kinds
+    found in the histories are appended. ``model`` is needed to decide
+    which kinds are identity transitions; a state space past
+    ``max_states`` raises StateSpaceExplosion — callers route the whole
+    batch to a host/native engine in that case.
+
+    Per-line op indices land in ``.index`` so invalid verdicts map back
+    to original ops. Process ids are densified per row to bound the
+    walk's process table. The walk itself runs in the native extension
+    (native/ingest.cpp) when available (``native=False`` forces the
+    pure-Python twin); the identity-drop + padding pass is vectorized
+    numpy either way.
+    """
+    from ..ops.statespace import enumerate_statespace
+
+    vocab: dict = {}
+    all_kinds: List[Tuple] = []
+    for k in (kinds or []):
+        if k not in vocab:
+            vocab[k] = len(all_kinds)
+            all_kinds.append(k)
+
+    ext = None
+    if native:
+        from ..native import ingest
+        ext = ingest()
+    if ext is not None:
+        histories = [h if isinstance(h, (list, tuple)) else list(h)
+                     for h in histories]
+        bufs = ext.walk(histories, vocab, all_kinds)
+        code = np.frombuffer(bufs[0], np.int8)
+        proc = np.frombuffer(bufs[1], np.int32)
+        kind = np.frombuffer(bufs[2], np.int32)
+        oidx = np.frombuffer(bufs[3], np.int32)
+        okflag = np.frombuffer(bufs[4], np.int8)
+        link = np.frombuffer(bufs[5], np.int32)
+        rowlen = np.frombuffer(bufs[6], np.int64)
+    else:
+        code, proc, kind, oidx, okflag, link, rowlen = _walk_py(
+            histories, vocab, all_kinds)
+
+    space = enumerate_statespace(model, all_kinds, max_states)
+    identity = space.identity_kinds
+
+    drop = code == PAD
+    if identity:
+        # Never-ok total-identity invocations and their info lines.
+        ident_mask = np.zeros(len(all_kinds) + 1, bool)
+        ident_mask[list(identity)] = True
+        inv_ident = (code == C_INVOKE) & ident_mask[kind] & (okflag == 0)
+        drop |= inv_ident
+        linked = link >= 0
+        drop |= linked & inv_ident[np.where(linked, link, 0)]
+    keep = ~drop
+
+    B = len(rowlen)
+    rid = np.repeat(np.arange(B), rowlen)[keep]
+    counts = np.bincount(rid, minlength=B)
+    N = int(counts.max()) if B else 0
+    starts = np.zeros(B, np.int64)
+    np.cumsum(counts[:-1], out=starts[1:])
+    posin = np.arange(rid.size, dtype=np.int64) - starts[rid]
+
+    typ = np.full((B, max(N, 1)), PAD, np.int8)
+    procs = np.zeros((B, max(N, 1)), np.int16)
+    kinds_arr = np.full((B, max(N, 1)), -1, np.int32)
+    index = np.full((B, max(N, 1)), -1, np.int32)
+    typ[rid, posin] = code[keep]
+    procs[rid, posin] = proc[keep].astype(np.int16)
+    kinds_arr[rid, posin] = kind[keep]
+    index[rid, posin] = oidx[keep]
+    return ColumnarOps(type=typ, process=procs, kind=kinds_arr,
+                       kinds=all_kinds, index=index)
+
+
 def columnar_to_ops(cols: ColumnarOps, row: int) -> List[Op]:
     """One row as an indexed Op-list history (host-engine routing and
     oracle tests). Invoke values are un-propagated where the semantics
-    require (a read invokes with value None, observes on completion)."""
+    require (a read invokes with value None, observes on completion).
+    Op indices are the row's line positions, or the original-history op
+    indices when the batch was converted (``cols.index``)."""
     out: List[Op] = []
     pending = {}
     for j in range(cols.n_lines):
@@ -94,6 +288,6 @@ def columnar_to_ops(cols: ColumnarOps, row: int) -> List[Op]:
         else:
             f, v = pending.pop(p)
             op = info_op(p, f, None if f == "read" else v, error="timeout")
-        op.index = j
+        op.index = cols.op_index(row, j)
         out.append(op)
     return out

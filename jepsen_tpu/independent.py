@@ -239,16 +239,21 @@ class BatchLinearizableChecker(Checker):
     """TPU-batched independent linearizability: strains the history into
     per-key subhistories and decides ALL keys in one device dispatch per
     cost bucket — the reference's per-key pmap (independent.clj:263-280)
-    becomes the batch axis of the frontier kernel."""
+    becomes the batch axis of the frontier kernel. Subhistories ride the
+    columnar fast path (one fused conversion walk + vectorized encode,
+    ops.linearize.check_batch_columnar); ``columnar=False`` keeps the
+    per-history encoder."""
 
-    def __init__(self, **kw):
+    def __init__(self, columnar: bool = True, **kw):
+        self.columnar = columnar
         self.kw = kw
 
     def check(self, test, model, history, opts=None) -> dict:
-        from .ops.linearize import check_batch_tpu
+        from .ops.linearize import check_batch_columnar, check_batch_tpu
         ks = history_keys(history)
         subs = [subhistory(k, history) for k in ks]
-        rs = check_batch_tpu(model, subs, **self.kw)
+        check = check_batch_columnar if self.columnar else check_batch_tpu
+        rs = check(model, subs, **self.kw)
         results = dict(zip(ks, rs))
         failures = [k for k, r in results.items()
                     if r.get("valid") is not True]

@@ -63,6 +63,60 @@ def build(force: bool = False) -> Path:
     return _LIB
 
 
+# ---------------------------------------------------------------- ingest
+# The Op-list -> columnar ingest walk is a true CPython extension (it
+# must read Python Op objects), built with the same content-hash
+# staleness + rebuild-on-load-failure discipline as the ctypes lib.
+_INGEST_SRC = _DIR / "ingest.cpp"
+_INGEST_LIB = _DIR / "_jt_ingest.so"
+_INGEST_STAMP = _DIR / "._jt_ingest.srchash"
+_ingest_mod = None
+_ingest_failed = False
+
+
+def build_ingest(force: bool = False) -> Path:
+    import hashlib
+    import sysconfig
+    h = hashlib.sha256(_INGEST_SRC.read_bytes()).hexdigest()
+    if force or not _INGEST_LIB.exists() or not _INGEST_STAMP.exists() or \
+            _INGEST_STAMP.read_text().strip() != h:
+        inc = sysconfig.get_paths()["include"]
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               f"-I{inc}", "-o", str(_INGEST_LIB), str(_INGEST_SRC)]
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(f"ingest build failed:\n{r.stderr}")
+        _INGEST_STAMP.write_text(h + "\n")
+    return _INGEST_LIB
+
+
+def _import_ingest():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("_jt_ingest",
+                                                  _INGEST_LIB)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def ingest():
+    """The native ingest extension, or None when it can't build/load
+    (callers fall back to the pure-Python walk)."""
+    global _ingest_mod, _ingest_failed
+    with _lock:
+        if _ingest_mod is None and not _ingest_failed:
+            try:
+                build_ingest()
+                _ingest_mod = _import_ingest()
+            except Exception:
+                try:
+                    build_ingest(force=True)
+                    _ingest_mod = _import_ingest()
+                except Exception:
+                    _ingest_failed = True
+    return _ingest_mod
+
+
 def _load() -> ctypes.CDLL:
     build()
     try:

@@ -107,7 +107,7 @@ def dropped_invocations(space: StateSpace, prepared: Sequence[Op],
         completion = completion_types(prepared)
     return {pos for pos, o in enumerate(prepared)
             if o.type == INVOKE
-            and space.kind_index[op_kind(o)] in identity
+            and space.kind_index.get(op_kind(o)) in identity
             and completion.get(pos) != OK}
 
 

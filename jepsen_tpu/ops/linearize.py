@@ -276,25 +276,34 @@ def decode_frontier(frontier: np.ndarray, space, slot_to_op: Dict[int, int],
     return configs[:n]
 
 
+def _decode_result(space, ops: List[Op], valid: bool, ev: int,
+                   op_index: int, frontier_row) -> dict:
+    """Host-shaped result dict from a kernel verdict: {"valid"} plus, on
+    failure, the impossible op and a decoded config sample — one decoder
+    for both device paths so counterexample discipline can't drift."""
+    if valid:
+        out = {"valid": True}
+        if space is not None:
+            table = slot_ops_at_event(space, ops, None)
+            out["configs"] = decode_frontier(frontier_row, space, table)
+        return out
+    op = next((o for o in ops if o.index == op_index), None)
+    out = {"valid": False,
+           "op": op.to_dict() if op is not None else {"index": op_index}}
+    if space is not None:
+        table = slot_ops_at_event(space, ops, ev)
+        out["configs"] = decode_frontier(frontier_row, space, table)
+    return out
+
+
 def _result_for(row: int, batch: EncodedBatch, valid: np.ndarray,
                 bad: np.ndarray, frontier: np.ndarray, model: Model,
                 prepared: List[Op]) -> dict:
     space = batch.spaces[row] if batch.spaces else None
-    if bool(valid[row]):
-        out = {"valid": True}
-        if space is not None:
-            table = slot_ops_at_event(space, prepared, None)
-            out["configs"] = decode_frontier(frontier[row], space, table)
-        return out
     ev = int(bad[row])
-    op_index = int(batch.ev_opidx[row, ev])
-    op = next((o for o in prepared if o.index == op_index), None)
-    out = {"valid": False,
-           "op": op.to_dict() if op is not None else {"index": op_index}}
-    if space is not None:
-        table = slot_ops_at_event(space, prepared, ev)
-        out["configs"] = decode_frontier(frontier[row], space, table)
-    return out
+    op_index = int(batch.ev_opidx[row, ev]) if not bool(valid[row]) else -1
+    return _decode_result(space, prepared, bool(valid[row]), ev, op_index,
+                          frontier[row])
 
 
 def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
@@ -356,13 +365,22 @@ def check_one_tpu(model: Model, history: List[Op], **kw) -> dict:
 
 
 def check_columnar(model: Model, cols, *, max_slots: int = 16,
-                   host_fallback=None):
+                   host_fallback=None, details: bool = False,
+                   min_device_batch: int = 1):
     """Device-check a ColumnarOps batch end-to-end at tensor speed.
 
-    Returns (valid [B] bool, bad [B] int32) — ``bad`` is the line index
-    of the first impossible completion (INT32_MAX when valid). Rows the
-    encoder cannot bound are converted to Op lists and routed to
-    ``host_fallback`` (default: the exact host engine).
+    Returns (valid [B] bool, bad [B] int32) — ``bad`` is the op index of
+    the first impossible completion (the original-history index for
+    converted batches, else the line position; INT32_MAX when valid).
+    Rows the encoder cannot bound are converted to Op lists and routed
+    to ``host_fallback`` (default: the exact host engine); cost-class
+    buckets smaller than ``min_device_batch`` go to the native CPU
+    engine (the info-heavy tail isn't worth an XLA compile).
+
+    With ``details=True`` the return is a list of per-row result dicts
+    matching the host engine's shape — {"valid", "op", "configs"} with
+    the reference's truncate-to-10 config-sample discipline
+    (checker.clj:104-107) — decoded from the latched device frontiers.
     """
     from ..checkers.linearizable import wgl_check
     from ..history.columnar import columnar_to_ops
@@ -373,16 +391,81 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
     buckets, failures = encode_columnar(space, cols, max_slots=max_slots)
     valid = np.ones(cols.batch, bool)
     bad = np.full(cols.batch, INT32_MAX, np.int32)
+    results: List[Optional[dict]] = [None] * cols.batch if details else None
+    failures = list(failures)
+    if min_device_batch > 1:
+        small = [b for b in buckets if 0 < b.batch < min_device_batch]
+        buckets = [b for b in buckets if b.batch >= min_device_batch]
+        try:
+            from ..native import check_batch_native
+        except Exception:
+            check_batch_native = None
+        for b in small:
+            if check_batch_native is not None:
+                rs = check_batch_native(
+                    model, [columnar_to_ops(cols, i) for i in b.indices])
+                for i, r in zip(b.indices, rs):
+                    valid[i] = r["valid"] is True
+                    if r["valid"] is False:
+                        bad[i] = r["op"].get("index", -1)
+                    if details:
+                        results[i] = r
+            else:
+                failures.extend((i, "small bucket") for i in b.indices)
     for batch in buckets:
-        v, b, _ = run_encoded_batch(batch)
+        v, b, front = run_encoded_batch(batch, return_frontier=details)
         idx = np.asarray(batch.indices)
         valid[idx] = v
-        rows = idx[~v]
-        bad[rows] = batch.ev_opidx[np.nonzero(~v)[0], b[~v]]
+        bad_rows = idx[~v]
+        bad_lines = batch.ev_opidx[np.nonzero(~v)[0], b[~v]]
+        bad[bad_rows] = (cols.index[bad_rows, bad_lines]
+                         if cols.index is not None else bad_lines)
+        if details:
+            from ..history.core import complete
+            for bi, row in enumerate(batch.indices):
+                # Propagate observations back onto invokes so the replay
+                # walk sees the same op kinds the encoder did.
+                ops = complete(columnar_to_ops(cols, row))
+                results[row] = _decode_result(
+                    space, ops, bool(v[bi]), int(b[bi]),
+                    int(bad[row]) if not bool(v[bi]) else -1, front[bi])
     host_fallback = host_fallback or wgl_check
-    for row, _ in failures:
+    for row, reason in failures:
         r = host_fallback(model, columnar_to_ops(cols, row))
         valid[row] = r["valid"] is True
         if r["valid"] is False:
             bad[row] = r["op"].get("index", -1)
+        if details:
+            r.setdefault("fallback", reason)
+            results[row] = r
+    if details:
+        return results
     return valid, bad
+
+
+def check_batch_columnar(model: Model, histories: Sequence[List[Op]], *,
+                         max_slots: int = 16, max_states: int = 64,
+                         host_fallback=None,
+                         min_device_batch: int = 1) -> List[dict]:
+    """Check recorded Op-list histories through the columnar fast path:
+    one fused conversion walk (history.columnar.ops_to_columnar), one
+    vectorized encode, one device dispatch per cost bucket. Falls back
+    to the per-history path (``check_batch_tpu``) when the shared
+    vocabulary's state space explodes. Per-history result dicts."""
+    from ..history.columnar import ops_to_columnar
+    from .statespace import StateSpaceExplosion
+
+    if not histories:
+        return []
+    try:
+        cols = ops_to_columnar(model, histories,
+                               max_states=min(max_states,
+                                              MAX_PACKED_STATES))
+    except StateSpaceExplosion:
+        return check_batch_tpu(model, histories, max_states=max_states,
+                               max_slots=max_slots,
+                               host_fallback=host_fallback,
+                               min_device_batch=min_device_batch)
+    return check_columnar(model, cols, max_slots=max_slots, details=True,
+                          host_fallback=host_fallback,
+                          min_device_batch=min_device_batch)

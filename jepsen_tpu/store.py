@@ -187,6 +187,51 @@ class Store:
                 out.append(loaded["history"])
         return out
 
+    def recheck(self, test_name: str, model,
+                timestamps: Optional[Sequence[str]] = None, *,
+                independent: bool = False) -> dict:
+        """Re-analyze every stored history of a test on device in one
+        batched dispatch — the replay seam (store.clj:165-171) riding
+        the columnar fast path (ops.linearize.check_batch_columnar).
+
+        ``independent=True`` strains each stored history into per-key
+        subhistories first (KV-valued workloads) and pools ALL
+        (run, key) units into the one batch. Returns
+        {"valid", "runs": {ts: {"valid", "results"}}}.
+        """
+        from .checkers.core import merge_valid
+        from .independent import history_keys, subhistory
+        from .ops.linearize import check_batch_columnar
+
+        ts = (list(timestamps) if timestamps is not None
+              else self.tests().get(test_name, []))
+        units, labels = [], []
+        for t in ts:
+            loaded = self.load(test_name, t)
+            h = loaded.get("history")
+            if h is None:
+                continue
+            if independent:
+                for k in history_keys(h):
+                    units.append(subhistory(k, h))
+                    labels.append((t, k))
+            else:
+                units.append(h)
+                labels.append((t, None))
+        rs = check_batch_columnar(model, units)
+        runs: Dict[str, dict] = {}
+        for (t, k), r in zip(labels, rs):
+            run = runs.setdefault(t, {"results": {}})
+            run["results"][k if k is not None else "history"] = r
+        for run in runs.values():
+            run["valid"] = merge_valid(
+                r["valid"] for r in run["results"].values())
+        return {
+            "valid": merge_valid(run["valid"] for run in runs.values())
+            if runs else True,
+            "runs": runs,
+        }
+
     def delete(self, test_name: str, ts: Optional[str] = None) -> None:
         """Remove a run, or all of a test's runs (store.clj:328-345)."""
         target = (self.base / test_name / ts) if ts else \

@@ -1,0 +1,254 @@
+"""Recorded-history ingest onto the columnar fast path.
+
+ops_to_columnar must apply the full prepared-history contract (failure
+drop, value propagation, identity drop) so that converted batches are
+indistinguishable from synthesized ones to the encoder, and verdicts +
+counterexamples match the exact host engine on the original histories.
+"""
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.linearizable import wgl_check
+from jepsen_tpu.history.columnar import (C_INFO, C_INVOKE, C_OK, PAD,
+                                         columnar_to_ops, ops_to_columnar)
+from jepsen_tpu.history.core import index as index_history
+from jepsen_tpu.history.ops import (fail_op, info_op, invoke_op, ok_op)
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.ops.linearize import (INT32_MAX, check_batch_columnar,
+                                      check_columnar)
+from jepsen_tpu.workloads.synth import synth_cas_batch
+
+
+@pytest.fixture(scope="module")
+def hists():
+    return synth_cas_batch(40, seed0=100, n_procs=4, n_ops=25, n_values=3,
+                           corrupt=0.3, p_info=0.1)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return cas_register()
+
+
+def test_contract_failure_drop(model):
+    h = index_history([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "cas", [0, 2]), fail_op(1, "cas", [0, 2]),
+        invoke_op(0, "read", None), ok_op(0, "read", 1),
+    ])
+    cols = ops_to_columnar(model, [h])
+    # the failed cas contributes no lines at all
+    kinds = [cols.kinds[int(k)] for k in cols.kind[0] if k >= 0]
+    assert ("cas", (0, 2)) not in kinds
+    assert int((cols.type[0] != PAD).sum()) == 4
+
+
+def test_contract_value_propagation(model):
+    h = index_history([
+        invoke_op(0, "write", 2), ok_op(0, "write", 2),
+        invoke_op(1, "read", None), ok_op(1, "read", 2),
+    ])
+    cols = ops_to_columnar(model, [h])
+    inv_kinds = {cols.kinds[int(cols.kind[0, j])]
+                 for j in range(cols.n_lines)
+                 if cols.type[0, j] == C_INVOKE}
+    # the read invoke carries the observed value, not None
+    assert ("read", 2) in inv_kinds
+    assert ("read", None) not in inv_kinds
+
+
+def test_contract_identity_drop(model):
+    h = index_history([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "read", None), info_op(1, "read", None,
+                                            error="timeout"),
+        invoke_op(2, "read", None),   # crashed, never completes
+    ])
+    cols = ops_to_columnar(model, [h])
+    # both unconstrained reads (and the info line) are dropped
+    assert int((cols.type[0] != PAD).sum()) == 2
+    assert not (cols.type[0] == C_INFO).any()
+
+
+def test_contract_index_mapping(model):
+    h = index_history([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "cas", [0, 2]), fail_op(1, "cas", [0, 2]),
+        invoke_op(0, "read", None), ok_op(0, "read", 1),
+    ])
+    cols = ops_to_columnar(model, [h])
+    live = cols.index[0][cols.type[0] != PAD].tolist()
+    assert live == [0, 1, 4, 5]
+
+
+def test_verdict_parity_converted(model, hists):
+    cols = ops_to_columnar(model, hists)
+    valid, bad = check_columnar(model, cols)
+    host = [wgl_check(model, h) for h in hists]
+    assert valid.tolist() == [r["valid"] is True for r in host]
+    assert {True, False} == set(valid.tolist())
+    for i, r in enumerate(host):
+        if r["valid"] is False:
+            # bad maps back to the ORIGINAL op index
+            assert int(bad[i]) == r["op"]["index"], i
+
+
+def test_details_counterexample_parity(model, hists):
+    rs = check_batch_columnar(model, hists)
+    for i, (r, h) in enumerate(zip(rs, hists)):
+        ref = wgl_check(model, h)
+        assert (r["valid"] is True) == (ref["valid"] is True), i
+        if ref["valid"] is False:
+            assert r["op"]["index"] == ref["op"]["index"], i
+            assert r["configs"] == ref["configs"], i
+
+
+def test_process_retirement_and_large_ids(model):
+    """Recorded histories carry retired process ids (p + concurrency on
+    indeterminate ops, runtime semantics); conversion densifies them."""
+    h = index_history([
+        invoke_op(3, "write", 1), info_op(3, "write", 1, error="timeout"),
+        invoke_op(103, "write", 2), ok_op(103, "write", 2),
+        invoke_op(203, "read", None), ok_op(203, "read", 2),
+    ])
+    cols = ops_to_columnar(model, [h])
+    assert int(cols.process.max()) <= 2
+    valid, _ = check_columnar(model, cols)
+    assert valid.tolist() == [wgl_check(model, h)["valid"] is True]
+
+
+# ---------------------------------------------------------------- INFO
+# Adversarial orderings around indeterminate ops: the columnar walk pins
+# the slot at invoke and relies on later invokes overwriting slot_of.
+
+def _parity(model, h):
+    h = index_history(h)
+    cols = ops_to_columnar(model, [h])
+    valid, _ = check_columnar(model, cols)
+    ref = wgl_check(model, h)["valid"]
+    assert valid.tolist() == [ref is True], (valid, ref)
+
+
+def test_info_then_same_process_reinvokes(model):
+    # jepsen retires processes after info, but nothing in the history
+    # format forbids reuse; the pinned slot must stay pinned while the
+    # new op gets a fresh slot.
+    _parity(model, [
+        invoke_op(0, "write", 1), info_op(0, "write", 1, error="timeout"),
+        invoke_op(0, "write", 2), ok_op(0, "write", 2),
+        invoke_op(1, "read", None), ok_op(1, "read", 2),
+    ])
+
+
+def test_info_pins_slot_to_end(model):
+    # the pinned write(2) may linearize after the read observes 1 —
+    # valid; and a read observing 2 (applied info op) is also valid.
+    _parity(model, [
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "write", 2), info_op(1, "write", 2, error="timeout"),
+        invoke_op(2, "read", None), ok_op(2, "read", 1),
+        invoke_op(2, "read", None), ok_op(2, "read", 2),
+    ])
+
+
+def test_info_invalid_detected(model):
+    # pinned write(2); read observes 3 which nothing ever wrote: invalid.
+    _parity(model, [
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "write", 2), info_op(1, "write", 2, error="timeout"),
+        invoke_op(2, "read", None), ok_op(2, "read", 3),
+    ])
+
+
+def test_info_value_not_an_observation(model):
+    """An info completion's value must NOT propagate onto the invoke
+    (history.core.complete propagates ok only): a timed-out read stays
+    unconstrained and is identity-dropped, matching the host engine's
+    configs exactly."""
+    h = index_history([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "read", None), info_op(1, "read", 1),
+        invoke_op(2, "read", None), ok_op(2, "read", 1),
+    ])
+    for native in (True, False):
+        cols = ops_to_columnar(model, [h], native=native)
+        # the info read is identity-dropped, not pinned as ("read", 1)
+        assert int((cols.type[0] != PAD).sum()) == 4, native
+    r = check_batch_columnar(model, [h])[0]
+    ref = wgl_check(model, h)
+    assert r["valid"] is ref["valid"] is True
+    assert r["configs"] == ref["configs"]
+
+
+def test_interleaved_info_storm(model):
+    # many concurrent indeterminate writes with interleaved reuse; the
+    # window grows but parity must hold.
+    h = []
+    for p in range(4):
+        h.append(invoke_op(p, "write", p))
+    for p in range(4):
+        h.append(info_op(p, "write", p, error="timeout"))
+    for p in range(4):
+        h.append(invoke_op(p, "cas", [p, (p + 1) % 4]))
+    h.append(info_op(0, "cas", [0, 1], error="timeout"))
+    h.append(ok_op(1, "cas", [1, 2]))
+    h.append(invoke_op(5, "read", None))
+    h.append(ok_op(5, "read", 2))
+    _parity(model, h)
+
+
+def test_empty_and_noop_histories(model):
+    cols = ops_to_columnar(model, [[], index_history(
+        [invoke_op(0, "read", None), info_op(0, "read", None)])])
+    valid, bad = check_columnar(model, cols)
+    assert valid.tolist() == [True, True]
+    assert (bad == INT32_MAX).all()
+    assert check_batch_columnar(model, []) == []
+
+
+def test_roundtrip_through_store(tmp_path, model, hists):
+    """Stored → reloaded → converted histories keep verdict parity: the
+    jsonl codec's list/tuple normalization must not change kinds."""
+    from jepsen_tpu.history.codec import read_jsonl, write_jsonl
+    p = tmp_path / "h.jsonl"
+    write_jsonl(p, hists[0])
+    back = read_jsonl(p)
+    cols = ops_to_columnar(model, [back])
+    valid, _ = check_columnar(model, cols)
+    assert valid.tolist() == [wgl_check(model, hists[0])["valid"] is True]
+
+
+def test_store_recheck_batched(tmp_path, model):
+    from jepsen_tpu.store import Store
+    store = Store(tmp_path / "store")
+    hs = synth_cas_batch(6, seed0=7, n_procs=3, n_ops=15, n_values=3,
+                         corrupt=0.5)
+    for i, h in enumerate(hs):
+        handle = store.create("recheck-demo", ts=f"t{i}")
+        handle.save_history(h)
+    out = store.recheck("recheck-demo", model)
+    assert set(out["runs"]) == {f"t{i}" for i in range(6)}
+    for i, h in enumerate(hs):
+        ref = wgl_check(model, h)["valid"]
+        got = out["runs"][f"t{i}"]["results"]["history"]["valid"]
+        assert got == ref, i
+    assert out["valid"] == all(
+        wgl_check(model, h)["valid"] is True for h in hs)
+
+
+def test_store_recheck_independent(tmp_path, model):
+    from jepsen_tpu.independent import KV
+    from jepsen_tpu.store import Store
+    store = Store(tmp_path / "store")
+    h = index_history([
+        invoke_op(0, "write", KV("k1", 1)), ok_op(0, "write", KV("k1", 1)),
+        invoke_op(1, "read", KV("k2", None)), ok_op(1, "read", KV("k2", 9)),
+        invoke_op(0, "read", KV("k1", None)), ok_op(0, "read", KV("k1", 1)),
+    ])
+    handle = store.create("recheck-kv", ts="t0")
+    handle.save_history(h)
+    out = store.recheck("recheck-kv", model, independent=True)
+    run = out["runs"]["t0"]
+    assert run["results"]["k1"]["valid"] is True
+    assert run["results"]["k2"]["valid"] is False   # read 9, never written
+    assert out["valid"] is False
