@@ -221,21 +221,134 @@ def batch_kernel(V: int, W: int):
 # HBM even for info-heavy windows (W=16 → 0.5 MB/history).
 MAX_FRONTIER_ELEMENTS = 1 << 26
 
+# Pending-window width the single-device kernel accepts; wider windows
+# split their mask axis over the mesh's "frontier" devices (the
+# sequence-parallel path, jepsen_tpu.parallel.frontier) — the TPU answer
+# to the reference handing Knossos a 32 GB JVM heap (project.clj:22).
+DATA_MAX_SLOTS = 16
+
+# Don't pay an SPMD compile to spread a handful of rows: batches below
+# this many rows per device stay on one device.
+MIN_ROWS_PER_DEVICE = 8
+
+# Dispatch telemetry: (path, V, W, B) per device call — "data1" (single
+# device), "dataN" (batch sharded over the mesh), "frontier" (mask axis
+# sharded). Tests and the multichip dryrun assert the route taken;
+# bounded so long-lived checker processes don't grow it forever.
+from collections import deque
+DISPATCH_LOG: "deque" = deque(maxlen=256)
+
+_PROD_MESHES: Dict[Tuple[int, int], object] = {}
+_SHARDED_KERNELS: Dict[Tuple, object] = {}
+
+
+def device_frontier_capacity() -> int:
+    """Extra pending-window bits the attached devices can host beyond
+    DATA_MAX_SLOTS: log2 of the largest power-of-two device count. The
+    encoder may window up to DATA_MAX_SLOTS + capacity slots before a
+    history must fall back to the host engine."""
+    import jax
+    try:
+        nd = len(jax.devices())
+    except Exception:
+        return 0
+    return max(nd.bit_length() - 1, 0)
+
+
+def production_mesh(n_frontier: int = 1):
+    """The process-wide ("data", "frontier") mesh for production
+    dispatch, or None when the devices can't host the frontier axis (or
+    there is only one device and no frontier need)."""
+    import jax
+    nd = len(jax.devices())
+    if n_frontier > nd or (nd < 2 and n_frontier == 1):
+        return None
+    key = (nd, n_frontier)
+    mesh = _PROD_MESHES.get(key)
+    if mesh is None:
+        from ..parallel.mesh import checker_mesh
+        mesh = checker_mesh(n_data=nd // n_frontier,
+                            n_frontier=n_frontier)
+        _PROD_MESHES[key] = mesh
+    return mesh
+
+
+def _sharded_kernel(kind: str, V: int, W: int, mesh):
+    key = (kind, V, W, id(mesh))
+    k = _SHARDED_KERNELS.get(key)
+    if k is None:
+        if kind == "frontier":
+            from ..parallel.frontier import frontier_sharded_kernel
+            k = frontier_sharded_kernel(V, W, mesh)
+        else:
+            from ..parallel.mesh import data_sharded_kernel
+            k = data_sharded_kernel(V, W, mesh)
+        _SHARDED_KERNELS[key] = k
+    return k
+
+
+def _pad_rows(batch: EncodedBatch, bp: int) -> Tuple[np.ndarray, ...]:
+    """Pad a batch's arrays to ``bp`` rows with inert histories (all
+    events PAD, empty slot tables, all-invalid targets): they scan to
+    valid=True and are sliced off after the device call."""
+    b, n, w = batch.batch, batch.n_events, batch.ev_slots.shape[2]
+    K1, V = batch.target.shape[1], batch.target.shape[2]
+    ev_type = np.zeros((bp, n), np.int32)
+    ev_slot = np.zeros((bp, n), np.int32)
+    ev_slots = np.full((bp, n, w), K1 - 1, np.int32)
+    target = np.full((bp, K1, V), -1, np.int32)
+    ev_type[:b] = batch.ev_type
+    ev_slot[:b] = batch.ev_slot
+    ev_slots[:b] = batch.ev_slots
+    target[:b] = batch.target
+    return ev_type, ev_slot, ev_slots, target
+
+
+def _round_up_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
 
 def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
-    """Device-check an encoded batch. Returns (valid [B] bool, bad [B],
-    frontier) — frontier is [B, words(V), 2^W] uint32 when requested and
-    None otherwise (skipping the device→host transfer, which hot paths
-    that only need verdicts shouldn't pay). Large batches are chunked to
-    bound device memory."""
+    """Device-check an encoded batch; routes each call to the right
+    kernel for the bucket's window and the attached devices:
+
+      * W <= DATA_MAX_SLOTS, small batch or one device — single-device
+        vmapped kernel, chunked to bound memory;
+      * W <= DATA_MAX_SLOTS, large batch on a multi-device mesh — batch
+        axis sharded over "data" (jepsen_tpu.parallel.mesh);
+      * W > DATA_MAX_SLOTS — mask axis split over 2^(W - 16) "frontier"
+        devices (jepsen_tpu.parallel.frontier). Raises
+        WindowOverflow when the devices can't host the axis — callers
+        route those rows to a host engine.
+
+    Returns (valid [B] bool, bad [B], frontier) — frontier is
+    [B, words(V), 2^W] uint32 when requested and None otherwise
+    (skipping the device→host transfer, which verdict-only hot paths
+    shouldn't pay).
+    """
     if batch.batch == 0:
         z = np.zeros((0,), bool)
         return (z, np.zeros((0,), np.int32),
                 np.zeros((0, 1, 1 << batch.W), np.uint32)
                 if return_frontier else None)
+
+    if batch.W > DATA_MAX_SLOTS:
+        D = 1 << (batch.W - DATA_MAX_SLOTS)
+        mesh = production_mesh(D)
+        if mesh is None:
+            raise WindowOverflow(
+                f"window W={batch.W} needs {D} frontier devices")
+        return _run_sharded("frontier", batch, mesh, return_frontier)
+
+    mesh = production_mesh(1)
+    if mesh is not None and \
+            batch.batch >= mesh.shape["data"] * MIN_ROWS_PER_DEVICE:
+        return _run_sharded("dataN", batch, mesh, return_frontier)
+
     kern = batch_kernel(batch.V, batch.W)
     per_hist = n_state_words(batch.V) << batch.W
     chunk = max(1, MAX_FRONTIER_ELEMENTS // per_hist)
+    DISPATCH_LOG.append(("data1", batch.V, batch.W, batch.batch))
     valids, bads, fronts = [], [], []
     for lo in range(0, batch.batch, chunk):
         hi = min(lo + chunk, batch.batch)
@@ -246,6 +359,45 @@ def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
         bads.append(np.asarray(bad))
         if return_frontier:
             fronts.append(np.asarray(front))
+    return (np.concatenate(valids), np.concatenate(bads),
+            np.concatenate(fronts) if return_frontier else None)
+
+
+class WindowOverflow(Exception):
+    """A cost bucket's pending window exceeds what the attached devices
+    can host; the rows belong on a host/native engine."""
+
+
+def _run_sharded(kind: str, batch: EncodedBatch, mesh,
+                 return_frontier: bool):
+    """Dispatch one bucket through a sharded kernel, padding the batch
+    to the data-axis multiple and chunking to bound per-device memory."""
+    n_data = mesh.shape["data"]
+    kern = _sharded_kernel("frontier" if kind == "frontier" else "data",
+                           batch.V, batch.W, mesh)
+    # Per-device budget: (chunk / n_data) rows x (per_hist / n_frontier)
+    # words <= MAX_FRONTIER_ELEMENTS  =>  chunk <= MAX * size / per_hist.
+    per_hist = n_state_words(batch.V) << batch.W
+    chunk = _round_up_to(
+        max(n_data, MAX_FRONTIER_ELEMENTS * mesh.size // max(per_hist, 1)),
+        n_data)
+    DISPATCH_LOG.append((kind, batch.V, batch.W, batch.batch))
+    valids, bads, fronts = [], [], []
+    for lo in range(0, batch.batch, chunk):
+        hi = min(lo + chunk, batch.batch)
+        nb = hi - lo
+        bp = _round_up_to(nb, n_data)
+        sub = EncodedBatch(
+            ev_type=batch.ev_type[lo:hi], ev_slot=batch.ev_slot[lo:hi],
+            ev_slots=batch.ev_slots[lo:hi], ev_opidx=batch.ev_opidx[lo:hi],
+            target=batch.target[lo:hi], V=batch.V, W=batch.W,
+            indices=[], failures=[])
+        ev_type, ev_slot, ev_slots, target = _pad_rows(sub, bp)
+        valid, bad, front = kern(ev_type, ev_slot, ev_slots, target)
+        valids.append(np.asarray(valid)[:nb])
+        bads.append(np.asarray(bad)[:nb])
+        if return_frontier:
+            fronts.append(np.asarray(front)[:nb])
     return (np.concatenate(valids), np.concatenate(bads),
             np.concatenate(fronts) if return_frontier else None)
 
@@ -330,9 +482,13 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
         if any(op.index is None for op in h):
             index_history(h)
     prepared = [prepare_history(h) for h in histories]
+    # Windows beyond the single-device kernel are encodable when the
+    # mesh can shard their mask axis (the frontier path).
+    eff_slots = max_slots + (device_frontier_capacity()
+                             if max_slots >= DATA_MAX_SLOTS else 0)
     buckets = bucket_encode(model, prepared,
                             max_states=min(max_states, MAX_PACKED_STATES),
-                            max_slots=max_slots)
+                            max_slots=eff_slots)
 
     results: List[Optional[dict]] = [None] * len(histories)
     for batch in buckets:
@@ -347,11 +503,18 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
             for i, r in zip(batch.indices, rs):
                 results[i] = r
         else:
-            valid, bad, front = run_encoded_batch(batch,
-                                                  return_frontier=True)
-            for row, i in enumerate(batch.indices):
-                results[i] = _result_for(row, batch, valid, bad, front,
-                                         model, prepared[i])
+            try:
+                valid, bad, front = run_encoded_batch(batch,
+                                                      return_frontier=True)
+            except WindowOverflow as e:
+                for i in batch.indices:
+                    r = host_fallback(model, histories[i])
+                    r.setdefault("fallback", str(e))
+                    results[i] = r
+            else:
+                for row, i in enumerate(batch.indices):
+                    results[i] = _result_for(row, batch, valid, bad, front,
+                                             model, prepared[i])
         for i, reason in batch.failures:
             r = host_fallback(model, histories[i])
             r.setdefault("fallback", reason)
@@ -388,7 +551,9 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
     from .statespace import enumerate_statespace
 
     space = enumerate_statespace(model, cols.kinds, MAX_PACKED_STATES)
-    buckets, failures = encode_columnar(space, cols, max_slots=max_slots)
+    eff_slots = max_slots + (device_frontier_capacity()
+                             if max_slots >= DATA_MAX_SLOTS else 0)
+    buckets, failures = encode_columnar(space, cols, max_slots=eff_slots)
     valid = np.ones(cols.batch, bool)
     bad = np.full(cols.batch, INT32_MAX, np.int32)
     results: List[Optional[dict]] = [None] * cols.batch if details else None
@@ -401,19 +566,26 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
         except Exception:
             check_batch_native = None
         for b in small:
-            if check_batch_native is not None:
+            try:
+                if check_batch_native is None:
+                    raise RuntimeError("native engine unavailable")
                 rs = check_batch_native(
                     model, [columnar_to_ops(cols, i) for i in b.indices])
-                for i, r in zip(b.indices, rs):
-                    valid[i] = r["valid"] is True
-                    if r["valid"] is False:
-                        bad[i] = r["op"].get("index", -1)
-                    if details:
-                        results[i] = r
-            else:
+            except Exception:
                 failures.extend((i, "small bucket") for i in b.indices)
+                continue
+            for i, r in zip(b.indices, rs):
+                valid[i] = r["valid"] is True
+                if r["valid"] is False:
+                    bad[i] = r["op"].get("index", -1)
+                if details:
+                    results[i] = r
     for batch in buckets:
-        v, b, front = run_encoded_batch(batch, return_frontier=details)
+        try:
+            v, b, front = run_encoded_batch(batch, return_frontier=details)
+        except WindowOverflow as e:
+            failures.extend((i, str(e)) for i in batch.indices)
+            continue
         idx = np.asarray(batch.indices)
         valid[idx] = v
         bad_rows = idx[~v]

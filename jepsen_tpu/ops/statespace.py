@@ -98,13 +98,32 @@ def _rep_op(kind: Tuple) -> Op:
     return Op(process=0, type=INVOKE, f=f, value=v)
 
 
+# (model, kinds, max_states) -> StateSpace. One batch of histories pays
+# the BFS once no matter how many pipeline stages re-derive the space
+# (ingest, encode, check). StateSpaces are immutable once built.
+_SPACE_MEMO: Dict[Tuple, StateSpace] = {}
+
+
 def enumerate_statespace(model: Model, kinds: List[Tuple],
                          max_states: int) -> StateSpace:
     """BFS the reachable state space of ``model`` under ``kinds``.
 
     Raises StateSpaceExplosion past ``max_states``. Models must be
-    hashable/eq-comparable (all jepsen_tpu.models are).
+    hashable/eq-comparable (all jepsen_tpu.models are). Memoized.
     """
+    key = (model, tuple(kinds), max_states)
+    hit = _SPACE_MEMO.get(key)
+    if hit is not None:
+        return hit
+    if len(_SPACE_MEMO) > 256:
+        _SPACE_MEMO.clear()
+    space = _enumerate_statespace(model, kinds, max_states)
+    _SPACE_MEMO[key] = space
+    return space
+
+
+def _enumerate_statespace(model: Model, kinds: List[Tuple],
+                          max_states: int) -> StateSpace:
     kind_ops = [(k, _rep_op(k)) for k in kinds]
     states: List[Model] = [model]
     index: Dict[Model, int] = {model: 0}

@@ -128,16 +128,18 @@ def make_frontier_kernel(V: int, W: int, D: int,
         rows = pack_rows(target, V)
 
         def step(carry, ev):
-            F, valid, bad = carry
+            F, Fbad, valid, bad = carry
             typ, slot, slots_row, idx = ev
             is_ok = typ == EV_OK
             is_close = typ == EV_CLOSE
             Fc = closure(F, slots_row, rows)
             F_ok = complete(Fc, slot)
             empty = is_ok & ~_pbool((_union(F_ok) != 0).any())
+            first = empty & valid
             F2 = tuple(jnp.where(is_ok, a, jnp.where(is_close, c, b))
                        for a, c, b in zip(F_ok, Fc, F))
-            return (F2, valid & ~empty,
+            Fb2 = tuple(jnp.where(first, c, b) for c, b in zip(Fc, Fbad))
+            return (F2, Fb2, valid & ~empty,
                     jnp.minimum(bad, jnp.where(empty, idx, INT32_MAX))), None
 
         N = ev_type.shape[0]
@@ -149,13 +151,22 @@ def make_frontier_kernel(V: int, W: int, D: int,
         # The scan consumes data-sharded events, so its carry is varying
         # over "data" — widen the initial carry's type to match.
         extra = tuple(a for a in sync_axes if a != "frontier")
-        carry = (tuple(lax.pcast(f, extra, to="varying") for f in F0),
-                 lax.pcast(jnp.bool_(True), extra, to="varying"),
-                 lax.pcast(jnp.int32(INT32_MAX), extra, to="varying"))
-        (F, valid, bad), _ = lax.scan(
+        pcast = lambda x: lax.pcast(x, extra, to="varying")  # noqa: E731
+        # Fbad is written from Fc (varying over EVERY mesh axis — F0
+        # derives from axis_index), so its initial value must be too.
+        carry = (tuple(pcast(f) for f in F0),
+                 tuple(lax.pcast(f, tuple(sync_axes), to="varying")
+                       for f in Fz),
+                 pcast(jnp.bool_(True)), pcast(jnp.int32(INT32_MAX)))
+        (F, Fbad, valid, bad), _ = lax.scan(
             step, carry, (ev_type, ev_slot, ev_slots,
                           jnp.arange(N, dtype=jnp.int32)))
-        return valid, bad
+        # Local shard of the latched frontier (mask-axis sharded; the
+        # out_spec concatenation restores global mask order because the
+        # top log2D mask bits ARE the frontier axis index).
+        frontier = jnp.stack(
+            [jnp.where(valid, a, b) for a, b in zip(F, Fbad)])
+        return valid, bad, frontier
 
     return check
 
@@ -164,12 +175,15 @@ def frontier_sharded_kernel(V: int, W: int, mesh: Mesh):
     """Batched checker over a ("data", "frontier") mesh: batch rows shard
     over "data", each row's frontier splits over "frontier". Returns
     check(ev_type [B,N], ev_slot [B,N], ev_slots [B,N,W], target)
-    -> (valid [B], bad [B])."""
+    -> (valid [B], bad [B], frontier [B, words(V), 2^W]) — the same
+    contract as the single-device kernel (ops.linearize.make_kernel), so
+    production dispatch and counterexample decoding are path-agnostic."""
     D = mesh.shape["frontier"]
     kern = jax.vmap(make_frontier_kernel(V, W, D), in_axes=(0, 0, 0, 0))
     ev = P("data", None)
     sharded = shard_map(kern, mesh=mesh,
                         in_specs=(ev, ev, P("data", None, None),
                                   P("data", None, None)),
-                        out_specs=(P("data"), P("data")))
+                        out_specs=(P("data"), P("data"),
+                                   P("data", None, "frontier")))
     return jax.jit(sharded)

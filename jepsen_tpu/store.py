@@ -218,6 +218,11 @@ class Store:
             else:
                 units.append(h)
                 labels.append((t, None))
+        if not units:
+            # Nothing loadable is not a pass: distinguish "re-checked
+            # and valid" from "found no stored histories to check".
+            return {"valid": "unknown", "runs": {},
+                    "error": f"no stored histories for {test_name!r}"}
         rs = check_batch_columnar(model, units)
         runs: Dict[str, dict] = {}
         for (t, k), r in zip(labels, rs):

@@ -95,6 +95,23 @@ def synth_cas_batch(n: int, seed0: int = 0, **kw) -> List[List[Op]]:
     return [synth_cas_history(seed0 + i, **kw) for i in range(n)]
 
 
+def synth_wide_window_history(*, width: int = 17, n_values: int = 2,
+                              invalid: bool = False) -> List[Op]:
+    """A history whose pending window is exactly ``width``: width-1
+    crashed writes pin slots forever, then one read completes ok while
+    all of them are pending. The checker must close the frontier over
+    2^(width-1) linearization subsets — the shape that exceeds a single
+    device's window and exercises the frontier-sharded path
+    (jepsen_tpu.parallel.frontier). ``invalid=True`` makes the read
+    observe a value no write could have produced."""
+    h: List[Op] = []
+    for p in range(width - 1):
+        h.append(invoke_op(p, "write", p % n_values))
+    h.append(invoke_op(width - 1, "read", None))
+    h.append(ok_op(width - 1, "read", n_values + 5 if invalid else None))
+    return index(h)
+
+
 def cas_kind_vocabulary(n_values: int):
     """The shared op-kind vocabulary for a CAS-register value domain:
     read(None), read(v), write(v), cas(a, b) — index-aligned with the

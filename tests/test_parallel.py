@@ -42,13 +42,77 @@ def test_frontier_sharded_matches_host(batch16):
     enc, host = batch16
     mesh = checker_mesh(n_data=4, n_frontier=2)
     kern = frontier_sharded_kernel(enc.V, enc.W, mesh)
-    valid, bad = kern(enc.ev_type, enc.ev_slot, enc.ev_slots, enc.target)
+    valid, bad, front = kern(enc.ev_type, enc.ev_slot, enc.ev_slots,
+                             enc.target)
     assert np.array_equal(np.asarray(valid), host)
+    # Latched frontiers must equal the single-device kernel's (the mask
+    # axis re-assembles in global order) so counterexample decoding is
+    # path-agnostic.
+    from jepsen_tpu.ops.linearize import batch_kernel
+    v1, b1, f1 = batch_kernel(enc.V, enc.W)(
+        enc.ev_type, enc.ev_slot, enc.ev_slots, enc.target)
+    assert np.array_equal(np.asarray(front), np.asarray(f1))
+    assert np.array_equal(np.asarray(bad), np.asarray(b1))
 
 
 def test_frontier_4way(batch16):
     enc, host = batch16
     mesh = checker_mesh(n_data=2, n_frontier=4)
     kern = frontier_sharded_kernel(enc.V, enc.W, mesh)
-    valid, _ = kern(enc.ev_type, enc.ev_slot, enc.ev_slots, enc.target)
+    valid, _, _ = kern(enc.ev_type, enc.ev_slot, enc.ev_slots, enc.target)
     assert np.array_equal(np.asarray(valid), host)
+
+
+# ------------------------------------------------------- production route
+
+def test_production_route_data_sharded():
+    """A big ordinary batch through the production entry point rides the
+    data-sharded mesh path, with host parity."""
+    from jepsen_tpu.ops import linearize as lin
+    model = cas_register()
+    hists = synth_cas_batch(80, seed0=31, n_procs=4, n_ops=12, n_values=3,
+                            corrupt=0.4)
+    lin.DISPATCH_LOG.clear()
+    rs = lin.check_batch_tpu(model, hists)
+    assert any(p == "dataN" for p, *_ in lin.DISPATCH_LOG), lin.DISPATCH_LOG
+    host = [wgl_check(model, h)["valid"] for h in hists]
+    assert [r["valid"] for r in rs] == host
+    assert {True, False} == set(host)
+
+
+def test_production_route_frontier_w17():
+    """A W=17 history exceeds the single-device window; the production
+    path decides it on the frontier-sharded mesh (2 devices) instead of
+    falling back to the host, with native-engine parity."""
+    from jepsen_tpu.ops import linearize as lin
+    from jepsen_tpu.workloads.synth import synth_wide_window_history
+    model = cas_register()
+    hs = [synth_wide_window_history(width=17),
+          synth_wide_window_history(width=17, invalid=True)]
+    lin.DISPATCH_LOG.clear()
+    rs = lin.check_batch_tpu(model, hs)
+    log = list(lin.DISPATCH_LOG)
+    assert any(p == "frontier" and w == 17 for p, _, w, _ in log), log
+    assert rs[0]["valid"] is True
+    assert rs[1]["valid"] is False
+    assert "fallback" not in rs[0] and "fallback" not in rs[1]
+    # the invalid row's counterexample points at the impossible read
+    assert rs[1]["op"]["f"] == "read"
+
+
+def test_production_route_frontier_columnar_w18():
+    """Same through the columnar entry at W=18 (4 frontier devices)."""
+    from jepsen_tpu.history.columnar import ops_to_columnar
+    from jepsen_tpu.ops import linearize as lin
+    from jepsen_tpu.workloads.synth import synth_wide_window_history
+    model = cas_register()
+    hs = [synth_wide_window_history(width=18),
+          synth_wide_window_history(width=18, invalid=True)]
+    cols = ops_to_columnar(model, hs)
+    lin.DISPATCH_LOG.clear()
+    valid, bad = lin.check_columnar(model, cols)
+    log = list(lin.DISPATCH_LOG)
+    assert any(p == "frontier" and w == 18 for p, _, w, _ in log), log
+    assert valid.tolist() == [True, False]
+    # bad maps to the original index of the impossible read completion
+    assert int(bad[1]) == hs[1][-1].index
