@@ -37,7 +37,8 @@ def main():
     from jepsen_tpu.history.columnar import columnar_to_ops
     from jepsen_tpu.models.core import cas_register
     from jepsen_tpu.ops.encode import encode_columnar
-    from jepsen_tpu.ops.linearize import run_encoded_batch
+    from jepsen_tpu.ops.linearize import (run_buckets_threaded,
+                                          run_encoded_batch)
     from jepsen_tpu.ops.statespace import enumerate_statespace
     from jepsen_tpu.workloads.synth import synth_cas_columnar
 
@@ -77,15 +78,26 @@ def main():
     cpu_hists = [columnar_to_ops(cols, i) for i in cpu_rows]
 
     def run_all():
-        outs = [run_encoded_batch(b) for b in dev_buckets]
-        if cpu_hists:
+        # Buckets run concurrently from a thread pool (overlapping the
+        # per-dispatch round trips); the CPU tail rides another thread.
+        from concurrent.futures import ThreadPoolExecutor
+
+        def cpu_tail():
+            if not cpu_hists:
+                return 0
             if check_batch_native is not None:
                 rs = check_batch_native(model, cpu_hists)
             else:
                 rs = [wgl_check(model, h) for h in cpu_hists]
-            n_bad = sum(1 for r in rs if r["valid"] is not True)
-        else:
-            n_bad = 0
+            return sum(1 for r in rs if r["valid"] is not True)
+
+        with ThreadPoolExecutor(1) as ex:
+            tail = ex.submit(cpu_tail)
+            by_batch = dict(
+                (id(b), out)
+                for b, out in run_buckets_threaded(dev_buckets))
+            n_bad = tail.result()
+        outs = [by_batch[id(b)] for b in dev_buckets]
         return outs, n_bad
 
     # Warmup / compile.
@@ -116,21 +128,55 @@ def main():
     skip = set(cpu_rows)
     parity_ok = all(dev_valid[r] == host[r] for r in sample if r not in skip)
 
-    # Native-CPU comparison point on a subsample.
+    # Native-CPU comparison point + first-bad-op-index parity vs the
+    # native engine on >= 500 rows (BASELINE.md: counterexample parity,
+    # not just valid?).
     native_rate = None
+    parity_bad_index = None
     if check_batch_native is not None:
-        sub = [columnar_to_ops(cols, r) for r in range(min(64, B))]
+        n_par = min(int(os.environ.get("JT_BENCH_PARITY_ROWS", "500")), B)
+        rows = [r for r in range(0, B, max(1, B // n_par))][:n_par]
+        sub = [columnar_to_ops(cols, r) for r in rows]
         check_batch_native(model, sub[:4])     # warm caches
         t0 = time.time()
-        check_batch_native(model, sub)
+        nrs = check_batch_native(model, sub)
         native_rate = round(len(sub) / (time.time() - t0), 2)
+        dev_bad = np.full(B, -1, np.int64)
+        for b, (v, bd, _) in zip(dev_buckets, outs):
+            iv = np.asarray(b.indices)[~v]
+            dev_bad[iv] = b.ev_opidx[np.nonzero(~v)[0], bd[~v]]
+        parity_bad_index = all(
+            (nr["valid"] is True and r not in skip and dev_valid[r]) or
+            (nr["valid"] is False and not dev_valid[r]
+             and nr["op"]["index"] == dev_bad[r]) or r in skip
+            for r, nr in zip(rows, nrs))
+
+    # Config-sample parity vs the exact host engine on invalid rows.
+    # Smallest windows first: the host oracle's closure cost is 2^W.
+    inv_rows = [i for b, (v, _, _) in sorted(zip(dev_buckets, outs),
+                                             key=lambda t: t[0].W)
+                if b.W <= 7
+                for i in np.asarray(b.indices)[~v].tolist()][:50]
+    parity_configs = None
+    if inv_rows:
+        from jepsen_tpu.ops.linearize import check_batch_columnar
+        inv_hists = [columnar_to_ops(cols, r) for r in inv_rows]
+        drs = check_batch_columnar(model, inv_hists)
+        parity_configs = all(
+            dr["valid"] is False and hr["valid"] is False
+            and dr["op"]["index"] == hr["op"]["index"]
+            and dr["configs"] == hr["configs"]
+            for dr, hr in zip(drs, (wgl_check(model, h)
+                                    for h in inv_hists)))
 
     # Converted-history extra: recorded Op-list histories ride the fast
     # path end-to-end (native ingest walk + vectorized encode + device).
     # Reconstruction to Op lists is setup (they stand in for histories
     # the runtime recorded); conversion onward is the timed path.
     from jepsen_tpu.history.columnar import ops_to_columnar
-    C = min(int(os.environ.get("JT_BENCH_CONVERTED", "2000")), B)
+    # Full-batch default: the converted batch re-encodes to the exact
+    # bucket shapes the headline run compiled, so no extra XLA compiles.
+    C = min(int(os.environ.get("JT_BENCH_CONVERTED", str(B))), B)
     conv_hists = [columnar_to_ops(cols, r) for r in range(C)]
     ops_to_columnar(model, conv_hists[:2])       # warm the native build
 
@@ -140,8 +186,8 @@ def main():
         cbuckets, cfails = encode_columnar(space_c, ccols, max_slots=16)
         cdev, ccpu = route(cbuckets, cfails)
         cvalid = np.ones(C, bool)
-        for b in cdev:
-            v, _, _ = run_encoded_batch(b)
+        for b, out in run_buckets_threaded(cdev):
+            v, _, _ = out
             cvalid[np.asarray(b.indices)] = v
         if ccpu:
             rs = (check_batch_native(model,
@@ -153,9 +199,12 @@ def main():
         return cvalid
 
     run_converted()                              # warm compiles
-    t0 = time.time()
-    cvalid = run_converted()
-    t_conv = time.time() - t0
+    t_conv = None
+    for _ in range(max(2, repeats)):             # min-of-n: the tunnel's
+        t0 = time.time()                         # latency is noisy
+        cvalid = run_converted()
+        dt = time.time() - t0
+        t_conv = dt if t_conv is None else min(t_conv, dt)
     converted_rate = C / t_conv
     # Compare against the main run's verdicts where both were on-device.
     cmp_rows = np.array([r for r in range(C) if r not in skip], int)
@@ -171,6 +220,9 @@ def main():
         "ops_per_history": n_ops * 2,
         "invalid_found": n_invalid,
         "parity_sample_ok": parity_ok,
+        "parity": {"valid": parity_ok, "bad_index": parity_bad_index,
+                   "configs": parity_configs,
+                   "config_rows": len(inv_rows)},
         "host_fallbacks": len(failures),
         "buckets": [[b.V, b.W, b.batch] for b in buckets],
         "device": str(jax.devices()[0]),

@@ -219,13 +219,14 @@ def ops_to_columnar(model, histories: Sequence[Sequence[Op]], *,
         histories = [h if isinstance(h, (list, tuple)) else list(h)
                      for h in histories]
         bufs = ext.walk(histories, vocab, all_kinds)
-        code = np.frombuffer(bufs[0], np.int8)
-        proc = np.frombuffer(bufs[1], np.int32)
-        kind = np.frombuffer(bufs[2], np.int32)
-        oidx = np.frombuffer(bufs[3], np.int32)
-        okflag = np.frombuffer(bufs[4], np.int8)
-        link = np.frombuffer(bufs[5], np.int32)
-        rowlen = np.frombuffer(bufs[6], np.int64)
+        # Py_BuildValue("y#") yields None for an empty vector's nullptr.
+        code = np.frombuffer(bufs[0] or b"", np.int8)
+        proc = np.frombuffer(bufs[1] or b"", np.int32)
+        kind = np.frombuffer(bufs[2] or b"", np.int32)
+        oidx = np.frombuffer(bufs[3] or b"", np.int32)
+        okflag = np.frombuffer(bufs[4] or b"", np.int8)
+        link = np.frombuffer(bufs[5] or b"", np.int32)
+        rowlen = np.frombuffer(bufs[6] or b"", np.int64)
     else:
         code, proc, kind, oidx, okflag, link, rowlen = _walk_py(
             histories, vocab, all_kinds)

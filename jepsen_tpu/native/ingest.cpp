@@ -60,9 +60,20 @@ PyObject* canon_items_tuple(PyObject* v) {
 }
 
 PyObject* canon(PyObject* v) {
-  if (PyList_Check(v) || PyTuple_Check(v)) return canon_items_tuple(v);
+  if (PyList_Check(v) || PyTuple_Check(v)) {
+    // Depth-guarded like the pure-Python twin: a pathologically nested
+    // value raises RecursionError instead of overflowing the C stack.
+    if (Py_EnterRecursiveCall(" in op-value canonicalization"))
+      return nullptr;
+    PyObject* out = canon_items_tuple(v);
+    Py_LeaveRecursiveCall();
+    return out;
+  }
   if (PyAnySet_Check(v)) {
+    if (Py_EnterRecursiveCall(" in op-value canonicalization"))
+      return nullptr;
     PyObject* t = canon_items_tuple(v);
+    Py_LeaveRecursiveCall();
     if (!t) return nullptr;
     PyObject* fs = PyFrozenSet_New(t);
     Py_DECREF(t);

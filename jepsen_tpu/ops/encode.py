@@ -246,12 +246,17 @@ class EncodedBatch:
 
     Array shapes (B = batch, N = padded events, V = padded states,
     K = padded op kinds, W = slot-window width):
-      ev_type  — int32 [B, N]: EV_OK or EV_PAD
-      ev_slot  — int32 [B, N]
-      ev_slots — int32 [B, N, W]: slot tables; empty slots point at the
-                 all-invalid sentinel row K of ``target``
-      ev_opidx — int32 [B, N]
+      ev_type  — int8  [B, N]: EV_OK or EV_PAD
+      ev_slot  — int8  [B, N]
+      ev_slots — int8 (int32 when K >= 127) [B, N, W]: slot tables;
+                 empty slots point at the all-invalid sentinel row K of
+                 ``target``
+      ev_opidx — int32 [B, N] (host-side only, never shipped to device)
       target   — int32 [B, K + 1, V]; final row = all-invalid sentinel
+    Event arrays are deliberately narrow: host→device transfer of the
+    batch is a real cost (PCIe at best, a network tunnel at worst), and
+    the kernel widens on device. ``shared_target`` marks every row
+    sharing one transition table (one [K+1, V] transfer instead of B).
     ``indices`` maps batch rows back to positions in the caller's history
     list; ``spaces`` holds each row's StateSpace (for result decoding);
     ``failures`` lists (position, reason) needing host fallback.
@@ -267,6 +272,7 @@ class EncodedBatch:
     indices: List[int]
     failures: List[Tuple[int, str]]
     spaces: List[StateSpace] = None
+    shared_target: bool = False
 
     @property
     def batch(self) -> int:
@@ -302,8 +308,9 @@ def stack_encoded(encs: Sequence[Tuple[int, EncodedHistory]],
     maxima over the group, rounded up for TPU-friendly layouts."""
     failures = list(failures)
     if not encs:
-        z = np.zeros((0, 0), np.int32)
-        return EncodedBatch(z, z, np.zeros((0, 0, min_w), np.int32), z,
+        z8 = np.zeros((0, 0), np.int8)
+        return EncodedBatch(z8, z8, np.zeros((0, 0, min_w), np.int8),
+                            np.zeros((0, 0), np.int32),
                             target=np.zeros((0, 1, min_v), np.int32),
                             V=min_v, W=min_w, indices=[], failures=failures,
                             spaces=[])
@@ -315,9 +322,10 @@ def stack_encoded(encs: Sequence[Tuple[int, EncodedHistory]],
     B = len(encs)
     Bp = pad_batch_to if pad_batch_to else B
 
-    ev_type = np.zeros((Bp, N), np.int32)
-    ev_slot = np.zeros((Bp, N), np.int32)
-    ev_slots = np.full((Bp, N, W), K, np.int32)  # K = sentinel row
+    ev_type = np.zeros((Bp, N), np.int8)
+    ev_slot = np.zeros((Bp, N), np.int8)
+    ev_slots = np.full((Bp, N, W), K,
+                       np.int8 if K < 127 else np.int32)  # K = sentinel
     ev_opidx = np.full((Bp, N), -1, np.int32)
     target = np.full((Bp, K + 1, V), -1, np.int32)
 
@@ -371,7 +379,8 @@ def encode_columnar(space: StateSpace, cols, *,
     K = space.n_kinds
     P = int(cols.process.max(initial=0)) + 1
 
-    table = np.full((B, S), K, np.int32)        # K = empty sentinel
+    table = np.full((B, S), K,
+                    np.int8 if K < 127 else np.int32)  # K = empty sentinel
     free = np.full(B, (1 << S) - 1, np.uint32)
     slot_of = np.full((B, P), -1, np.int8)
     live = np.zeros(B, np.int32)
@@ -382,8 +391,9 @@ def encode_columnar(space: StateSpace, cols, *,
     # ok events + close, rounded up so the per-bucket event axis (also
     # rounded to 8) can never exceed the buffer width
     E = _round_up(N // 2 + 1, 8)
-    ev_slot = np.zeros((B, E), np.int32)
-    ev_slots = np.full((B, E, S), K, np.int32)
+    slot_dtype = np.int8 if K < 127 else np.int32
+    ev_slot = np.zeros((B, E), np.int8)
+    ev_slots = np.full((B, E, S), K, slot_dtype)
     ev_opidx = np.full((B, E), -1, np.int32)
 
     rows = np.arange(B)
@@ -435,20 +445,22 @@ def encode_columnar(space: StateSpace, cols, *,
     W_row = np.maximum(max_live, min_w)
 
     out: List[EncodedBatch] = []
+    padded_target = space.padded_target(V, K)
     for W in sorted(set(W_row[keep].tolist())):
         r = rows[keep & (W_row == W)]
         Nev = _round_up(int(n_events[r].max()), 8)
         ar = np.arange(Nev)
-        etype = np.full((len(r), Nev), EV_PAD, np.int32)
+        etype = np.full((len(r), Nev), EV_PAD, np.int8)
         etype[ar[None, :] < cnt[r, None]] = EV_OK
         etype[np.arange(len(r)), cnt[r]] = EV_CLOSE
-        tgt = np.broadcast_to(space.padded_target(V, K),
-                              (len(r), K + 1, V)).copy()
+        # Every row shares one transition table: a zero-copy broadcast
+        # view + shared_target lets dispatch ship it to the device once.
+        tgt = np.broadcast_to(padded_target, (len(r), K + 1, V))
         out.append(EncodedBatch(
             ev_type=etype, ev_slot=ev_slot[r, :Nev],
             ev_slots=ev_slots[r, :Nev, :W], ev_opidx=ev_opidx[r, :Nev],
             target=tgt, V=V, W=int(W), indices=r.tolist(),
-            failures=[], spaces=[space] * len(r)))
+            failures=[], spaces=[space] * len(r), shared_target=True))
     if out:
         out[0].failures = failures
     return out, failures

@@ -172,6 +172,11 @@ def make_kernel(V: int, W: int):
         return F
 
     def check(ev_type, ev_slot, ev_slots, target):
+        # Event arrays arrive narrow (int8 — transfer bytes are a real
+        # cost off-chip); widen for gathers/switch on device.
+        ev_type = ev_type.astype(jnp.int32)
+        ev_slot = ev_slot.astype(jnp.int32)
+        ev_slots = ev_slots.astype(jnp.int32)
         rows = pack_rows(target, V)
 
         def step(carry, ev):
@@ -204,14 +209,19 @@ def make_kernel(V: int, W: int):
 
 
 # One compiled batch kernel per static (V, W); jit caches per event-shape.
-_BATCH_KERNELS: Dict[Tuple[int, int], object] = {}
+_BATCH_KERNELS: Dict[Tuple[int, int, bool], object] = {}
 
 
-def batch_kernel(V: int, W: int):
-    key = (V, W)
+def batch_kernel(V: int, W: int, shared_target: bool = False):
+    """``shared_target``: every row uses one transition table — the
+    table is passed unbatched ([K+1, V]) and broadcast on device,
+    saving the per-row transfer."""
+    key = (V, W, shared_target)
     k = _BATCH_KERNELS.get(key)
     if k is None:
-        k = jax.jit(jax.vmap(make_kernel(V, W), in_axes=(0, 0, 0, 0)))
+        k = jax.jit(jax.vmap(make_kernel(V, W),
+                             in_axes=(0, 0, 0,
+                                      None if shared_target else 0)))
         _BATCH_KERNELS[key] = k
     return k
 
@@ -273,16 +283,17 @@ def production_mesh(n_frontier: int = 1):
     return mesh
 
 
-def _sharded_kernel(kind: str, V: int, W: int, mesh):
-    key = (kind, V, W, id(mesh))
+def _sharded_kernel(kind: str, V: int, W: int, mesh,
+                    shared_target: bool = False):
+    key = (kind, V, W, id(mesh), shared_target)
     k = _SHARDED_KERNELS.get(key)
     if k is None:
         if kind == "frontier":
             from ..parallel.frontier import frontier_sharded_kernel
-            k = frontier_sharded_kernel(V, W, mesh)
+            k = frontier_sharded_kernel(V, W, mesh, shared_target)
         else:
             from ..parallel.mesh import data_sharded_kernel
-            k = data_sharded_kernel(V, W, mesh)
+            k = data_sharded_kernel(V, W, mesh, shared_target)
         _SHARDED_KERNELS[key] = k
     return k
 
@@ -293,13 +304,16 @@ def _pad_rows(batch: EncodedBatch, bp: int) -> Tuple[np.ndarray, ...]:
     valid=True and are sliced off after the device call."""
     b, n, w = batch.batch, batch.n_events, batch.ev_slots.shape[2]
     K1, V = batch.target.shape[1], batch.target.shape[2]
-    ev_type = np.zeros((bp, n), np.int32)
-    ev_slot = np.zeros((bp, n), np.int32)
-    ev_slots = np.full((bp, n, w), K1 - 1, np.int32)
-    target = np.full((bp, K1, V), -1, np.int32)
+    ev_type = np.zeros((bp, n), batch.ev_type.dtype)
+    ev_slot = np.zeros((bp, n), batch.ev_slot.dtype)
+    ev_slots = np.full((bp, n, w), K1 - 1, batch.ev_slots.dtype)
     ev_type[:b] = batch.ev_type
     ev_slot[:b] = batch.ev_slot
     ev_slots[:b] = batch.ev_slots
+    if batch.shared_target:
+        # Dispatch ships batch.target[0] once; don't materialize B copies.
+        return ev_type, ev_slot, ev_slots, None
+    target = np.full((bp, K1, V), -1, np.int32)
     target[:b] = batch.target
     return ev_type, ev_slot, ev_slots, target
 
@@ -308,29 +322,28 @@ def _round_up_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
-    """Device-check an encoded batch; routes each call to the right
-    kernel for the bucket's window and the attached devices:
+def dispatch_encoded_batch(batch: EncodedBatch,
+                           return_frontier: bool = False):
+    """Queue a bucket's device work WITHOUT blocking; routes to the
+    right kernel for the bucket's window and the attached devices:
 
       * W <= DATA_MAX_SLOTS, small batch or one device — single-device
         vmapped kernel, chunked to bound memory;
       * W <= DATA_MAX_SLOTS, large batch on a multi-device mesh — batch
         axis sharded over "data" (jepsen_tpu.parallel.mesh);
       * W > DATA_MAX_SLOTS — mask axis split over 2^(W - 16) "frontier"
-        devices (jepsen_tpu.parallel.frontier). Raises
-        WindowOverflow when the devices can't host the axis — callers
-        route those rows to a host engine.
+        devices (jepsen_tpu.parallel.frontier). Raises WindowOverflow
+        when the devices can't host the axis — callers route those rows
+        to a host engine.
 
-    Returns (valid [B] bool, bad [B], frontier) — frontier is
-    [B, words(V), 2^W] uint32 when requested and None otherwise
-    (skipping the device→host transfer, which verdict-only hot paths
-    shouldn't pay).
+    Returns an opaque pending handle for ``collect_encoded_batch``.
+    JAX dispatch is asynchronous, so queueing every bucket before
+    collecting any overlaps their transfers and round-trip latencies —
+    on a tunneled device (axon), per-dispatch latency otherwise
+    dominates multi-bucket batches.
     """
     if batch.batch == 0:
-        z = np.zeros((0,), bool)
-        return (z, np.zeros((0,), np.int32),
-                np.zeros((0, 1, 1 << batch.W), np.uint32)
-                if return_frontier else None)
+        return []
 
     if batch.W > DATA_MAX_SLOTS:
         D = 1 << (batch.W - DATA_MAX_SLOTS)
@@ -338,29 +351,61 @@ def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
         if mesh is None:
             raise WindowOverflow(
                 f"window W={batch.W} needs {D} frontier devices")
-        return _run_sharded("frontier", batch, mesh, return_frontier)
+        return _dispatch_sharded("frontier", batch, mesh, return_frontier)
 
     mesh = production_mesh(1)
     if mesh is not None and \
             batch.batch >= mesh.shape["data"] * MIN_ROWS_PER_DEVICE:
-        return _run_sharded("dataN", batch, mesh, return_frontier)
+        return _dispatch_sharded("dataN", batch, mesh, return_frontier)
 
-    kern = batch_kernel(batch.V, batch.W)
+    kern = batch_kernel(batch.V, batch.W, batch.shared_target)
     per_hist = n_state_words(batch.V) << batch.W
     chunk = max(1, MAX_FRONTIER_ELEMENTS // per_hist)
     DISPATCH_LOG.append(("data1", batch.V, batch.W, batch.batch))
-    valids, bads, fronts = [], [], []
+    out = []
     for lo in range(0, batch.batch, chunk):
         hi = min(lo + chunk, batch.batch)
         valid, bad, front = kern(
             batch.ev_type[lo:hi], batch.ev_slot[lo:hi],
-            batch.ev_slots[lo:hi], batch.target[lo:hi])
-        valids.append(np.asarray(valid))
-        bads.append(np.asarray(bad))
+            batch.ev_slots[lo:hi],
+            batch.target[0] if batch.shared_target
+            else batch.target[lo:hi])
+        out.append((valid, bad, front if return_frontier else None,
+                    hi - lo))
+    return out
+
+
+def collect_encoded_batch(pending, batch: EncodedBatch,
+                          return_frontier: bool = False):
+    """Materialize a ``dispatch_encoded_batch`` handle to numpy:
+    (valid [B] bool, bad [B] int32, frontier-or-None)."""
+    if not pending:
+        z = np.zeros((0,), bool)
+        return (z, np.zeros((0,), np.int32),
+                np.zeros((0, 1, 1 << batch.W), np.uint32)
+                if return_frontier else None)
+    valids, bads, fronts = [], [], []
+    for valid, bad, front, nb in pending:
+        valids.append(np.asarray(valid)[:nb])
+        bads.append(np.asarray(bad)[:nb])
         if return_frontier:
-            fronts.append(np.asarray(front))
+            fronts.append(np.asarray(front)[:nb])
     return (np.concatenate(valids), np.concatenate(bads),
             np.concatenate(fronts) if return_frontier else None)
+
+
+def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
+    """Dispatch + collect one bucket (see dispatch_encoded_batch); for
+    multi-bucket pipelines, dispatch all buckets before collecting any.
+
+    Returns (valid [B] bool, bad [B], frontier) — frontier is
+    [B, words(V), 2^W] uint32 when requested and None otherwise
+    (skipping the device→host transfer, which verdict-only hot paths
+    shouldn't pay).
+    """
+    return collect_encoded_batch(
+        dispatch_encoded_batch(batch, return_frontier), batch,
+        return_frontier)
 
 
 class WindowOverflow(Exception):
@@ -368,13 +413,37 @@ class WindowOverflow(Exception):
     can host; the rows belong on a host/native engine."""
 
 
-def _run_sharded(kind: str, batch: EncodedBatch, mesh,
-                 return_frontier: bool):
-    """Dispatch one bucket through a sharded kernel, padding the batch
-    to the data-axis multiple and chunking to bound per-device memory."""
+def run_buckets_threaded(batches: Sequence[EncodedBatch],
+                         return_frontier: bool = False):
+    """Run many cost buckets concurrently from a thread pool and yield
+    (batch, (valid, bad, frontier) | WindowOverflow) pairs. JAX
+    execution is thread-safe; overlapping the per-call round trips is
+    what keeps many-bucket batches fast when the device sits behind a
+    link with real latency (PCIe queues locally, a network tunnel under
+    axon)."""
+    if not batches:
+        return []
+    from concurrent.futures import ThreadPoolExecutor
+
+    def one(batch):
+        try:
+            return batch, run_encoded_batch(batch, return_frontier)
+        except WindowOverflow as e:
+            return batch, e
+
+    if len(batches) == 1:
+        return [one(batches[0])]
+    with ThreadPoolExecutor(min(12, len(batches))) as ex:
+        return list(ex.map(one, batches))
+
+
+def _dispatch_sharded(kind: str, batch: EncodedBatch, mesh,
+                      return_frontier: bool):
+    """Queue one bucket through a sharded kernel, padding the batch to
+    the data-axis multiple and chunking to bound per-device memory."""
     n_data = mesh.shape["data"]
     kern = _sharded_kernel("frontier" if kind == "frontier" else "data",
-                           batch.V, batch.W, mesh)
+                           batch.V, batch.W, mesh, batch.shared_target)
     # Per-device budget: (chunk / n_data) rows x (per_hist / n_frontier)
     # words <= MAX_FRONTIER_ELEMENTS  =>  chunk <= MAX * size / per_hist.
     per_hist = n_state_words(batch.V) << batch.W
@@ -382,7 +451,7 @@ def _run_sharded(kind: str, batch: EncodedBatch, mesh,
         max(n_data, MAX_FRONTIER_ELEMENTS * mesh.size // max(per_hist, 1)),
         n_data)
     DISPATCH_LOG.append((kind, batch.V, batch.W, batch.batch))
-    valids, bads, fronts = [], [], []
+    out = []
     for lo in range(0, batch.batch, chunk):
         hi = min(lo + chunk, batch.batch)
         nb = hi - lo
@@ -391,15 +460,13 @@ def _run_sharded(kind: str, batch: EncodedBatch, mesh,
             ev_type=batch.ev_type[lo:hi], ev_slot=batch.ev_slot[lo:hi],
             ev_slots=batch.ev_slots[lo:hi], ev_opidx=batch.ev_opidx[lo:hi],
             target=batch.target[lo:hi], V=batch.V, W=batch.W,
-            indices=[], failures=[])
+            indices=[], failures=[], shared_target=batch.shared_target)
         ev_type, ev_slot, ev_slots, target = _pad_rows(sub, bp)
-        valid, bad, front = kern(ev_type, ev_slot, ev_slots, target)
-        valids.append(np.asarray(valid)[:nb])
-        bads.append(np.asarray(bad)[:nb])
-        if return_frontier:
-            fronts.append(np.asarray(front)[:nb])
-    return (np.concatenate(valids), np.concatenate(bads),
-            np.concatenate(fronts) if return_frontier else None)
+        valid, bad, front = kern(
+            ev_type, ev_slot, ev_slots,
+            batch.target[0] if batch.shared_target else target)
+        out.append((valid, bad, front if return_frontier else None, nb))
+    return out
 
 
 def decode_frontier(frontier: np.ndarray, space, slot_to_op: Dict[int, int],
@@ -491,6 +558,7 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
                             max_slots=eff_slots)
 
     results: List[Optional[dict]] = [None] * len(histories)
+    device_batches = []
     for batch in buckets:
         if 0 < batch.batch < min_device_batch:
             try:
@@ -503,22 +571,23 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
             for i, r in zip(batch.indices, rs):
                 results[i] = r
         else:
-            try:
-                valid, bad, front = run_encoded_batch(batch,
-                                                      return_frontier=True)
-            except WindowOverflow as e:
-                for i in batch.indices:
-                    r = host_fallback(model, histories[i])
-                    r.setdefault("fallback", str(e))
-                    results[i] = r
-            else:
-                for row, i in enumerate(batch.indices):
-                    results[i] = _result_for(row, batch, valid, bad, front,
-                                             model, prepared[i])
+            device_batches.append(batch)
         for i, reason in batch.failures:
             r = host_fallback(model, histories[i])
             r.setdefault("fallback", reason)
             results[i] = r
+    for batch, out in run_buckets_threaded(device_batches,
+                                           return_frontier=True):
+        if isinstance(out, WindowOverflow):
+            for i in batch.indices:
+                r = host_fallback(model, histories[i])
+                r.setdefault("fallback", str(out))
+                results[i] = r
+            continue
+        valid, bad, front = out
+        for row, i in enumerate(batch.indices):
+            results[i] = _result_for(row, batch, valid, bad, front,
+                                     model, prepared[i])
     return results
 
 
@@ -558,7 +627,10 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
     bad = np.full(cols.batch, INT32_MAX, np.int32)
     results: List[Optional[dict]] = [None] * cols.batch if details else None
     failures = list(failures)
-    if min_device_batch > 1:
+    # In details mode every row must carry the full host-shaped result
+    # (op + configs); the native engine returns verdicts only, so the
+    # small-bucket shortcut applies to the verdict-only path alone.
+    if min_device_batch > 1 and not details:
         small = [b for b in buckets if 0 < b.batch < min_device_batch]
         buckets = [b for b in buckets if b.batch >= min_device_batch]
         try:
@@ -580,12 +652,12 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
                     bad[i] = r["op"].get("index", -1)
                 if details:
                     results[i] = r
-    for batch in buckets:
-        try:
-            v, b, front = run_encoded_batch(batch, return_frontier=details)
-        except WindowOverflow as e:
-            failures.extend((i, str(e)) for i in batch.indices)
+    for batch, out in run_buckets_threaded(buckets,
+                                           return_frontier=details):
+        if isinstance(out, WindowOverflow):
+            failures.extend((i, str(out)) for i in batch.indices)
             continue
+        v, b, front = out
         idx = np.asarray(batch.indices)
         valid[idx] = v
         bad_rows = idx[~v]

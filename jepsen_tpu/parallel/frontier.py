@@ -125,6 +125,10 @@ def make_frontier_kernel(V: int, W: int, D: int,
         return out
 
     def check(ev_type, ev_slot, ev_slots, target):
+        # Narrow (int8) event arrays off the host; widen on device.
+        ev_type = ev_type.astype(jnp.int32)
+        ev_slot = ev_slot.astype(jnp.int32)
+        ev_slots = ev_slots.astype(jnp.int32)
         rows = pack_rows(target, V)
 
         def step(carry, ev):
@@ -171,19 +175,23 @@ def make_frontier_kernel(V: int, W: int, D: int,
     return check
 
 
-def frontier_sharded_kernel(V: int, W: int, mesh: Mesh):
+def frontier_sharded_kernel(V: int, W: int, mesh: Mesh,
+                            shared_target: bool = False):
     """Batched checker over a ("data", "frontier") mesh: batch rows shard
     over "data", each row's frontier splits over "frontier". Returns
     check(ev_type [B,N], ev_slot [B,N], ev_slots [B,N,W], target)
     -> (valid [B], bad [B], frontier [B, words(V), 2^W]) — the same
     contract as the single-device kernel (ops.linearize.make_kernel), so
-    production dispatch and counterexample decoding are path-agnostic."""
+    production dispatch and counterexample decoding are path-agnostic.
+    ``shared_target``: one replicated [K+1, V] transition table instead
+    of a per-row batch."""
     D = mesh.shape["frontier"]
-    kern = jax.vmap(make_frontier_kernel(V, W, D), in_axes=(0, 0, 0, 0))
+    kern = jax.vmap(make_frontier_kernel(V, W, D),
+                    in_axes=(0, 0, 0, None if shared_target else 0))
     ev = P("data", None)
+    tgt = P(None, None) if shared_target else P("data", None, None)
     sharded = shard_map(kern, mesh=mesh,
-                        in_specs=(ev, ev, P("data", None, None),
-                                  P("data", None, None)),
+                        in_specs=(ev, ev, P("data", None, None), tgt),
                         out_specs=(P("data"), P("data"),
                                    P("data", None, "frontier")))
     return jax.jit(sharded)

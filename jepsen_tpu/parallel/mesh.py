@@ -33,16 +33,21 @@ def checker_mesh(n_data: Optional[int] = None, n_frontier: int = 1,
     return Mesh(use, axis_names=("data", "frontier"))
 
 
-def data_sharded_kernel(V: int, W: int, mesh: Mesh):
+def data_sharded_kernel(V: int, W: int, mesh: Mesh,
+                        shared_target: bool = False):
     """Compile the batched checker with the batch axis sharded over the
     mesh's "data" axis. Returns check(ev_type [B,N], ev_slot [B,N],
     ev_slots [B,N,W], target [B,K+1,V]) -> (valid [B], bad [B],
-    frontier [B, words(V), 2^W]); B must divide by the data-axis size."""
+    frontier [B, words(V), 2^W]); B must divide by the data-axis size.
+    ``shared_target``: target is one replicated [K+1, V] table instead
+    of a per-row batch (one transfer, not B)."""
     batch_spec = NamedSharding(mesh, P("data"))
     out_spec = NamedSharding(mesh, P("data"))
-    kern = jax.vmap(make_kernel(V, W), in_axes=(0, 0, 0, 0))
+    tgt_spec = NamedSharding(mesh, P()) if shared_target else batch_spec
+    kern = jax.vmap(make_kernel(V, W),
+                    in_axes=(0, 0, 0, None if shared_target else 0))
     return jax.jit(kern,
-                   in_shardings=(batch_spec,) * 4,
+                   in_shardings=(batch_spec,) * 3 + (tgt_spec,),
                    out_shardings=(out_spec, out_spec, out_spec))
 
 
