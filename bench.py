@@ -37,8 +37,7 @@ def main():
     from jepsen_tpu.history.columnar import columnar_to_ops
     from jepsen_tpu.models.core import cas_register
     from jepsen_tpu.ops.encode import encode_columnar
-    from jepsen_tpu.ops.linearize import (run_buckets_threaded,
-                                          run_encoded_batch)
+    from jepsen_tpu.ops.linearize import run_buckets_threaded
     from jepsen_tpu.ops.statespace import enumerate_statespace
     from jepsen_tpu.workloads.synth import synth_cas_columnar
 
@@ -93,11 +92,9 @@ def main():
 
         with ThreadPoolExecutor(1) as ex:
             tail = ex.submit(cpu_tail)
-            by_batch = dict(
-                (id(b), out)
-                for b, out in run_buckets_threaded(dev_buckets))
+            # run_buckets_threaded preserves input order
+            outs = [out for _, out in run_buckets_threaded(dev_buckets)]
             n_bad = tail.result()
-        outs = [by_batch[id(b)] for b in dev_buckets]
         return outs, n_bad
 
     # Warmup / compile.
@@ -211,6 +208,36 @@ def main():
     converted_match = bool(
         (cvalid[cmp_rows] == dev_valid[cmp_rows]).all())
 
+    # O(n) fold-checker extra: batch total-queue accounting on device
+    # (jepsen_tpu.ops.folds) — the reference's single-pass reducers
+    # (checker.clj:214-271) as one scatter dispatch per batch.
+    from jepsen_tpu.history.ops import invoke_op, ok_op
+    from jepsen_tpu.ops.folds import check_total_queues_batch
+    import random as _random
+
+    def synth_tq(seed, n=100):
+        rng = _random.Random(seed)
+        h = []
+        for i in range(n):
+            h.append(invoke_op(0, "enqueue", i))
+            h.append(ok_op(0, "enqueue", i))
+        order = list(range(n))
+        rng.shuffle(order)
+        if rng.random() < 0.3:
+            order.pop()                      # lost element
+        for v in order:
+            h.append(invoke_op(1, "dequeue", None))
+            h.append(ok_op(1, "dequeue", v))
+        return h
+
+    FB = int(os.environ.get("JT_BENCH_FOLD_B", "2000"))
+    fold_hists = [synth_tq(s) for s in range(FB)]
+    check_total_queues_batch(fold_hists)         # warm (same shapes)
+    t0 = time.time()
+    fold_rs = check_total_queues_batch(fold_hists)
+    fold_rate = FB / (time.time() - t0)
+    fold_invalid = sum(1 for r in fold_rs if r["valid"] is not True)
+
     print(json.dumps({
         "metric": "linearizability_check_throughput_1kop_cas_e2e",
         "value": round(rate, 2),
@@ -230,6 +257,9 @@ def main():
         "converted_e2e_rate": round(converted_rate, 2),
         "converted_histories": C,
         "converted_verdict_match": converted_match,
+        "fold_total_queue_rate": round(fold_rate, 2),
+        "fold_histories": FB,
+        "fold_invalid": fold_invalid,
         "device_rate": round(n_checked / t_dev, 2),
         "device_time_s": round(t_dev, 3),
         "encode_time_s": round(t_encode, 3),

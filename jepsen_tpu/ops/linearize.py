@@ -322,10 +322,9 @@ def _round_up_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def dispatch_encoded_batch(batch: EncodedBatch,
-                           return_frontier: bool = False):
-    """Queue a bucket's device work WITHOUT blocking; routes to the
-    right kernel for the bucket's window and the attached devices:
+def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
+    """Device-check one cost bucket; routes to the right kernel for the
+    bucket's window and the attached devices:
 
       * W <= DATA_MAX_SLOTS, small batch or one device — single-device
         vmapped kernel, chunked to bound memory;
@@ -336,14 +335,17 @@ def dispatch_encoded_batch(batch: EncodedBatch,
         when the devices can't host the axis — callers route those rows
         to a host engine.
 
-    Returns an opaque pending handle for ``collect_encoded_batch``.
-    JAX dispatch is asynchronous, so queueing every bucket before
-    collecting any overlaps their transfers and round-trip latencies —
-    on a tunneled device (axon), per-dispatch latency otherwise
-    dominates multi-bucket batches.
+    Blocking; multi-bucket callers overlap the per-dispatch round trips
+    with ``run_buckets_threaded``. Returns (valid [B] bool, bad [B],
+    frontier) — frontier is [B, words(V), 2^W] uint32 when requested
+    and None otherwise (skipping the device→host transfer, which
+    verdict-only hot paths shouldn't pay).
     """
     if batch.batch == 0:
-        return []
+        z = np.zeros((0,), bool)
+        return (z, np.zeros((0,), np.int32),
+                np.zeros((0, 1, 1 << batch.W), np.uint32)
+                if return_frontier else None)
 
     if batch.W > DATA_MAX_SLOTS:
         D = 1 << (batch.W - DATA_MAX_SLOTS)
@@ -351,39 +353,31 @@ def dispatch_encoded_batch(batch: EncodedBatch,
         if mesh is None:
             raise WindowOverflow(
                 f"window W={batch.W} needs {D} frontier devices")
-        return _dispatch_sharded("frontier", batch, mesh, return_frontier)
+        pending = _dispatch_sharded("frontier", batch, mesh,
+                                    return_frontier)
+    else:
+        mesh = production_mesh(1)
+        if mesh is not None and \
+                batch.batch >= mesh.shape["data"] * MIN_ROWS_PER_DEVICE:
+            pending = _dispatch_sharded("dataN", batch, mesh,
+                                        return_frontier)
+        else:
+            kern = batch_kernel(batch.V, batch.W, batch.shared_target)
+            per_hist = n_state_words(batch.V) << batch.W
+            chunk = max(1, MAX_FRONTIER_ELEMENTS // per_hist)
+            DISPATCH_LOG.append(("data1", batch.V, batch.W, batch.batch))
+            pending = []
+            for lo in range(0, batch.batch, chunk):
+                hi = min(lo + chunk, batch.batch)
+                valid, bad, front = kern(
+                    batch.ev_type[lo:hi], batch.ev_slot[lo:hi],
+                    batch.ev_slots[lo:hi],
+                    batch.target[0] if batch.shared_target
+                    else batch.target[lo:hi])
+                pending.append((valid, bad,
+                                front if return_frontier else None,
+                                hi - lo))
 
-    mesh = production_mesh(1)
-    if mesh is not None and \
-            batch.batch >= mesh.shape["data"] * MIN_ROWS_PER_DEVICE:
-        return _dispatch_sharded("dataN", batch, mesh, return_frontier)
-
-    kern = batch_kernel(batch.V, batch.W, batch.shared_target)
-    per_hist = n_state_words(batch.V) << batch.W
-    chunk = max(1, MAX_FRONTIER_ELEMENTS // per_hist)
-    DISPATCH_LOG.append(("data1", batch.V, batch.W, batch.batch))
-    out = []
-    for lo in range(0, batch.batch, chunk):
-        hi = min(lo + chunk, batch.batch)
-        valid, bad, front = kern(
-            batch.ev_type[lo:hi], batch.ev_slot[lo:hi],
-            batch.ev_slots[lo:hi],
-            batch.target[0] if batch.shared_target
-            else batch.target[lo:hi])
-        out.append((valid, bad, front if return_frontier else None,
-                    hi - lo))
-    return out
-
-
-def collect_encoded_batch(pending, batch: EncodedBatch,
-                          return_frontier: bool = False):
-    """Materialize a ``dispatch_encoded_batch`` handle to numpy:
-    (valid [B] bool, bad [B] int32, frontier-or-None)."""
-    if not pending:
-        z = np.zeros((0,), bool)
-        return (z, np.zeros((0,), np.int32),
-                np.zeros((0, 1, 1 << batch.W), np.uint32)
-                if return_frontier else None)
     valids, bads, fronts = [], [], []
     for valid, bad, front, nb in pending:
         valids.append(np.asarray(valid)[:nb])
@@ -392,20 +386,6 @@ def collect_encoded_batch(pending, batch: EncodedBatch,
             fronts.append(np.asarray(front)[:nb])
     return (np.concatenate(valids), np.concatenate(bads),
             np.concatenate(fronts) if return_frontier else None)
-
-
-def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
-    """Dispatch + collect one bucket (see dispatch_encoded_batch); for
-    multi-bucket pipelines, dispatch all buckets before collecting any.
-
-    Returns (valid [B] bool, bad [B], frontier) — frontier is
-    [B, words(V), 2^W] uint32 when requested and None otherwise
-    (skipping the device→host transfer, which verdict-only hot paths
-    shouldn't pay).
-    """
-    return collect_encoded_batch(
-        dispatch_encoded_batch(batch, return_frontier), batch,
-        return_frontier)
 
 
 class WindowOverflow(Exception):

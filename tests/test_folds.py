@@ -1,0 +1,241 @@
+"""Vmapped O(n) fold checkers vs the host oracles (checkers.simple).
+
+Every family gets randomized workloads with seeded violations; the
+device dicts must match the host dicts field for field (set /
+total-queue / unique-ids / counter) or verdict for verdict (queue,
+whose host dict embeds a model object).
+"""
+import random
+
+import pytest
+
+from jepsen_tpu.checkers.simple import (CounterChecker, QueueChecker,
+                                        SetChecker, TotalQueueChecker,
+                                        UniqueIdsChecker)
+from jepsen_tpu.history.core import index
+from jepsen_tpu.history.ops import (fail_op, info_op, invoke_op, ok_op)
+from jepsen_tpu.models.core import unordered_queue
+from jepsen_tpu.ops.folds import (check_counters_batch, check_queues_batch,
+                                  check_sets_batch, check_total_queues_batch,
+                                  check_unique_ids_batch)
+
+
+def synth_set_history(seed):
+    rng = random.Random(seed)
+    h = []
+    added_ok, attempted = [], []
+    for i in range(rng.randrange(5, 30)):
+        p = rng.randrange(4)
+        h.append(invoke_op(p, "add", i))
+        attempted.append(i)
+        r = rng.random()
+        if r < 0.7:
+            h.append(ok_op(p, "add", i))
+            added_ok.append(i)
+        elif r < 0.85:
+            h.append(fail_op(p, "add", i))
+        else:
+            h.append(info_op(p, "add", i))
+    final = set(added_ok)
+    if rng.random() < 0.4 and added_ok:     # lose an acknowledged add
+        final.discard(rng.choice(added_ok))
+    if rng.random() < 0.3:                  # element from nowhere
+        final.add(10_000 + seed)
+    h.append(invoke_op(0, "read", None))
+    if rng.random() < 0.9:
+        h.append(ok_op(0, "read", sorted(final)))
+    return index(h)
+
+
+def synth_total_queue_history(seed):
+    rng = random.Random(seed)
+    h = []
+    enq_ok = []
+    for i in range(rng.randrange(5, 25)):
+        p = rng.randrange(3)
+        h.append(invoke_op(p, "enqueue", i))
+        r = rng.random()
+        if r < 0.75:
+            h.append(ok_op(p, "enqueue", i))
+            enq_ok.append(i)
+        elif r < 0.9:
+            h.append(fail_op(p, "enqueue", i))
+        else:
+            h.append(info_op(p, "enqueue", i))
+    deqs = list(enq_ok)
+    rng.shuffle(deqs)
+    if rng.random() < 0.4 and deqs:
+        deqs.pop()                           # lost element
+    if rng.random() < 0.3 and deqs:
+        deqs.append(rng.choice(deqs))        # duplicate delivery
+    if rng.random() < 0.2:
+        deqs.append(7_000 + seed)            # unexpected element
+    drain_at = len(deqs) // 2 if rng.random() < 0.5 else None
+    for j, v in enumerate(deqs):
+        p = rng.randrange(3)
+        if drain_at is not None and j == drain_at:
+            h.append(invoke_op(p, "drain", None))
+            h.append(ok_op(p, "drain", deqs[drain_at:]))
+            break
+        h.append(invoke_op(p, "dequeue", None))
+        h.append(ok_op(p, "dequeue", v))
+    return index(h)
+
+
+def synth_counter_history(seed):
+    rng = random.Random(seed)
+    h = []
+    lower = upper = 0
+    pending = {}
+    for _ in range(rng.randrange(10, 40)):
+        p = rng.randrange(4)
+        if p in pending:
+            lo, val = pending.pop(p)
+            h.append(ok_op(p, "read", val))
+            continue
+        if rng.random() < 0.5:
+            v = rng.randrange(1, 5)
+            h.append(invoke_op(p, "add", v))
+            upper += v
+            if rng.random() < 0.8:
+                h.append(ok_op(p, "add", v))
+                lower += v
+            else:
+                h.append(info_op(p, "add", v))
+        else:
+            # a plausible read within bounds, sometimes corrupted
+            val = rng.randrange(lower, upper + 1) if upper >= lower else 0
+            if rng.random() < 0.2:
+                val = upper + rng.randrange(5, 50)
+            h.append(invoke_op(p, "read", None))
+            pending[p] = (lower, val)
+            if rng.random() < 0.8:
+                h.append(ok_op(p, "read", val))
+            else:
+                pending[p] = (lower, val)
+                pending.pop(p)
+                h.append(info_op(p, "read", None))
+    return index(h)
+
+
+def synth_ids_history(seed):
+    rng = random.Random(seed)
+    h = []
+    next_id = seed * 1000
+    issued = []
+    for _ in range(rng.randrange(5, 30)):
+        p = rng.randrange(4)
+        h.append(invoke_op(p, "generate", None))
+        r = rng.random()
+        if r < 0.75:
+            if issued and rng.random() < 0.15:
+                v = rng.choice(issued)       # duplicate id
+            else:
+                v = next_id
+                next_id += 1
+            issued.append(v)
+            h.append(ok_op(p, "generate", v))
+        elif r < 0.9:
+            h.append(fail_op(p, "generate", None))
+        else:
+            h.append(info_op(p, "generate", None))
+    return index(h)
+
+
+def synth_queue_history(seed):
+    rng = random.Random(seed)
+    h = []
+    in_queue = []
+    for i in range(rng.randrange(5, 25)):
+        p = rng.randrange(3)
+        if in_queue and rng.random() < 0.4:
+            v = in_queue.pop(rng.randrange(len(in_queue)))
+            if rng.random() < 0.15:
+                v = 9_000 + seed             # dequeue from nowhere
+            h.append(invoke_op(p, "dequeue", None))
+            h.append(ok_op(p, "dequeue", v))
+        else:
+            h.append(invoke_op(p, "enqueue", i))
+            h.append(ok_op(p, "enqueue", i))
+            in_queue.append(i)
+    return index(h)
+
+
+N_HIST = 40
+
+
+def test_set_fold_parity():
+    hs = [synth_set_history(s) for s in range(N_HIST)]
+    got = check_sets_batch(hs)
+    ref = [SetChecker().check({}, None, h) for h in hs]
+    assert got == ref
+    assert {True, False} <= {r["valid"] for r in ref
+                             if r["valid"] != "unknown"} | {True, False}
+    assert any(r["valid"] is False for r in ref)
+    assert any(r["valid"] is True for r in ref)
+
+
+def test_total_queue_fold_parity():
+    hs = [synth_total_queue_history(s) for s in range(N_HIST)]
+    got = check_total_queues_batch(hs)
+    ref = [TotalQueueChecker().check({}, None, h) for h in hs]
+    assert got == ref
+    assert any(r["valid"] is False for r in ref)
+    assert any(r["valid"] is True for r in ref)
+
+
+def test_counter_fold_parity():
+    hs = [synth_counter_history(s) for s in range(N_HIST)]
+    got = check_counters_batch(hs)
+    ref = [CounterChecker().check({}, None, h) for h in hs]
+    assert got == ref
+    assert any(r["valid"] is False for r in ref)
+    assert any(r["valid"] is True for r in ref)
+
+
+def test_unique_ids_fold_parity():
+    hs = [synth_ids_history(s) for s in range(N_HIST)]
+    got = check_unique_ids_batch(hs)
+    ref = [UniqueIdsChecker().check({}, None, h) for h in hs]
+    assert got == ref
+    assert any(r["valid"] is False for r in ref)
+    assert any(r["valid"] is True for r in ref)
+
+
+def test_queue_fold_parity():
+    hs = [synth_queue_history(s) for s in range(N_HIST)]
+    got = check_queues_batch(hs)
+    ref = [QueueChecker().check({}, unordered_queue(), h) for h in hs]
+    assert [g["valid"] for g in got] == [r["valid"] for r in ref]
+    assert any(r["valid"] is False for r in ref)
+    assert any(r["valid"] is True for r in ref)
+
+
+def test_fold_checker_protocol_adapters():
+    from jepsen_tpu.ops.folds import (counter_checker_tpu, queue_checker_tpu,
+                                      set_checker_tpu,
+                                      total_queue_checker_tpu,
+                                      unique_ids_checker_tpu)
+    h = synth_set_history(3)
+    assert set_checker_tpu().check({}, None, h) == \
+        SetChecker().check({}, None, h)
+    h = synth_counter_history(3)
+    assert counter_checker_tpu().check({}, None, h) == \
+        CounterChecker().check({}, None, h)
+    h = synth_total_queue_history(3)
+    assert total_queue_checker_tpu().check({}, None, h) == \
+        TotalQueueChecker().check({}, None, h)
+    h = synth_ids_history(3)
+    assert unique_ids_checker_tpu().check({}, None, h) == \
+        UniqueIdsChecker().check({}, None, h)
+    h = synth_queue_history(3)
+    assert queue_checker_tpu().check({}, None, h)["valid"] == \
+        QueueChecker().check({}, unordered_queue(), h)["valid"]
+
+
+def test_empty_histories():
+    assert check_sets_batch([[]])[0]["valid"] == "unknown"
+    assert check_total_queues_batch([[]])[0]["valid"] is True
+    assert check_counters_batch([[]])[0]["valid"] is True
+    assert check_unique_ids_batch([[]])[0]["valid"] is True
+    assert check_queues_batch([[]])[0]["valid"] is True
