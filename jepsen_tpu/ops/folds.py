@@ -142,32 +142,34 @@ def check_sets_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
     for the whole batch."""
     enc = _encode(histories, {"add": F_ADD})
     # Final read per row is a value *list*: lower to a [B, V] bitmap.
+    # Never-attempted elements extend the decoded domain; collect them
+    # first so the bitmap allocates once at its final width.
     vocab_idx = {v: i for i, v in enumerate(enc.vocab)}
-    V = max(len(enc.vocab), 1)
-    final = np.zeros((enc.batch, V), bool)
-    has_read = np.zeros(enc.batch, bool)
-    for r, h in enumerate(histories):
+    finals: List[Optional[list]] = []
+    for h in histories:
         fr = None
         for op in h:
             if op.is_ok and op.f == "read":
                 fr = op.value
+        finals.append(fr)
+        for v in (fr or ()):
+            v = tuple(v) if isinstance(v, list) else v
+            if v not in vocab_idx:
+                vocab_idx[v] = len(enc.vocab)
+                enc.vocab.append(v)
+    V = max(len(enc.vocab), 1)
+    final = np.zeros((enc.batch, V), bool)
+    has_read = np.zeros(enc.batch, bool)
+    for r, fr in enumerate(finals):
         if fr is None:
             continue
         has_read[r] = True
         for v in fr:
-            v = tuple(v) if isinstance(v, list) else v
-            vi = vocab_idx.get(v)
-            if vi is None:
-                # element never attempted: extend the decoded domain
-                vi = vocab_idx[v] = len(enc.vocab)
-                enc.vocab.append(v)
-                V = len(enc.vocab)
-                final = np.pad(final, ((0, 0), (0, 1)))
-            final[r, vi] = True
+            final[r, vocab_idx[tuple(v) if isinstance(v, list) else v]] = \
+                True
     att, ok, unexpected, lost, recovered = (
-        np.asarray(a) for a in _set_kernel(V)(
-            enc.typ, enc.f, enc.val, final[:, :V] if final.shape[1] >= V
-            else np.pad(final, ((0, 0), (0, V - final.shape[1])))))
+        np.asarray(a) for a in _set_kernel(V)(enc.typ, enc.f, enc.val,
+                                              final))
 
     def decode(r: int) -> dict:
         if not has_read[r]:

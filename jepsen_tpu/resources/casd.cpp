@@ -14,6 +14,19 @@
 // --persist FILE, writes go through an fsync'd log replayed on boot,
 // and restarts are harmless — valid histories stay valid.
 //
+// Besides the KV register it serves the other coordination primitives
+// the checker families need real processes for (the role hazelcast /
+// aerospike / rabbitmq servers play in the reference suites):
+//   POST /lock/<name>     op=acquire|release&owner=O   (mutex)
+//   POST /ids/next                                     (unique ids)
+//   POST /counter/<name>  delta=N   GET /counter/<name>
+//   POST /queue/<name>    op=enq&v=X | op=deq | op=drain
+//   POST /set/<name>      op=add&v=X   GET /set/<name>
+// All share the same in-memory-unless-persisted semantics, so the one
+// kill+restart nemesis seeds a REAL violation in every family: wiped
+// locks double-grant, a reset id counter duplicates, wiped queues/sets
+// lose elements, a wiped counter under-reads.
+//
 // Usage: casd --port P [--persist FILE] [--delay-ms N]
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -22,29 +35,45 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstdio>
 #include <cstring>
+#include <deque>
 #include <fstream>
 #include <map>
 #include <mutex>
+#include <set>
 #include <sstream>
 #include <string>
 #include <thread>
+#include <vector>
 
 namespace {
 
 std::mutex g_mu;
 std::map<std::string, std::string> g_store;
+std::map<std::string, std::string> g_locks;               // name -> owner
+std::map<std::string, long> g_counters;
+std::map<std::string, std::deque<std::string>> g_queues;
+std::map<std::string, std::set<std::string>> g_sets;
+long g_next_id = 0;
 long g_index = 0;
 std::string g_persist_path;
 int g_delay_ms = 0;
 
-void persist(const std::string& key, const std::string& value, bool del) {
+// Append one replayable record. Codes: S/D kv set/delete, L/U lock
+// acquire/release, I id grant, C counter add, Q/R queue enq/deq,
+// E set add.
+void plog(char code, const std::string& a, const std::string& b) {
   if (g_persist_path.empty()) return;
   std::ofstream f(g_persist_path, std::ios::app);
-  f << (del ? "D" : "S") << " " << key << " " << value << "\n";
+  f << code << " " << a << " " << b << "\n";
   f.flush();
+}
+
+void persist(const std::string& key, const std::string& value, bool del) {
+  plog(del ? 'D' : 'S', key, value);
 }
 
 void replay() {
@@ -52,13 +81,28 @@ void replay() {
   std::ifstream f(g_persist_path);
   std::string op, key, value;
   while (f >> op >> key) {
+    std::getline(f, value);
+    if (!value.empty() && value[0] == ' ') value.erase(0, 1);
     if (op == "S") {
-      std::getline(f, value);
-      if (!value.empty() && value[0] == ' ') value.erase(0, 1);
       g_store[key] = value;
-    } else {
-      std::getline(f, value);
+    } else if (op == "D") {
       g_store.erase(key);
+    } else if (op == "L") {
+      g_locks[key] = value;
+    } else if (op == "U") {
+      g_locks.erase(key);
+    } else if (op == "I") {
+      ++g_next_id;
+    } else if (op == "C") {
+      g_counters[key] += atol(value.c_str());
+    } else if (op == "Q") {
+      g_queues[key].push_back(value);
+    } else if (op == "R") {
+      auto& q = g_queues[key];
+      auto it = std::find(q.begin(), q.end(), value);
+      if (it != q.end()) q.erase(it);
+    } else if (op == "E") {
+      g_sets[key].insert(value);
     }
     ++g_index;
   }
@@ -161,6 +205,113 @@ std::string node_json(const std::string& key, const std::string& value,
   return os.str();
 }
 
+std::string json_list(const std::vector<std::string>& vs) {
+  std::ostringstream os;
+  os << "[";
+  for (size_t i = 0; i < vs.size(); ++i)
+    os << (i ? "," : "") << "\"" << vs[i] << "\"";
+  os << "]";
+  return os.str();
+}
+
+bool starts_with(const std::string& s, const std::string& p,
+                 std::string* rest) {
+  if (s.compare(0, p.size(), p) != 0) return false;
+  *rest = s.substr(p.size());
+  return true;
+}
+
+// The coordination services. Caller holds g_mu.
+void handle_service(int fd, Request& req) {
+  std::string name;
+  if (req.path == "/ids/next") {
+    long id = g_next_id++;
+    plog('I', "-", "-");
+    respond(fd, 200, "{\"id\":" + std::to_string(id) + "}");
+  } else if (starts_with(req.path, "/lock/", &name)) {
+    const std::string& op = req.form["op"];
+    const std::string& owner = req.form["owner"];
+    auto it = g_locks.find(name);
+    if (op == "acquire") {
+      if (it != g_locks.end()) {
+        respond(fd, 409, "{\"held\":\"" + it->second + "\"}");
+      } else {
+        g_locks[name] = owner;
+        plog('L', name, owner);
+        respond(fd, 200, "{\"ok\":true}");
+      }
+    } else if (op == "release") {
+      if (it == g_locks.end() || it->second != owner) {
+        respond(fd, 409, "{\"error\":\"not holder\"}");
+      } else {
+        g_locks.erase(it);
+        plog('U', name, "-");
+        respond(fd, 200, "{\"ok\":true}");
+      }
+    } else {
+      respond(fd, 400, "{\"error\":\"bad lock op\"}");
+    }
+  } else if (starts_with(req.path, "/counter/", &name)) {
+    if (req.method == "GET") {
+      respond(fd, 200,
+              "{\"value\":" + std::to_string(g_counters[name]) + "}");
+    } else {
+      long d = atol(req.form["delta"].c_str());
+      g_counters[name] += d;
+      plog('C', name, std::to_string(d));
+      respond(fd, 200,
+              "{\"value\":" + std::to_string(g_counters[name]) + "}");
+    }
+  } else if (starts_with(req.path, "/queue/", &name)) {
+    const std::string& op = req.form["op"];
+    auto& q = g_queues[name];
+    if (op == "enq") {
+      q.push_back(req.form["v"]);
+      plog('Q', name, req.form["v"]);
+      respond(fd, 200, "{\"ok\":true}");
+    } else if (op == "deq") {
+      if (q.empty()) {
+        respond(fd, 404, "{\"error\":\"empty\"}");
+      } else {
+        std::string v = q.front();
+        q.pop_front();
+        // At-least-once delivery: acknowledge BEFORE logging the
+        // removal, so a crash in the window re-delivers the element on
+        // replay (a duplicate, which total-queue tolerates) instead of
+        // losing it (which it must flag) — persisted restarts stay
+        // valid.
+        respond(fd, 200, "{\"v\":\"" + v + "\"}");
+        plog('R', name, v);
+      }
+    } else if (op == "drain") {
+      std::vector<std::string> vs(q.begin(), q.end());
+      q.clear();
+      respond(fd, 200, "{\"vs\":" + json_list(vs) + "}");
+      for (const auto& v : vs) plog('R', name, v);
+    } else {
+      respond(fd, 400, "{\"error\":\"bad queue op\"}");
+    }
+  } else if (starts_with(req.path, "/set/", &name)) {
+    if (req.method == "GET") {
+      std::vector<std::string> vs(g_sets[name].begin(),
+                                  g_sets[name].end());
+      respond(fd, 200, "{\"vs\":" + json_list(vs) + "}");
+    } else {
+      g_sets[name].insert(req.form["v"]);
+      plog('E', name, req.form["v"]);
+      respond(fd, 200, "{\"ok\":true}");
+    }
+  } else {
+    respond(fd, 400, "{\"errorCode\":400,\"message\":\"bad path\"}");
+  }
+}
+
+bool is_service_path(const std::string& p) {
+  return p == "/ids/next" || p.rfind("/lock/", 0) == 0 ||
+         p.rfind("/counter/", 0) == 0 || p.rfind("/queue/", 0) == 0 ||
+         p.rfind("/set/", 0) == 0;
+}
+
 void handle(int fd) {
   Request req;
   if (read_request(fd, &req)) {
@@ -169,6 +320,9 @@ void handle(int fd) {
     const std::string prefix = "/v2/keys/";
     if (req.path == "/health") {
       respond(fd, 200, "{\"health\":\"true\"}");
+    } else if (is_service_path(req.path)) {
+      std::lock_guard<std::mutex> lock(g_mu);
+      handle_service(fd, req);
     } else if (req.path.compare(0, prefix.size(), prefix) != 0) {
       respond(fd, 400, "{\"errorCode\":400,\"message\":\"bad path\"}");
     } else {

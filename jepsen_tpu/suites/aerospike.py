@@ -1,0 +1,61 @@
+"""Aerospike-family suite: counter workload.
+
+Mirrors the reference's counter test
+(aerospike/src/jepsen/aerospike/core.clj:400-421): concurrent
+increments and reads against one counter, checked by the bounds-window
+counter checker (checker.clj:321-374) — every ok read must lie within
+[sum of definitely-applied adds at invoke, sum of possibly-applied adds
+at completion].
+
+Local mode drives casd's /counter endpoints; a state-wiping restart
+zeroes the counter, so later reads fall below the lower bound — the
+seeded violation. Real-Aerospike automation (core.clj:80-130, including
+the faketime-skewed install) slots behind the DB protocol as in the
+etcd suite.
+"""
+from __future__ import annotations
+
+from .. import gen as g
+from ..ops.folds import counter_checker_tpu
+from .local_common import ServiceClient, service_test
+
+
+class CounterClient(ServiceClient):
+    """add / read over /counter/<name> (core.clj:231-258 client)."""
+
+    def invoke(self, test, op):
+        f = op["f"]
+
+        def body():
+            if f == "add":
+                self._req("POST", "/counter/jepsen",
+                          {"delta": op["value"]})
+                return {**op, "type": "ok"}
+            if f == "read":
+                r = self._req("GET", "/counter/jepsen")
+                return {**op, "type": "ok", "value": int(r["value"])}
+            raise ValueError(f"unknown op {f}")
+
+        return self.guarded(op, body, mutating=f == "add")
+
+
+def _counter_gen(test, process, ctx):
+    if ctx.rng.random() < 0.5:
+        return {"type": "invoke", "f": "add",
+                "value": 1 + ctx.rng.randrange(4)}
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def counter_workload(opts: dict) -> dict:
+    n_ops = opts.get("n_ops", 200)
+    return {
+        "generator": g.limit(n_ops, g.stagger(1 / 80, _counter_gen)),
+        "checker": counter_checker_tpu(),
+        "model": None,
+    }
+
+
+def aerospike_test(**opts) -> dict:
+    return service_test("aerospike-counter",
+                        CounterClient(opts.get("client_timeout", 0.5)),
+                        counter_workload(opts), **opts)

@@ -1,0 +1,69 @@
+"""Elasticsearch-family suite: set workload.
+
+Mirrors the reference's set test
+(elasticsearch/src/jepsen/system/elasticsearch.clj:204-253): concurrent
+adds of distinct integers, then one final read of the whole set,
+checked by the set checker's lost/unexpected/recovered accounting
+(checker.clj:131-178).
+
+Local mode drives casd's /set endpoints; a state-wiping restart loses
+acknowledged elements — the seeded ``lost`` violation. Real-server
+automation slots behind the DB protocol as in the etcd suite.
+"""
+from __future__ import annotations
+
+import threading
+
+from .. import gen as g
+from ..ops.folds import set_checker_tpu
+from .local_common import ServiceClient, service_test
+
+
+class SetClient(ServiceClient):
+    """add / read over /set/<name>."""
+
+    def invoke(self, test, op):
+        f = op["f"]
+
+        def body():
+            if f == "add":
+                self._req("POST", "/set/jepsen",
+                          {"op": "add", "v": op["value"]})
+                return {**op, "type": "ok"}
+            if f == "read":
+                r = self._req("GET", "/set/jepsen")
+                return {**op, "type": "ok",
+                        "value": [int(v) for v in r["vs"]]}
+            raise ValueError(f"unknown op {f}")
+
+        return self.guarded(op, body, mutating=f == "add")
+
+
+class _AddGen(g.Generator):
+    """Consecutive-int adds (each element attempted once)."""
+
+    def __init__(self):
+        self._i = -1
+        self._lock = threading.Lock()
+
+    def op(self, test, process, ctx):
+        with self._lock:
+            self._i += 1
+            return {"type": "invoke", "f": "add", "value": self._i}
+
+
+def set_workload(opts: dict) -> dict:
+    n_ops = opts.get("n_ops", 150)
+    main = g.limit(n_ops, g.stagger(1 / 80, _AddGen()))
+    final = g.once({"type": "invoke", "f": "read", "value": None})
+    return {
+        "generator": g.phases(main, final),
+        "checker": set_checker_tpu(),
+        "model": None,
+    }
+
+
+def elasticsearch_test(**opts) -> dict:
+    return service_test("elasticsearch-set",
+                        SetClient(opts.get("client_timeout", 0.5)),
+                        set_workload(opts), **opts)

@@ -1,0 +1,120 @@
+"""Hazelcast-family suite: lock (linearizable mutex), unique ids, and
+queue workloads selected by name — mirroring the reference's
+``:workload`` flag dispatch (hazelcast/src/jepsen/hazelcast.clj:278-304:
+lock -> knossos Mutex via checker/linearizable, id-gen ->
+checker/unique-ids, queue -> checker/total-queue).
+
+Local mode drives the casd daemon's /lock, /ids, /queue endpoints
+(resources/casd.cpp) — real processes under real kill/pause nemeses;
+a state-wiping restart double-grants a held lock, resets the id
+sequence (duplicate ids), and loses queued elements, each caught by
+its family's checker. Real-Hazelcast automation (the reference ships a
+server uberjar, hazelcast.clj:33-95) would slot behind the DB protocol
+exactly as EtcdDB does in the etcd suite.
+"""
+from __future__ import annotations
+
+import threading
+import urllib.error
+
+from .. import gen as g
+from ..checkers.core import compose
+from ..checkers.linearizable import linearizable
+from ..checkers.timeline import html_timeline
+from ..models.core import mutex
+from ..ops.folds import total_queue_checker_tpu, unique_ids_checker_tpu
+from .local_common import ServiceClient, service_test
+
+
+class LockClient(ServiceClient):
+    """Mutex over /lock/<name>: acquire/release with the calling
+    process as owner (hazelcast.clj:101-132 lock client semantics)."""
+
+    def invoke(self, test, op):
+        owner = str(op.get("process"))
+        form = {"op": op["f"], "owner": owner}
+
+        def body():
+            try:
+                self._req("POST", "/lock/jepsen", form)
+                return {**op, "type": "ok"}
+            except urllib.error.HTTPError as e:
+                if e.code == 409:
+                    return {**op, "type": "fail", "error": "rejected"}
+                raise
+
+        return self.guarded(op, body, mutating=True)
+
+
+class IdsClient(ServiceClient):
+    """Unique-id generation over /ids/next (hazelcast.clj:195-219)."""
+
+    def invoke(self, test, op):
+        def body():
+            body_json = self._req("POST", "/ids/next")
+            return {**op, "type": "ok", "value": body_json["id"]}
+
+        return self.guarded(op, body, mutating=True)
+
+
+class _AlternatingLockGen(g.Generator):
+    """Each thread alternates acquire -> release (the hazelcast lock
+    workload's per-process cycle, hazelcast.clj:285-287)."""
+
+    def __init__(self):
+        self._next = {}
+        self._lock = threading.Lock()
+
+    def op(self, test, process, ctx):
+        thread = ctx.thread_of(process)
+        with self._lock:
+            f = self._next.get(thread, "acquire")
+            self._next[thread] = "release" if f == "acquire" else "acquire"
+        return {"type": "invoke", "f": f, "value": None}
+
+
+def lock_workload(opts: dict) -> dict:
+    n_ops = opts.get("n_ops", 80)
+    return {
+        "generator": g.limit(n_ops, g.stagger(1 / 40,
+                                              _AlternatingLockGen())),
+        "checker": compose({
+            "linear": linearizable(
+                backend=opts.get("checker_backend", "tpu")),
+            "timeline": html_timeline(),
+        }),
+        "model": mutex(),
+    }
+
+
+def ids_workload(opts: dict) -> dict:
+    n_ops = opts.get("n_ops", 150)
+    gen = g.limit(n_ops, g.stagger(
+        1 / 100, lambda test, process, ctx: {"type": "invoke",
+                                             "f": "generate",
+                                             "value": None}))
+    return {"generator": gen,
+            "checker": unique_ids_checker_tpu(),
+            "model": None}
+
+
+def queue_workload(opts: dict) -> dict:
+    """Enqueue/dequeue mix then a drain phase — shared with the
+    rabbitmq suite, where it is the headline workload."""
+    from .rabbitmq import queue_workload as rq
+    return rq(opts)
+
+
+WORKLOADS = {"lock": lock_workload, "ids": ids_workload,
+             "queue": queue_workload}
+
+
+def hazelcast_test(workload: str = "lock", **opts) -> dict:
+    """Local-mode hazelcast-family test (workload dispatch mirroring
+    hazelcast.clj:278-304 + 340-343's --workload flag)."""
+    from .rabbitmq import QueueClient
+    clients = {"lock": LockClient, "ids": IdsClient, "queue": QueueClient}
+    w = WORKLOADS[workload](opts)
+    return service_test(f"hazelcast-{workload}",
+                        clients[workload](opts.get("client_timeout", 0.5)),
+                        w, **opts)

@@ -1,0 +1,118 @@
+"""Shared scaffold for the coordination-service suites.
+
+The reference covers its checker families with per-database suites
+(hazelcast lock/queue/ids, aerospike counter, rabbitmq queue+drain,
+elasticsearch set). Those servers are JVM artifacts this environment
+can't run; what the suites actually prove — each checker family
+detecting a seeded violation in histories recorded from *real
+processes* under *real fault injection* — is preserved by driving the
+same workloads against the compiled casd daemon's coordination
+endpoints (resources/casd.cpp): lock, unique ids, counter, queue,
+set. State is in-memory unless persisted, so the one kill+restart
+nemesis seeds a genuine violation in every family.
+
+Each suite module mirrors its reference counterpart's workload wiring
+and cites it; real-server automation slots behind the DB protocol the
+way EtcdDB does in the etcd suite.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from .. import gen as g
+from ..client import Client
+from ..os_ import NoopOS
+from ..testing import noop_test
+from .etcd import CasdDB, _casd_pauser, _casd_restarter, _with_nemesis
+
+
+class ServiceClient(Client):
+    """Base HTTP client for casd's coordination endpoints with the
+    etcd-suite error discipline (etcd.clj:101-136): timeouts and
+    mid-flight resets on mutating ops are :info (may have applied),
+    definite rejections and read faults are :fail."""
+
+    def __init__(self, timeout: float = 0.5):
+        self.timeout = timeout
+        self.base: Optional[str] = None
+        self.node = None
+
+    def setup(self, test, node):
+        cl = type(self)(self.timeout)
+        cl.node = node
+        urls = test.get("client_urls") or {}
+        cl.base = urls.get(node, f"http://{node}:2379")
+        return cl
+
+    def _req(self, method: str, path: str, form: Optional[dict] = None):
+        url = f"{self.base}{path}"
+        data = urllib.parse.urlencode(form).encode() \
+            if form is not None else b""
+        req = urllib.request.Request(
+            url, data=data if method == "POST" else None, method=method)
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read().decode())
+
+    def guarded(self, op: dict, body, *, mutating: bool) -> dict:
+        """Run ``body()`` (returns the completed op) under the standard
+        exception -> fail/info mapping."""
+        try:
+            return body()
+        except (socket.timeout, TimeoutError):
+            return {**op, "type": "info" if mutating else "fail",
+                    "error": "timeout"}
+        except (ConnectionError, urllib.error.URLError) as e:
+            reason = getattr(e, "reason", e)
+            if isinstance(reason, (socket.timeout, TimeoutError)):
+                return {**op, "type": "info" if mutating else "fail",
+                        "error": "timeout"}
+            if isinstance(reason, ConnectionRefusedError) or not mutating:
+                return {**op, "type": "fail", "error": str(reason)}
+            return {**op, "type": "info", "error": str(reason)}
+
+
+def service_test(name: str, client: Client, workload: dict,
+                 nemesis_mode: Optional[str] = None, persist: bool = True,
+                 **opts) -> dict:
+    """A local-mode suite test over real casd processes: same daemon
+    deploy / start-stop-daemon / nemesis wiring as etcd.casd_test, with
+    a suite-supplied client + workload (generator/checker/model)."""
+    n = opts.get("n_nodes", 1)
+    nodes = [f"n{i + 1}" for i in range(n)]
+    base = opts.get("base_port", 24790)
+    ports = {node: base + i for i, node in enumerate(nodes)}
+    db = CasdDB(persist=persist)
+    test = noop_test(
+        name=name,
+        nodes=nodes,
+        concurrency=opts.get("concurrency", 4),
+        ssh={"local": True},
+        os=NoopOS(),
+        db=db,
+        client=client,
+        casd_ports=ports,
+        casd_dir=opts.get("casd_dir", f"/tmp/jepsen/{name}"),
+        client_urls={node: f"http://127.0.0.1:{ports[nodes[0]]}"
+                     for node in nodes},
+        **workload)
+    if nemesis_mode == "pause":
+        test["nemesis"] = _casd_pauser(test)
+    elif nemesis_mode == "restart":
+        test["nemesis"] = _casd_restarter(db)
+    nem_gen = None
+    if test.get("nemesis"):
+        import itertools
+        cadence = opts.get("nemesis_cadence", 1.0)
+        nem_gen = g.seq(itertools.cycle([g.sleep(cadence),
+                                         {"type": "info", "f": "start"},
+                                         g.sleep(cadence),
+                                         {"type": "info", "f": "stop"}]))
+    _with_nemesis(test, nem_gen, opts.get("time_limit", 30))
+    test.update({k: v for k, v in opts.items()
+                 if k not in ("n_nodes", "concurrency", "name")})
+    return test

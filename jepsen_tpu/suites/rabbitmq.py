@@ -1,0 +1,76 @@
+"""Rabbitmq-family suite: queue workload with a drain phase.
+
+Mirrors the reference's queue test
+(rabbitmq/src/jepsen/rabbitmq.clj:118-186 client,
+rabbitmq/test/jepsen/rabbitmq_test.clj:46-77 wiring): an
+enqueue/dequeue mix followed by a drain that empties the queue, checked
+by BOTH the ordered-fold queue checker and total-queue multiset
+accounting (checker.clj:109-129, 214-271).
+
+Local mode drives casd's /queue endpoints; a state-wiping restart loses
+enqueued elements, which total-queue reports as ``lost``. Real-RabbitMQ
+automation (AMQP client + server install, rabbitmq.clj:24-66) slots
+behind the DB protocol as in the etcd suite.
+"""
+from __future__ import annotations
+
+import urllib.error
+
+from .. import gen as g
+from ..checkers.core import compose
+from ..ops.folds import queue_checker_tpu, total_queue_checker_tpu
+from .local_common import ServiceClient, service_test
+
+
+class QueueClient(ServiceClient):
+    """enqueue / dequeue / drain over /queue/<name>. Dequeue of an
+    empty queue is a definite :fail (the reference's empty-queue
+    convention); drain returns the remaining elements as one op, which
+    the total-queue checker expands into dequeue pairs."""
+
+    def invoke(self, test, op):
+        f = op["f"]
+
+        def body():
+            if f == "enqueue":
+                self._req("POST", "/queue/jepsen",
+                          {"op": "enq", "v": op["value"]})
+                return {**op, "type": "ok"}
+            if f == "dequeue":
+                try:
+                    r = self._req("POST", "/queue/jepsen", {"op": "deq"})
+                    return {**op, "type": "ok", "value": int(r["v"])}
+                except urllib.error.HTTPError as e:
+                    if e.code == 404:
+                        return {**op, "type": "fail", "error": "empty"}
+                    raise
+            if f == "drain":
+                r = self._req("POST", "/queue/jepsen", {"op": "drain"})
+                return {**op, "type": "ok",
+                        "value": [int(v) for v in r["vs"]]}
+            raise ValueError(f"unknown op {f}")
+
+        return self.guarded(op, body, mutating=f != "dequeue")
+
+
+def queue_workload(opts: dict) -> dict:
+    """Main mix (queue_gen: consecutive-int enqueues vs dequeues), then
+    one drain op once every thread is done (rabbitmq_test.clj:52-61's
+    gen/phases main -> drain shape)."""
+    n_ops = opts.get("n_ops", 120)
+    main = g.limit(n_ops, g.stagger(1 / 60, g.queue_gen()))
+    drain = g.once({"type": "invoke", "f": "drain", "value": None})
+    return {
+        "generator": g.phases(main, drain),
+        "checker": compose({
+            "queue": queue_checker_tpu(),
+            "total-queue": total_queue_checker_tpu(),
+        }),
+        "model": None,
+    }
+
+
+def rabbitmq_test(**opts) -> dict:
+    return service_test("rabbitmq-queue",
+                        QueueClient(opts.get("client_timeout", 0.5)),
+                        queue_workload(opts), **opts)

@@ -169,8 +169,6 @@ def test_set_fold_parity():
     got = check_sets_batch(hs)
     ref = [SetChecker().check({}, None, h) for h in hs]
     assert got == ref
-    assert {True, False} <= {r["valid"] for r in ref
-                             if r["valid"] != "unknown"} | {True, False}
     assert any(r["valid"] is False for r in ref)
     assert any(r["valid"] is True for r in ref)
 

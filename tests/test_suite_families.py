@@ -1,0 +1,159 @@
+"""Coordination-family suites end-to-end: every remaining checker
+family (mutex, unique-ids, queue/total-queue, counter, set) exercised
+against REAL casd processes with REAL kill/restart faults — healthy
+runs pass, state-wiping restarts produce violations each family's
+checker must catch (the role of the reference's hazelcast / aerospike /
+rabbitmq / elasticsearch suite tests)."""
+import shutil
+import subprocess
+
+import pytest
+
+from jepsen_tpu import store as store_mod
+from jepsen_tpu.runtime import run
+from jepsen_tpu.suites.aerospike import aerospike_test
+from jepsen_tpu.suites.elasticsearch import elasticsearch_test
+from jepsen_tpu.suites.hazelcast import hazelcast_test
+from jepsen_tpu.suites.rabbitmq import rabbitmq_test
+
+
+def run_stored(test, tmp_path):
+    store_mod.attach(test, store_mod.Store(tmp_path / "store"))
+    try:
+        return run(test)
+    finally:
+        test["store_handle"].stop_logging()
+
+
+def _cleanup():
+    subprocess.run(["bash", "-c", "pkill -9 -f '[c]asd --port' || true"],
+                   capture_output=True)
+    shutil.rmtree("/tmp/jepsen/hazelcast-lock", ignore_errors=True)
+
+
+@pytest.fixture(autouse=True)
+def clean_casd():
+    _cleanup()
+    yield
+    _cleanup()
+
+
+def _opts(tmp_path, port, **kw):
+    opts = dict(client_timeout=0.4, casd_dir=str(tmp_path / "casd"),
+                base_port=port, time_limit=12)
+    opts.update(kw)
+    return opts
+
+
+# ------------------------------------------------------------------ lock
+
+def test_lock_healthy_valid(tmp_path):
+    test = hazelcast_test("lock", persist=True,
+                          **_opts(tmp_path, 24700, n_ops=60))
+    r = run_stored(test, tmp_path)
+    assert r["results"]["valid"] is True, r["results"]
+    grants = [op for op in r["history"]
+              if op.type == "ok" and op.f == "acquire"]
+    assert len(grants) >= 5
+
+
+def test_lock_restart_double_grant_detected(tmp_path):
+    """Wiping the lock table while a client holds the lock lets a second
+    client acquire it: two holders, which the Mutex model rejects."""
+    test = hazelcast_test("lock", nemesis_mode="restart", persist=False,
+                          **_opts(tmp_path, 24710, n_ops=600,
+                                  nemesis_cadence=0.8, time_limit=8))
+    r = run_stored(test, tmp_path)
+    assert r["results"]["linear"]["valid"] is False, r["results"]
+
+
+# ------------------------------------------------------------------- ids
+
+def test_ids_healthy_valid(tmp_path):
+    test = hazelcast_test("ids", persist=True,
+                          **_opts(tmp_path, 24720, n_ops=120))
+    r = run_stored(test, tmp_path)
+    assert r["results"]["valid"] is True, r["results"]
+    assert r["results"]["acknowledged-count"] >= 50
+
+
+def test_ids_restart_duplicates_detected(tmp_path):
+    """A reset id sequence reissues ids: unique-ids must flag dups."""
+    test = hazelcast_test("ids", nemesis_mode="restart", persist=False,
+                          **_opts(tmp_path, 24730, n_ops=800,
+                                  nemesis_cadence=0.8, time_limit=6))
+    r = run_stored(test, tmp_path)
+    assert r["results"]["valid"] is False, r["results"]
+    assert r["results"]["duplicated-count"] > 0
+
+
+# ----------------------------------------------------------------- queue
+
+def test_queue_healthy_valid(tmp_path):
+    test = rabbitmq_test(persist=True, **_opts(tmp_path, 24740, n_ops=80))
+    r = run_stored(test, tmp_path)
+    assert r["results"]["valid"] is True, r["results"]
+    # the drain phase really ran and total-queue accounted for it
+    assert any(op.type == "ok" and op.f == "drain"
+               for op in r["history"])
+
+
+def test_queue_restart_with_persistence_stays_valid(tmp_path):
+    """Persisted queues deliver at-least-once across restarts: a crash
+    may re-deliver (duplicates, tolerated) but never lose, so
+    total-queue must stay valid under the same kill schedule that
+    breaks the non-persistent queue."""
+    test = rabbitmq_test(nemesis_mode="restart", persist=True,
+                         **_opts(tmp_path, 24755, n_ops=300,
+                                 nemesis_cadence=0.8, time_limit=6))
+    r = run_stored(test, tmp_path)
+    assert r["results"]["total-queue"]["valid"] is True, r["results"]
+
+
+def test_queue_restart_lost_elements_detected(tmp_path):
+    """Wiping the queue loses acknowledged enqueues: total-queue must
+    report them as lost."""
+    test = rabbitmq_test(nemesis_mode="restart", persist=False,
+                         **_opts(tmp_path, 24750, n_ops=500,
+                                 nemesis_cadence=0.8, time_limit=7))
+    r = run_stored(test, tmp_path)
+    assert r["results"]["total-queue"]["valid"] is False, r["results"]
+    assert r["results"]["total-queue"]["lost"]
+
+
+# --------------------------------------------------------------- counter
+
+def test_counter_healthy_valid(tmp_path):
+    test = aerospike_test(persist=True,
+                          **_opts(tmp_path, 24760, n_ops=150))
+    r = run_stored(test, tmp_path)
+    assert r["results"]["valid"] is True, r["results"]
+    assert len(r["results"]["reads"]) >= 10
+
+
+def test_counter_restart_underflow_detected(tmp_path):
+    """A zeroed counter reads below the sum of acknowledged adds."""
+    test = aerospike_test(nemesis_mode="restart", persist=False,
+                          **_opts(tmp_path, 24770, n_ops=700,
+                                  nemesis_cadence=0.8, time_limit=7))
+    r = run_stored(test, tmp_path)
+    assert r["results"]["valid"] is False, r["results"]
+    assert r["results"]["errors"]
+
+
+# ------------------------------------------------------------------- set
+
+def test_set_healthy_valid(tmp_path):
+    test = elasticsearch_test(persist=True,
+                              **_opts(tmp_path, 24780, n_ops=100))
+    r = run_stored(test, tmp_path)
+    assert r["results"]["valid"] is True, r["results"]
+
+
+def test_set_restart_lost_elements_detected(tmp_path):
+    test = elasticsearch_test(nemesis_mode="restart", persist=False,
+                              **_opts(tmp_path, 24785, n_ops=600,
+                                      nemesis_cadence=0.8, time_limit=7))
+    r = run_stored(test, tmp_path)
+    assert r["results"]["valid"] is False, r["results"]
+    assert r["results"]["lost"]
