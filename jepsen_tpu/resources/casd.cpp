@@ -57,7 +57,13 @@ std::map<std::string, std::string> g_locks;               // name -> owner
 std::map<std::string, long> g_counters;
 std::map<std::string, std::deque<std::string>> g_queues;
 std::map<std::string, std::set<std::string>> g_sets;
+std::map<std::string, std::map<long, long>> g_banks;      // name -> acct->bal
 long g_next_id = 0;
+// >0: transfers release the store lock between debit and credit for
+// this many ms — a deliberately seedable read-skew/lost-total race the
+// bank checker must catch (the violation cockroach's bank test hunts,
+// cockroachdb/src/jepsen/cockroach/bank.clj:112-143).
+int g_bank_split_ms = 0;
 long g_index = 0;
 std::string g_persist_path;
 int g_delay_ms = 0;
@@ -103,6 +109,19 @@ void replay() {
       if (it != q.end()) q.erase(it);
     } else if (op == "E") {
       g_sets[key].insert(value);
+    } else if (op == "B") {            // bank init "n_accounts:balance"
+      auto c = value.find(':');
+      long n = atol(value.c_str());
+      long bal = atol(value.c_str() + c + 1);
+      for (long a = 0; a < n; ++a) g_banks[key][a] = bal;
+    } else if (op == "T") {            // transfer "from:to:amount"
+      auto c1 = value.find(':');
+      auto c2 = value.find(':', c1 + 1);
+      long from = atol(value.c_str());
+      long to = atol(value.c_str() + c1 + 1);
+      long amount = atol(value.c_str() + c2 + 1);
+      g_banks[key][from] -= amount;
+      g_banks[key][to] += amount;
     }
     ++g_index;
   }
@@ -306,6 +325,64 @@ void handle_service(int fd, Request& req) {
   }
 }
 
+// Bank transfers manage g_mu themselves (the split-transfer race needs
+// to drop the lock mid-transaction).
+void handle_bank(int fd, Request& req, const std::string& name) {
+  const std::string& op = req.form["op"];
+  if (op == "init") {
+    long n = atol(req.form["accounts"].c_str());
+    long bal = atol(req.form["balance"].c_str());
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto& bank = g_banks[name];
+    if (bank.empty()) {
+      for (long a = 0; a < n; ++a) bank[a] = bal;
+      // One record for the whole init: replay can never restore a
+      // partial bank (which later idempotent inits would skip fixing).
+      plog('B', name, std::to_string(n) + ":" + std::to_string(bal));
+    }
+    respond(fd, 200, "{\"ok\":true}");
+  } else if (op == "transfer") {
+    long from = atol(req.form["from"].c_str());
+    long to = atol(req.form["to"].c_str());
+    long amount = atol(req.form["amount"].c_str());
+    std::unique_lock<std::mutex> lock(g_mu);
+    auto& bank = g_banks[name];
+    if (bank.find(from) == bank.end() || bank.find(to) == bank.end()) {
+      respond(fd, 404, "{\"error\":\"no such account\"}");
+      return;
+    }
+    if (bank[from] < amount) {
+      respond(fd, 409, "{\"error\":\"insufficient\"}");
+      return;
+    }
+    bank[from] -= amount;
+    if (g_bank_split_ms > 0) {
+      // the seeded isolation bug: another request can observe (or
+      // mutate) the mid-transfer state
+      lock.unlock();
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(g_bank_split_ms));
+      lock.lock();
+    }
+    bank[to] += amount;
+    plog('T', name, std::to_string(from) + ":" + std::to_string(to) +
+                        ":" + std::to_string(amount));
+    respond(fd, 200, "{\"ok\":true}");
+  } else {  // GET: atomic snapshot of all balances
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto& bank = g_banks[name];
+    std::ostringstream os;
+    os << "{\"balances\":{";
+    bool first = true;
+    for (const auto& kv : bank) {
+      os << (first ? "" : ",") << "\"" << kv.first << "\":" << kv.second;
+      first = false;
+    }
+    os << "}}";
+    respond(fd, 200, os.str());
+  }
+}
+
 bool is_service_path(const std::string& p) {
   return p == "/ids/next" || p.rfind("/lock/", 0) == 0 ||
          p.rfind("/counter/", 0) == 0 || p.rfind("/queue/", 0) == 0 ||
@@ -318,8 +395,11 @@ void handle(int fd) {
     if (g_delay_ms > 0)
       std::this_thread::sleep_for(std::chrono::milliseconds(g_delay_ms));
     const std::string prefix = "/v2/keys/";
+    std::string bank_name;
     if (req.path == "/health") {
       respond(fd, 200, "{\"health\":\"true\"}");
+    } else if (starts_with(req.path, "/bank/", &bank_name)) {
+      handle_bank(fd, req, bank_name);   // manages g_mu itself
     } else if (is_service_path(req.path)) {
       std::lock_guard<std::mutex> lock(g_mu);
       handle_service(fd, req);
@@ -372,6 +452,8 @@ int main(int argc, char** argv) {
     if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
     if (!strcmp(argv[i], "--persist")) g_persist_path = argv[i + 1];
     if (!strcmp(argv[i], "--delay-ms")) g_delay_ms = atoi(argv[i + 1]);
+    if (!strcmp(argv[i], "--bank-split-ms"))
+      g_bank_split_ms = atoi(argv[i + 1]);
   }
   replay();
   signal(SIGPIPE, SIG_IGN);

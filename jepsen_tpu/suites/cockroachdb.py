@@ -1,0 +1,178 @@
+"""Cockroachdb-family suite: the bank serializability workload and the
+nemesis-product sweep runner — north-star config #5.
+
+Mirrors the reference's richest suite:
+
+  * bank workload + balance-sum checker
+    (cockroachdb/src/jepsen/cockroach/bank.clj:112-143): concurrent
+    transfers between accounts plus whole-bank reads; under
+    serializable isolation every read's balances sum to the invariant
+    total and never go negative — a short sum is read skew, a negative
+    balance a lost update.
+  * product sweep runner (cockroachdb/src/jepsen/cockroach/runner.clj:
+    94-138): build and run one test per combination of named option
+    lists (the reference sweeps nemesis x nemesis2 pairs), aggregate
+    validity across the product.
+
+Local mode drives casd's /bank endpoints. The daemon's transfers are
+atomic by default; the ``--bank-split-ms N`` flag releases the store
+lock between debit and credit for N ms — a REAL isolation bug
+(mid-transfer state observable), which is the seeded violation the
+checker must catch. Real-CockroachDB automation (JDBC client +
+cluster install, cockroach.clj:136-164) slots behind the DB protocol
+as in the etcd suite.
+"""
+from __future__ import annotations
+
+import itertools
+import urllib.error
+
+from .. import gen as g
+from ..checkers.core import Checker, merge_valid
+from .local_common import ServiceClient, service_test
+
+
+class BankClient(ServiceClient):
+    """transfer / read over /bank/<name> (bank.clj:55-110 client). The
+    first client setup initializes the accounts (idempotent server
+    side)."""
+
+    def __init__(self, timeout: float = 0.5, accounts: int = 5,
+                 balance: int = 10):
+        super().__init__(timeout)
+        self.accounts = accounts
+        self.balance = balance
+
+    def setup(self, test, node):
+        cl = super().setup(test, node)
+        cl.accounts = self.accounts
+        cl.balance = self.balance
+        cl._req("POST", "/bank/jepsen",
+                {"op": "init", "accounts": cl.accounts,
+                 "balance": cl.balance})
+        return cl
+
+    def invoke(self, test, op):
+        f = op["f"]
+
+        def body():
+            if f == "transfer":
+                v = op["value"]
+                try:
+                    self._req("POST", "/bank/jepsen",
+                              {"op": "transfer", "from": v["from"],
+                               "to": v["to"], "amount": v["amount"]})
+                    return {**op, "type": "ok"}
+                except urllib.error.HTTPError as e:
+                    if e.code == 409:     # insufficient funds: no-op
+                        return {**op, "type": "fail",
+                                "error": "insufficient"}
+                    if e.code == 404:
+                        return {**op, "type": "fail",
+                                "error": "no-such-account"}
+                    raise
+            if f == "read":
+                r = self._req("GET", "/bank/jepsen")
+                balances = {int(k): int(vv)
+                            for k, vv in r["balances"].items()}
+                return {**op, "type": "ok", "value": balances}
+            raise ValueError(f"unknown op {f}")
+
+        return self.guarded(op, body, mutating=f == "transfer")
+
+
+class BankChecker(Checker):
+    """Balance-sum invariant over ok reads (bank.clj:112-143): every
+    read must see exactly ``accounts`` balances summing to the constant
+    total, none negative."""
+
+    def __init__(self, accounts: int = 5, balance: int = 10):
+        self.accounts = accounts
+        self.total = accounts * balance
+
+    def check(self, test, model, history, opts=None) -> dict:
+        bad = []
+        n_reads = 0
+        for op in history:
+            if not (op.type == "ok" and op.f == "read"
+                    and isinstance(op.value, dict)):
+                continue
+            n_reads += 1
+            balances = op.value
+            err = None
+            if len(balances) != self.accounts:
+                err = f"{len(balances)} accounts, expected {self.accounts}"
+            elif sum(balances.values()) != self.total:
+                err = (f"total {sum(balances.values())}, "
+                       f"expected {self.total}")
+            elif any(b < 0 for b in balances.values()):
+                err = "negative balance"
+            if err:
+                bad.append({"op": op.to_dict(), "error": err})
+        if n_reads == 0:
+            return {"valid": "unknown", "error": "bank was never read"}
+        return {"valid": not bad, "reads": n_reads,
+                "bad-reads": bad[:10],
+                "bad-read-count": len(bad)}
+
+
+def _transfer_gen(accounts: int, max_amount: int):
+    def gen(test, process, ctx):
+        if ctx.rng.random() < 0.6:
+            a = ctx.rng.randrange(accounts)
+            b = ctx.rng.randrange(accounts - 1)
+            if b >= a:
+                b += 1
+            return {"type": "invoke", "f": "transfer",
+                    "value": {"from": a, "to": b,
+                              "amount": 1 + ctx.rng.randrange(max_amount)}}
+        return {"type": "invoke", "f": "read", "value": None}
+
+    return gen
+
+
+def bank_workload(opts: dict) -> dict:
+    accounts = opts.get("accounts", 5)
+    balance = opts.get("balance", 10)
+    n_ops = opts.get("n_ops", 300)
+    return {
+        "generator": g.limit(n_ops, g.stagger(
+            1 / 100, _transfer_gen(accounts,
+                                   opts.get("max_amount", 5)))),
+        "checker": BankChecker(accounts, balance),
+        "model": None,
+    }
+
+
+def bank_test(split_ms: int = 0, **opts) -> dict:
+    """The local bank test; ``split_ms > 0`` seeds the non-atomic
+    transfer race the checker must catch."""
+    daemon_args = (["--bank-split-ms", str(split_ms)] if split_ms else [])
+    return service_test(
+        "cockroach-bank",
+        BankClient(opts.get("client_timeout", 0.5),
+                   opts.get("accounts", 5), opts.get("balance", 10)),
+        bank_workload(opts), daemon_args=daemon_args, **opts)
+
+
+def product_sweep(build_test, dimensions: dict, run_fn=None) -> dict:
+    """Run one test per combination of named option lists and aggregate
+    validity — the reference's nemesis-product runner
+    (runner.clj:94-138), generalized to arbitrary option dimensions.
+
+    ``build_test(**combo)`` must return a test map. Returns
+    {"valid", "runs": {label: results}}; the label encodes the combo.
+    """
+    if run_fn is None:
+        from ..runtime import run as run_fn
+    keys = list(dimensions)
+    runs = {}
+    for combo in itertools.product(*(dimensions[k] for k in keys)):
+        combo_opts = dict(zip(keys, combo))
+        label = ",".join(f"{k}={v}" for k, v in combo_opts.items())
+        runs[label] = run_fn(build_test(**combo_opts))["results"]
+    return {
+        "valid": merge_valid(r["valid"] for r in runs.values())
+        if runs else True,
+        "runs": runs,
+    }

@@ -109,8 +109,9 @@ class CasdDB(DB):
     node and run it under start-stop-daemon. One instance per logical
     node, ports from test["casd_ports"]."""
 
-    def __init__(self, persist: bool = True):
+    def __init__(self, persist: bool = True, extra_args=()):
         self.persist = persist
+        self.extra_args = list(extra_args)
 
     def _dir(self, test, node) -> str:
         return f"{test.get('casd_dir', '/tmp/jepsen/casd')}/{node}"
@@ -127,6 +128,7 @@ class CasdDB(DB):
         args = ["--port", port]
         if self.persist:
             args += ["--persist", f"{d}/casd.wal"]
+        args += self.extra_args
         cu.start_daemon(
             {"logfile": f"{d}/casd.log", "pidfile": f"{d}/casd.pid",
              "chdir": d},

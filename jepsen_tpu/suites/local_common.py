@@ -78,15 +78,17 @@ class ServiceClient(Client):
 
 def service_test(name: str, client: Client, workload: dict,
                  nemesis_mode: Optional[str] = None, persist: bool = True,
-                 **opts) -> dict:
+                 daemon_args=(), **opts) -> dict:
     """A local-mode suite test over real casd processes: same daemon
     deploy / start-stop-daemon / nemesis wiring as etcd.casd_test, with
-    a suite-supplied client + workload (generator/checker/model)."""
+    a suite-supplied client + workload (generator/checker/model).
+    ``daemon_args``: extra casd flags (fault-seeding knobs like
+    --bank-split-ms)."""
     n = opts.get("n_nodes", 1)
     nodes = [f"n{i + 1}" for i in range(n)]
     base = opts.get("base_port", 24790)
     ports = {node: base + i for i, node in enumerate(nodes)}
-    db = CasdDB(persist=persist)
+    db = CasdDB(persist=persist, extra_args=daemon_args)
     test = noop_test(
         name=name,
         nodes=nodes,

@@ -1,0 +1,93 @@
+"""Bank suite end-to-end (north-star #5): concurrent transfers against
+a real daemon; the balance-sum checker passes atomic transfers and
+catches the seeded split-transfer isolation bug; the product sweep
+runner aggregates validity over option combinations."""
+import shutil
+import subprocess
+
+import pytest
+
+from jepsen_tpu import store as store_mod
+from jepsen_tpu.runtime import run
+from jepsen_tpu.suites.cockroachdb import bank_test, product_sweep
+
+
+def _cleanup():
+    subprocess.run(["bash", "-c", "pkill -9 -f '[c]asd --port' || true"],
+                   capture_output=True)
+    shutil.rmtree("/tmp/jepsen/cockroach-bank", ignore_errors=True)
+
+
+@pytest.fixture(autouse=True)
+def clean_casd():
+    _cleanup()
+    yield
+    _cleanup()
+
+
+def _opts(tmp_path, port, **kw):
+    opts = dict(client_timeout=0.5, casd_dir=str(tmp_path / "casd"),
+                base_port=port, time_limit=15)
+    opts.update(kw)
+    return opts
+
+
+def test_bank_healthy_valid(tmp_path):
+    test = bank_test(**_opts(tmp_path, 25000, n_ops=250))
+    r = run(test)
+    assert r["results"]["valid"] is True, r["results"]
+    assert r["results"]["reads"] >= 20
+    transfers = sum(1 for op in r["history"]
+                    if op.type == "ok" and op.f == "transfer")
+    assert transfers >= 20
+
+
+def test_bank_split_transfer_detected_invalid(tmp_path):
+    """With the daemon's lock released mid-transfer, reads observe the
+    debited-but-not-credited state: the balance total comes up short."""
+    test = bank_test(split_ms=10, **_opts(tmp_path, 25010, n_ops=400))
+    r = run(test)
+    assert r["results"]["valid"] is False, r["results"]
+    bad = r["results"]["bad-reads"]
+    assert bad and "total" in bad[0]["error"]
+
+
+def test_bank_pause_nemesis_stays_valid(tmp_path):
+    """SIGSTOP faults cause timeouts but no invariant violation when
+    transfers are atomic."""
+    test = bank_test(nemesis_mode="pause",
+                     **_opts(tmp_path, 25020, n_ops=400,
+                             nemesis_cadence=1.0, time_limit=6))
+    r = run(test)
+    assert r["results"]["valid"] is True, r["results"]
+
+
+def test_bank_restart_with_persistence_stays_valid(tmp_path):
+    """Kill -9 + restart replays the WAL (one-record init + transfer
+    log): the invariant holds across real process deaths."""
+    test = bank_test(nemesis_mode="restart", persist=True,
+                     **_opts(tmp_path, 25025, n_ops=400,
+                             nemesis_cadence=0.9, time_limit=6))
+    r = run(test)
+    assert r["results"]["valid"] is True, r["results"]
+
+
+def test_product_sweep(tmp_path):
+    """The runner sweeps the (split_ms x nemesis) product and aggregates
+    validity: the atomic combos pass, the split combos fail, the whole
+    sweep is therefore invalid (runner.clj:94-138 discipline)."""
+    ports = iter([25030, 25040, 25050, 25060])
+
+    def build(split_ms, nemesis_mode):
+        return bank_test(split_ms=split_ms, nemesis_mode=nemesis_mode,
+                         **_opts(tmp_path, next(ports), n_ops=250,
+                                 nemesis_cadence=1.0, time_limit=5,
+                                 casd_dir=str(tmp_path / "casd" /
+                                              f"s{split_ms}-{nemesis_mode}")))
+
+    out = product_sweep(build, {"split_ms": [0, 10],
+                                "nemesis_mode": [None, "pause"]})
+    assert out["valid"] is False
+    assert len(out["runs"]) == 4
+    assert out["runs"]["split_ms=0,nemesis_mode=None"]["valid"] is True
+    assert out["runs"]["split_ms=10,nemesis_mode=None"]["valid"] is False
