@@ -1,0 +1,13 @@
+#!/bin/sh
+# Generate the shared keypair (once) onto the ssh-keys volume, relax
+# host-key checking for the test network, then idle for exec sessions.
+[ -f /root/.ssh/id_rsa ] || ssh-keygen -t rsa -N "" -f /root/.ssh/id_rsa
+cat > /root/.ssh/config <<EOF
+Host n1 n2 n3 n4 n5
+  User root
+  StrictHostKeyChecking no
+  UserKnownHostsFile /dev/null
+EOF
+chmod 600 /root/.ssh/config
+echo "control ready; db nodes: n1..n5"
+exec sleep infinity

@@ -1,0 +1,8 @@
+#!/bin/sh
+# Install the control node's public key once it appears on the shared
+# volume, then run sshd in the foreground.
+mkdir -p /root/.ssh
+( while [ ! -f /root/.ssh-shared/id_rsa.pub ]; do sleep 1; done
+  cat /root/.ssh-shared/id_rsa.pub >> /root/.ssh/authorized_keys
+  chmod 600 /root/.ssh/authorized_keys ) &
+exec /usr/sbin/sshd -D

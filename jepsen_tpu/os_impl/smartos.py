@@ -1,0 +1,126 @@
+"""SmartOS OS automation (jepsen/src/jepsen/os/smartos.clj): pkgin
+package management and base setup — the pkgin analog of the debian
+module, completing the reference's OS matrix (mongodb-smartos etc.)."""
+from __future__ import annotations
+
+import logging
+import re
+import time
+from typing import Dict, Optional, Sequence, Union
+
+from ..control.core import RemoteError, exec_, lit, su
+from ..control.util import meh
+from ..os_ import OS
+
+log = logging.getLogger("jepsen.os.smartos")
+
+BASE_PACKAGES = ["curl", "vim", "unzip", "gcc", "rsyslog", "logrotate"]
+
+
+def setup_hostfile() -> None:
+    """Ensure /etc/hosts has a loopback entry for the local hostname
+    (smartos.clj setup-hostfile!)."""
+    name = exec_("hostname")
+    hosts = exec_("cat", "/etc/hosts")
+    out = []
+    for line in hosts.split("\n"):
+        if line.startswith("127.0.0.1\t") and name not in line:
+            line = f"{line} {name}"
+        out.append(line)
+    with su():
+        exec_("echo", "\n".join(out), lit(">"), "/etc/hosts")
+
+
+def time_since_last_update() -> int:
+    """Seconds since the last pkgin update (smartos.clj)."""
+    now = int(exec_("date", "+%s"))
+    return now - int(exec_("stat", "-c", "%Y", "/var/db/pkgin/sql.log"))
+
+
+def update() -> None:
+    with su():
+        exec_("pkgin", "update")
+
+
+def maybe_update() -> None:
+    """pkgin update if the cache is over a day old."""
+    try:
+        if time_since_last_update() > 86400:
+            update()
+    except RemoteError:
+        update()
+
+
+def _installed_pairs():
+    """[(name, version)] of every installed package, parsed from
+    ``pkgin -p list``'s name-version;comment lines."""
+    out = exec_("pkgin", "-p", "list")
+    pairs = []
+    for line in out.split("\n"):
+        full = line.split(";", 1)[0]
+        m = re.match(r"(.*)-([^-]+)$", full)
+        if m:
+            pairs.append((m.group(1), m.group(2)))
+    return pairs
+
+
+def installed(packages: Sequence[str]) -> set:
+    """Which of these pkgin packages are installed?"""
+    want = set(packages)
+    return {name for name, _ in _installed_pairs() if name in want}
+
+
+def installed_version(package: str) -> Optional[str]:
+    for name, version in _installed_pairs():
+        if name == package:
+            return version
+    return None
+
+
+def uninstall(packages) -> None:
+    if isinstance(packages, str):
+        packages = [packages]
+    present = installed(packages)
+    if present:
+        with su():
+            exec_("pkgin", "-y", "remove", *sorted(present))
+
+
+def install(packages: Union[Sequence[str], Dict[str, str]]) -> None:
+    """Ensure packages are installed: a flat list installs any version;
+    a {package: version} map pins versions (smartos.clj install)."""
+    if isinstance(packages, dict):
+        versions = dict(_installed_pairs())   # one round trip for all
+        for pkg, version in packages.items():
+            if versions.get(pkg) != version:
+                log.info("installing %s-%s", pkg, version)
+                with su():
+                    exec_("pkgin", "-y", "install", f"{pkg}-{version}")
+        return
+    if isinstance(packages, str):
+        packages = [packages]
+    got = installed(packages)                 # one round trip for the lot
+    missing = [p for p in packages if p not in got]
+    if missing:
+        log.info("installing %s", missing)
+        with su():
+            exec_("pkgin", "-y", "install", *missing)
+
+
+class SmartOS(OS):
+    """Base-package setup + hostfile + network heal (smartos.clj os)."""
+
+    def setup(self, test, node):
+        log.info("%s setting up smartos", node)
+        setup_hostfile()
+        maybe_update()
+        install(BASE_PACKAGES)
+        net = test.get("net")
+        if net is not None:
+            meh(net.heal, test)
+
+    def teardown(self, test, node):
+        pass
+
+
+os = SmartOS()

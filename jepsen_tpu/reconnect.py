@@ -1,0 +1,190 @@
+"""Auto-reconnecting client connection wrappers.
+
+The runtime seam JDBC-style suite clients need
+(jepsen/src/jepsen/reconnect.clj): a Wrapper owns one live connection
+shared by many worker threads; ``with_conn`` hands the current
+connection out under a read lock, and any exception inside the block
+closes and reopens the connection (under the write lock) before
+rethrowing — so the op that hit the fault still fails/infos, but the
+next op gets a fresh connection instead of a poisoned one
+(reconnect.clj:92-129).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Optional
+
+log = logging.getLogger("jepsen.reconnect")
+
+
+class RWLock:
+    """A writer-preferring reader/writer lock (the reference uses a
+    ReentrantReadWriteLock; many threads may hold a connection at once,
+    open/close/reopen exclude them all)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    def acquire_read(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer = True
+
+    def release_write(self):
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+    @contextmanager
+    def read(self):
+        self.acquire_read()
+        try:
+            yield
+        finally:
+            self.release_read()
+
+    @contextmanager
+    def write(self):
+        self.acquire_write()
+        try:
+            yield
+        finally:
+            self.release_write()
+
+
+class Wrapper:
+    """A stateful auto-reconnecting holder for one client connection
+    (reconnect.clj:16-31)."""
+
+    def __init__(self, open: Callable[[], Any],
+                 close: Optional[Callable[[Any], None]] = None,
+                 name: Optional[str] = None, log_reconnects: bool = True):
+        assert callable(open)
+        self._open = open
+        self._close = close or (lambda conn: None)
+        self.name = name
+        self.log_reconnects = log_reconnects
+        self._lock = RWLock()
+        self._conn: Any = None
+
+    def conn(self):
+        """The active connection, if one exists."""
+        return self._conn
+
+    def open(self) -> "Wrapper":
+        """Open a connection; no-op when already open
+        (reconnect.clj:54-63)."""
+        with self._lock.write():
+            if self._conn is None:
+                conn = self._open()
+                if conn is None:
+                    raise RuntimeError(
+                        f"open() returned None for wrapper {self.name}")
+                self._conn = conn
+        return self
+
+    def close(self) -> "Wrapper":
+        """Close the connection, if open (reconnect.clj:65-72)."""
+        with self._lock.write():
+            if self._conn is not None:
+                try:
+                    self._close(self._conn)
+                finally:
+                    self._conn = None
+        return self
+
+    def reopen(self) -> "Wrapper":
+        """Close and open atomically — one reconnect even when many
+        threads hit the same fault (reconnect.clj:74-90)."""
+        with self._lock.write():
+            if self.log_reconnects:
+                log.info("reconnecting %s", self.name or "client")
+            if self._conn is not None:
+                try:
+                    self._close(self._conn)
+                except Exception:
+                    pass
+                self._conn = None
+            conn = self._open()
+            if conn is None:
+                raise RuntimeError(
+                    f"open() returned None for wrapper {self.name}")
+            self._conn = conn
+        return self
+
+    @contextmanager
+    def with_conn(self):
+        """Yield the current connection under the read lock; on ANY
+        exception, close + reopen the connection and rethrow
+        (reconnect.clj:92-129). Callers still see the failure — the op
+        maps to fail/info as usual — but the next op gets a live
+        connection."""
+        self._lock.acquire_read()
+        conn = self._conn
+        if conn is None:
+            self._lock.release_read()
+            self.open()
+            self._lock.acquire_read()
+            conn = self._conn
+        held = True
+        try:
+            try:
+                yield conn
+            except BaseException as e:
+                self._lock.release_read()
+                held = False
+                if isinstance(e, Exception):
+                    self._reopen_after_error(conn)
+                raise
+        finally:
+            if held:
+                self._lock.release_read()
+
+    def _reopen_after_error(self, conn) -> None:
+        """Close + reopen after a failure on ``conn`` — but only if it
+        is still the current connection (another thread may have
+        reconnected already). A failed reopen leaves the wrapper closed
+        so the next with_conn attempts a fresh open."""
+        with self._lock.write():
+            if self._conn is not conn:
+                return
+            if self.log_reconnects:
+                log.info("reconnecting %s after error",
+                         self.name or "client")
+            try:
+                self._close(conn)
+            except Exception:
+                pass
+            self._conn = None
+            try:
+                self._conn = self._open()
+            except Exception:
+                log.warning("reconnect of %s failed", self.name,
+                            exc_info=True)
+
+
+def wrapper(open: Callable[[], Any], close=None, name=None,
+            log_reconnects: bool = True) -> Wrapper:
+    return Wrapper(open, close, name, log_reconnects)

@@ -1,0 +1,30 @@
+"""Interactive-session helpers (jepsen/src/jepsen/repl.clj): reload the
+most recent stored run for poking at histories and re-checking."""
+from __future__ import annotations
+
+from typing import Optional
+
+from .store import DEFAULT, Store
+
+
+def last_test(test_name: Optional[str] = None,
+              store: Optional[Store] = None) -> dict:
+    """Rehydrate the latest stored run — of one test, or of any test
+    (repl.clj:6-13). The returned map carries "history" (Op list) and
+    "results"; feed the history back to any checker or
+    store.recheck/check_batch_columnar for re-analysis."""
+    store = store or DEFAULT
+    if test_name is not None:
+        return store.load(test_name, "latest")
+    names = store.tests()
+    if not names:
+        raise FileNotFoundError(f"no stored runs under {store.base}")
+    # store/latest points at the most recent run of any test; it can
+    # dangle after deletes, in which case fall back to the newest
+    # timestamp across tests.
+    latest = (store.base / "latest").resolve()
+    if latest.is_dir():
+        return store.load(latest.parent.name, latest.name)
+    name, ts = max(((n, t) for n, runs in names.items() for t in runs),
+                   key=lambda p: p[1])
+    return store.load(name, ts)

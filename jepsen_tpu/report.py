@@ -1,0 +1,32 @@
+"""Reporting helpers (jepsen/src/jepsen/report.clj): capture stdout
+into a store file while still printing it."""
+from __future__ import annotations
+
+import sys
+from contextlib import contextmanager
+
+
+class _Tee:
+    def __init__(self, *streams):
+        self._streams = streams
+
+    def write(self, s):
+        for st in self._streams:
+            st.write(s)
+
+    def flush(self):
+        for st in self._streams:
+            st.flush()
+
+
+@contextmanager
+def to(path: str):
+    """Everything printed inside the block goes to ``path`` AND stdout
+    (report.clj:7-16's `to` macro)."""
+    with open(path, "w") as f:
+        old = sys.stdout
+        sys.stdout = _Tee(old, f)
+        try:
+            yield
+        finally:
+            sys.stdout = old

@@ -1,0 +1,188 @@
+"""Auxiliary parity modules: reconnect wrapper, SmartOS OS, report.to,
+repl.last_test."""
+import threading
+
+import pytest
+
+from jepsen_tpu.control.core import session, with_session
+from jepsen_tpu.reconnect import Wrapper, wrapper
+
+
+# ------------------------------------------------------------ reconnect
+
+class FlakyConn:
+    def __init__(self, gen):
+        self.gen = gen
+        self.closed = False
+
+
+def test_wrapper_opens_lazily_and_reconnects_on_error():
+    opens = []
+
+    def open_():
+        c = FlakyConn(len(opens))
+        opens.append(c)
+        return c
+
+    closed = []
+    w = wrapper(open_, close=lambda c: closed.append(c), name="t")
+    assert w.conn() is None
+    with w.with_conn() as c:
+        assert c.gen == 0
+    assert len(opens) == 1
+
+    with pytest.raises(RuntimeError):
+        with w.with_conn() as c:
+            raise RuntimeError("connection reset")
+    # the failed conn was closed and a fresh one opened; the error
+    # still propagated to the caller
+    assert closed == [opens[0]]
+    assert len(opens) == 2
+    with w.with_conn() as c:
+        assert c.gen == 1
+
+
+def test_wrapper_single_reopen_under_concurrent_failures():
+    """Many threads failing on the SAME connection trigger one
+    reconnect, not a thundering herd (reconnect.clj's write lock)."""
+    opens = []
+    lock = threading.Lock()
+
+    def open_():
+        with lock:
+            opens.append(object())
+            return opens[-1]
+
+    w = Wrapper(open_, name="herd")
+    w.open()
+    barrier = threading.Barrier(8)
+    errs = []
+
+    def worker():
+        try:
+            with w.with_conn():
+                # every thread holds the SAME conn before any fails
+                barrier.wait()
+                raise ValueError("boom")
+        except ValueError:
+            errs.append(1)
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(errs) == 8
+    # 1 initial + exactly 1 reopen (all failures saw the same conn)
+    assert len(opens) == 2
+
+
+def test_wrapper_failed_reopen_recovers_on_next_use():
+    """If the DB is down when the reconnect fires, the wrapper is left
+    closed and the next with_conn attempts a fresh open."""
+    state = {"up": True, "opens": 0}
+
+    def open_():
+        if not state["up"]:
+            raise ConnectionError("db down")
+        state["opens"] += 1
+        return state["opens"]
+
+    w = Wrapper(open_, name="downy", log_reconnects=False)
+    with w.with_conn() as c:
+        assert c == state["opens"]
+    state["up"] = False
+    with pytest.raises(ValueError):
+        with w.with_conn():
+            raise ValueError("fault")   # reconnect fails silently
+    assert w.conn() is None
+    state["up"] = True
+    with w.with_conn() as c2:
+        assert c2 == state["opens"]
+
+
+def test_wrapper_explicit_lifecycle():
+    opens = []
+    w = Wrapper(lambda: opens.append(1) or len(opens), name="x")
+    w.open()
+    w.open()                      # no-op when open
+    assert len(opens) == 1
+    w.reopen()
+    assert len(opens) == 2
+    w.close()
+    assert w.conn() is None
+
+
+# -------------------------------------------------------------- smartos
+
+PKGIN_LIST = ("curl-8.4.0;HTTP client\n"
+              "gcc13-13.2.0;GNU compiler\n"
+              "vim-9.0.2121;editor\n")
+
+
+def test_smartos_install_only_missing():
+    from jepsen_tpu.os_impl import smartos
+
+    def responder(host, cmd):
+        if "pkgin -p list" in cmd:
+            return PKGIN_LIST, "", 0
+        return "", "", 0
+
+    s = session("n1", {"dummy": True}, responder)
+    with with_session("n1", s):
+        assert smartos.installed(["curl", "vim", "rsyslog"]) == \
+            {"curl", "vim"}
+        assert smartos.installed_version("curl") == "8.4.0"
+        assert smartos.installed_version("rsyslog") is None
+        smartos.install(["curl", "rsyslog"])
+    joined = "\n".join(s.transport.commands)
+    assert "pkgin -y install rsyslog" in joined
+    assert "install curl" not in joined
+
+
+def test_smartos_versioned_install():
+    from jepsen_tpu.os_impl import smartos
+
+    def responder(host, cmd):
+        if "pkgin -p list" in cmd:
+            return PKGIN_LIST, "", 0
+        return "", "", 0
+
+    s = session("n1", {"dummy": True}, responder)
+    with with_session("n1", s):
+        smartos.install({"curl": "8.4.0", "wget": "1.21"})
+    joined = "\n".join(s.transport.commands)
+    assert "pkgin -y install wget-1.21" in joined
+    assert "curl-8.4.0" not in joined   # already at that version
+
+
+# ---------------------------------------------------------- report/repl
+
+def test_report_to_tees_stdout(tmp_path, capsys):
+    from jepsen_tpu.report import to
+    p = tmp_path / "out.txt"
+    with to(str(p)):
+        print("hello report")
+    assert "hello report" in p.read_text()
+    assert "hello report" in capsys.readouterr().out
+
+
+def test_repl_last_test(tmp_path):
+    from jepsen_tpu.history.ops import invoke_op, ok_op
+    from jepsen_tpu.repl import last_test
+    from jepsen_tpu.store import Store
+    store = Store(tmp_path / "store")
+    h = store.create("demo", ts="t1")
+    h.save_history([invoke_op(0, "read", None), ok_op(0, "read", 1)])
+    h.save_results({"valid": True})
+    out = last_test(store=store)
+    assert out["results"]["valid"] is True
+    assert len(out["history"]) == 2
+    assert last_test("demo", store=store)["results"]["valid"] is True
+    with pytest.raises(FileNotFoundError):
+        last_test(store=Store(tmp_path / "empty"))
+    # a dangling store/latest symlink falls back to the newest run
+    h2 = store.create("demo2", ts="t2")
+    h2.save_history([invoke_op(0, "read", None)])
+    store.delete("demo2", "t2")          # latest now dangles
+    assert len(last_test(store=store)["history"]) == 2
