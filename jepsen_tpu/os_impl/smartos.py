@@ -115,6 +115,10 @@ class SmartOS(OS):
         setup_hostfile()
         maybe_update()
         install(BASE_PACKAGES)
+        # The ipfilter nemesis needs the service enabled (stock SmartOS
+        # ships it disabled).
+        with su():
+            exec_("svcadm", "enable", "-r", "ipfilter")
         net = test.get("net")
         if net is not None:
             meh(net.heal, test)

@@ -143,9 +143,16 @@ class Wrapper:
         connection."""
         self._lock.acquire_read()
         conn = self._conn
-        if conn is None:
+        # A concurrent failed reopen can null _conn between our open()
+        # and re-acquiring the read lock; retry rather than yield None.
+        attempts = 0
+        while conn is None:
             self._lock.release_read()
-            self.open()
+            attempts += 1
+            if attempts > 3:
+                raise ConnectionError(
+                    f"could not obtain a connection for {self.name}")
+            self.open()            # raises when the DB stays down
             self._lock.acquire_read()
             conn = self._conn
         held = True

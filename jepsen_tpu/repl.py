@@ -15,6 +15,9 @@ def last_test(test_name: Optional[str] = None,
     store.recheck/check_batch_columnar for re-analysis."""
     store = store or DEFAULT
     if test_name is not None:
+        if not store.run_dir(test_name, "latest").exists():
+            raise FileNotFoundError(
+                f"no stored runs for {test_name!r} under {store.base}")
         return store.load(test_name, "latest")
     names = store.tests()
     if not names:

@@ -59,6 +59,10 @@ std::map<std::string, std::deque<std::string>> g_queues;
 std::map<std::string, std::set<std::string>> g_sets;
 std::map<std::string, std::map<long, long>> g_banks;      // name -> acct->bal
 long g_next_id = 0;
+long g_next_ts = 0;                 // monotonic timestamp oracle
+std::map<std::string, std::string> g_kv;       // consul-style KV
+std::map<std::string, long> g_kv_index;        // per-key ModifyIndex
+long g_kv_counter = 0;
 // >0: transfers release the store lock between debit and credit for
 // this many ms — a deliberately seedable read-skew/lost-total race the
 // bank checker must catch (the violation cockroach's bank test hunts,
@@ -68,9 +72,50 @@ long g_index = 0;
 std::string g_persist_path;
 int g_delay_ms = 0;
 
+const char* B64 =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+std::string b64_encode(const std::string& in) {
+  std::string out;
+  int val = 0, bits = -6;
+  for (unsigned char c : in) {
+    val = (val << 8) + c;
+    bits += 8;
+    while (bits >= 0) {
+      out += B64[(val >> bits) & 0x3F];
+      bits -= 6;
+    }
+  }
+  if (bits > -6) out += B64[((val << 8) >> (bits + 8)) & 0x3F];
+  while (out.size() % 4) out += '=';
+  return out;
+}
+
+std::string b64_decode(const std::string& in) {
+  static int rev[256];
+  static bool init = false;
+  if (!init) {
+    for (int i = 0; i < 256; ++i) rev[i] = -1;
+    for (int i = 0; i < 64; ++i) rev[(unsigned char)B64[i]] = i;
+    init = true;
+  }
+  std::string out;
+  int val = 0, bits = -8;
+  for (unsigned char c : in) {
+    if (rev[c] == -1) break;
+    val = (val << 6) + rev[c];
+    bits += 6;
+    if (bits >= 0) {
+      out += (char)((val >> bits) & 0xFF);
+      bits -= 8;
+    }
+  }
+  return out;
+}
+
 // Append one replayable record. Codes: S/D kv set/delete, L/U lock
-// acquire/release, I id grant, C counter add, Q/R queue enq/deq,
-// E set add.
+// acquire/release, I id grant, Z timestamp grant, K/X consul-kv
+// set(b64)/delete, C counter add, Q/R queue enq/deq, E set add.
 void plog(char code, const std::string& a, const std::string& b) {
   if (g_persist_path.empty()) return;
   std::ofstream f(g_persist_path, std::ios::app);
@@ -99,6 +144,14 @@ void replay() {
       g_locks.erase(key);
     } else if (op == "I") {
       ++g_next_id;
+    } else if (op == "Z") {
+      ++g_next_ts;
+    } else if (op == "K") {          // consul kv set, value b64
+      g_kv[key] = b64_decode(value);
+      g_kv_index[key] = ++g_kv_counter;
+    } else if (op == "X") {          // consul kv delete
+      g_kv.erase(key);
+      g_kv_index.erase(key);
     } else if (op == "C") {
       g_counters[key] += atol(value.c_str());
     } else if (op == "Q") {
@@ -247,6 +300,46 @@ void handle_service(int fd, Request& req) {
     long id = g_next_id++;
     plog('I', "-", "-");
     respond(fd, 200, "{\"id\":" + std::to_string(id) + "}");
+  } else if (req.path == "/ts/next") {
+    long ts = g_next_ts++;
+    plog('Z', "-", "-");
+    respond(fd, 200, "{\"ts\":" + std::to_string(ts) + "}");
+  } else if (starts_with(req.path, "/v1/kv/", &name)) {
+    // consul KV subset: base64 values, index-based check-and-set.
+    auto it = g_kv.find(name);
+    if (req.method == "GET") {
+      if (it == g_kv.end()) {
+        respond(fd, 404, "[]");
+      } else {
+        long idx = g_kv_index[name];
+        respond(fd, 200,
+                "[{\"CreateIndex\":" + std::to_string(idx) +
+                    ",\"ModifyIndex\":" + std::to_string(idx) +
+                    ",\"Key\":\"" + name + "\",\"Flags\":0,\"Value\":\"" +
+                    b64_encode(it->second) + "\"}]");
+      }
+    } else if (req.method == "PUT") {
+      auto cas = req.form.find("cas");
+      if (cas != req.form.end()) {
+        long want = atol(cas->second.c_str());
+        long have = it == g_kv.end() ? 0 : g_kv_index[name];
+        if (want != have) {
+          respond(fd, 200, "false");
+          return;
+        }
+      }
+      g_kv[name] = req.body;
+      g_kv_index[name] = ++g_kv_counter;
+      plog('K', name, b64_encode(req.body));
+      respond(fd, 200, "true");
+    } else if (req.method == "DELETE") {
+      g_kv.erase(name);
+      g_kv_index.erase(name);
+      plog('X', name, "-");
+      respond(fd, 200, "true");
+    } else {
+      respond(fd, 400, "{\"error\":\"bad method\"}");
+    }
   } else if (starts_with(req.path, "/lock/", &name)) {
     const std::string& op = req.form["op"];
     const std::string& owner = req.form["owner"];
@@ -384,7 +477,8 @@ void handle_bank(int fd, Request& req, const std::string& name) {
 }
 
 bool is_service_path(const std::string& p) {
-  return p == "/ids/next" || p.rfind("/lock/", 0) == 0 ||
+  return p == "/ids/next" || p == "/ts/next" ||
+         p.rfind("/v1/kv/", 0) == 0 || p.rfind("/lock/", 0) == 0 ||
          p.rfind("/counter/", 0) == 0 || p.rfind("/queue/", 0) == 0 ||
          p.rfind("/set/", 0) == 0;
 }

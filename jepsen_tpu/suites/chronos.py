@@ -1,0 +1,136 @@
+"""Chronos-family suite: job-scheduler run verification.
+
+Mirrors the reference's only suite-local checker namespace
+(chronos/src/jepsen/chronos/checker.clj): jobs are registered with a
+start time, a run count, an interval, a tardiness allowance (epsilon),
+and a duration; the scheduler must begin one run inside every expected
+target window. The reference solves the target→run assignment with a
+constraint solver (loco); targets and runs are sorted intervals of
+uniform width, so greedy earliest-run matching over targets in end
+order is an exact maximum matching here (classic interval scheduling
+exchange argument) — no solver needed.
+
+Checker inputs come from the history: ok ``add-job`` ops carry job
+dicts, and a final ok ``read`` carries {"time": T, "runs": [{"name",
+"start", "end"}...]} (the shape chronos' read phase produces). All
+times are seconds (floats ok).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..checkers.core import Checker, merge_valid
+
+# The reference lets the scheduler miss deadlines by a few extra
+# seconds (checker.clj epsilon-forgiveness).
+EPSILON_FORGIVENESS = 5
+
+
+@dataclass(frozen=True)
+class Job:
+    name: object
+    start: float        # first target time
+    count: int          # how many runs are scheduled
+    interval: float     # seconds between targets
+    epsilon: float      # allowed tardiness per run
+    duration: float     # how long a run takes
+
+    @classmethod
+    def from_value(cls, v: dict) -> "Job":
+        return cls(name=v["name"], start=v["start"], count=v["count"],
+                   interval=v["interval"], epsilon=v["epsilon"],
+                   duration=v["duration"])
+
+
+def job_targets(read_time: float, job: Job) -> List[Tuple[float, float]]:
+    """[(start, latest-allowed-start)] for every target that MUST have
+    begun by the read (checker.clj job->targets): targets may start up
+    to epsilon late and need duration to finish, so only targets before
+    read_time - epsilon - duration are due."""
+    finish = read_time - job.epsilon - job.duration
+    out = []
+    for k in range(job.count):
+        t = job.start + k * job.interval
+        if t >= finish:
+            break
+        out.append((t, t + job.epsilon + EPSILON_FORGIVENESS))
+    return out
+
+
+def job_solution(read_time: float, job: Job,
+                 runs: Sequence[dict]) -> dict:
+    """Match this job's complete runs to its due targets
+    (checker.clj job-solution). Greedy earliest-available-run per
+    target in order is an exact maximum matching for uniform sorted
+    windows. Returns {"valid", "job", "solution", "extra",
+    "complete", "incomplete"}."""
+    complete = sorted((r for r in runs if r.get("end") is not None),
+                      key=lambda r: r["start"])
+    incomplete = sorted((r for r in runs if r.get("end") is None),
+                        key=lambda r: r["start"])
+    targets = job_targets(read_time, job)
+    used = [False] * len(complete)
+    solution: Dict[Tuple[float, float], Optional[dict]] = {}
+    valid = True
+    for lo, hi in targets:
+        found = None
+        for i, r in enumerate(complete):
+            if used[i]:
+                continue
+            if r["start"] > hi:
+                break
+            if r["start"] >= lo:
+                found = i
+                break
+        if found is None:
+            valid = False
+            solution[(lo, hi)] = None
+        else:
+            used[found] = True
+            solution[(lo, hi)] = complete[found]
+    extra = [r for i, r in enumerate(complete) if not used[i]]
+    return {"valid": valid, "job": job, "solution": solution,
+            "extra": extra, "complete": complete,
+            "incomplete": incomplete}
+
+
+def solution(read_time: float, jobs: Sequence[Job],
+             runs: Sequence[dict]) -> dict:
+    """Partition jobs and runs by name and solve each
+    (checker.clj solution)."""
+    by_name: Dict[object, List[dict]] = {}
+    for r in runs:
+        by_name.setdefault(r["name"], []).append(r)
+    sols = {j.name: job_solution(read_time, j, by_name.get(j.name, []))
+            for j in jobs}
+    return {
+        "valid": all(s["valid"] for s in sols.values()),
+        "jobs": sols,
+        "extra": [r for s in sols.values() for r in s["extra"]],
+        "incomplete": [r for s in sols.values() for r in s["incomplete"]],
+        "read_time": read_time,
+    }
+
+
+class ChronosChecker(Checker):
+    """History-level wrapper: collect ok add-job ops and the final ok
+    read of {"time", "runs"}, then verify the schedule."""
+
+    def check(self, test, model, history, opts=None) -> dict:
+        jobs = [Job.from_value(op.value) for op in history
+                if op.type == "ok" and op.f == "add-job"]
+        final = None
+        for op in history:
+            if op.type == "ok" and op.f == "read":
+                final = op.value
+        if final is None:
+            return {"valid": "unknown",
+                    "error": "schedule was never read"}
+        out = solution(final["time"], jobs, final["runs"])
+        out["valid"] = merge_valid([out["valid"]])
+        return out
+
+
+def chronos_checker() -> Checker:
+    return ChronosChecker()

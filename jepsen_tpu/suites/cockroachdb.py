@@ -155,6 +155,71 @@ def bank_test(split_ms: int = 0, **opts) -> dict:
         bank_workload(opts), daemon_args=daemon_args, **opts)
 
 
+class TimestampClient(ServiceClient):
+    """Monotonic-timestamp grants over /ts/next (the role of cockroach's
+    hybrid-logical-clock reads in monotonic.clj)."""
+
+    def invoke(self, test, op):
+        def body():
+            r = self._req("POST", "/ts/next")
+            return {**op, "type": "ok", "value": int(r["ts"])}
+
+        return self.guarded(op, body, mutating=True)
+
+
+class MonotonicChecker(Checker):
+    """Real-time monotonicity (cockroachdb/src/jepsen/cockroach/
+    monotonic.clj:163+): an ok-granted timestamp must exceed every
+    timestamp granted by ops that COMPLETED before this op was invoked.
+    Concurrent grants may complete out of order — that's fine; going
+    backwards across a real-time boundary is the violation (a reset
+    clock/oracle)."""
+
+    def check(self, test, model, history, opts=None) -> dict:
+        cur_max = None
+        floor: dict = {}     # process -> max completed ts at its invoke
+        bad = []
+        n = 0
+        for op in history:
+            if op.f != "ts" or not op.is_client:
+                continue
+            if op.type == "invoke":
+                floor[op.process] = cur_max
+            elif op.type == "ok":
+                lo = floor.pop(op.process, None)
+                n += 1
+                if lo is not None and op.value is not None \
+                        and op.value <= lo:
+                    bad.append({"op": op.to_dict(), "floor": lo})
+                if cur_max is None or (op.value is not None
+                                       and op.value > cur_max):
+                    cur_max = op.value
+        return {"valid": not bad, "grants": n,
+                "regressions": bad[:10], "regression-count": len(bad)}
+
+
+def monotonic_workload(opts: dict) -> dict:
+    n_ops = opts.get("n_ops", 200)
+    return {
+        "generator": g.limit(n_ops, g.stagger(
+            1 / 100, lambda test, process, ctx: {"type": "invoke",
+                                                 "f": "ts",
+                                                 "value": None})),
+        "checker": MonotonicChecker(),
+        "model": None,
+    }
+
+
+def monotonic_test(**opts) -> dict:
+    """Timestamp-oracle monotonicity test; a state-wiping restart
+    resets the oracle, and post-restart grants regress below completed
+    pre-restart grants — the seeded violation."""
+    return service_test(
+        "cockroach-monotonic",
+        TimestampClient(opts.get("client_timeout", 0.5)),
+        monotonic_workload(opts), **opts)
+
+
 def product_sweep(build_test, dimensions: dict, run_fn=None) -> dict:
     """Run one test per combination of named option lists and aggregate
     validity — the reference's nemesis-product runner

@@ -1,0 +1,104 @@
+"""Consul suite: CAS register over the v1 KV HTTP API.
+
+Mirrors the reference suite (consul/src/jepsen/consul.clj): values are
+JSON-encoded and base64-wrapped in responses; CAS is index-based — read
+the key's ModifyIndex, then conditional-PUT with ``?cas=<index>``
+(consul.clj:101-110's consul-cas!). The workload/checker wiring is the
+etcd suite's independent-keys CAS register (the two suites share the
+family, consul.clj:141-179).
+
+Local mode drives casd's /v1/kv emulation of the same API subset, so
+the client's wire handling (base64, index CAS, 404-as-absent) is
+exercised against a real server; real-Consul automation (agent
+bootstrap, consul.clj:21-54) slots behind the DB protocol as in the
+etcd suite.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import urllib.error
+
+from .. import independent
+from ..suites import etcd as etcd_suite
+from .local_common import ServiceClient, service_test
+
+
+class ConsulClient(ServiceClient):
+    """CAS register over /v1/kv with consul's error discipline: reads
+    are side-effect free (any fault -> fail); a definite CAS index
+    mismatch is fail; network indeterminacy on PUTs is info."""
+
+    def _key(self, k) -> str:
+        return f"/v1/kv/jepsen-{k}"
+
+    def _get(self, k):
+        """(value, modify_index) or (ABSENT, 0) when the key is
+        missing."""
+        try:
+            rows = self._req("GET", self._key(k))
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return etcd_suite.ABSENT, 0
+            raise
+        row = rows[0]
+        value = json.loads(base64.b64decode(row["Value"]).decode())
+        return value, int(row["ModifyIndex"])
+
+    def _put(self, k, v, cas=None) -> bool:
+        import urllib.request
+        url = f"{self.base}{self._key(k)}"
+        if cas is not None:
+            url += f"?cas={cas}"
+        req = urllib.request.Request(url, data=json.dumps(v).encode(),
+                                     method="PUT")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return r.read().decode().strip() == "true"
+
+    def invoke(self, test, op):
+        f = op["f"]
+        k, v = op["value"] if independent.is_kv(op["value"]) \
+            else (None, op["value"])
+
+        def done(typ, value=v, **extra):
+            out = {**op, "type": typ, **extra}
+            out["value"] = independent.tuple_(k, value) if k is not None \
+                else value
+            return out
+
+        def body():
+            if f == "read":
+                value, _ = self._get(k)
+                return done("ok", value)
+            if f == "write":
+                self._put(k, v)
+                return done("ok")
+            if f == "cas":
+                old, new = v
+                # index CAS: read the current value + index first; the
+                # read phase has no side effects, so faults there are
+                # still a definite fail (handled by the outer guard
+                # only for the mutating PUT below).
+                try:
+                    cur, index = self._get(k)
+                except Exception:
+                    return done("fail", error="read-phase")
+                if cur != old:
+                    return done("fail", error="value-mismatch")
+                ok = self._put(k, new, cas=index)
+                return done("ok") if ok else \
+                    done("fail", error="index-mismatch")
+            raise ValueError(f"unknown op {f}")
+
+        return self.guarded(op, body, mutating=f != "read")
+
+
+def consul_test(**opts) -> dict:
+    """Independent-keys CAS over the consul KV wire protocol
+    (consul.clj:141-179 wiring, etcd-family workload). service_test
+    derives/validates concurrency from threads_per_key."""
+    opts.setdefault("threads_per_key", 2)
+    return service_test(
+        "consul",
+        ConsulClient(opts.get("client_timeout", 0.5)),
+        etcd_suite.workload(opts), **opts)

@@ -89,6 +89,16 @@ def service_test(name: str, client: Client, workload: dict,
     base = opts.get("base_port", 24790)
     ports = {node: base + i for i, node in enumerate(nodes)}
     db = CasdDB(persist=persist, extra_args=daemon_args)
+    # Independent-keys workloads need concurrency to be a multiple of
+    # the thread-group size; derive/validate once for every suite.
+    tpk = opts.get("threads_per_key")
+    if tpk:
+        conc = opts.get("concurrency", tpk * max(1, -(-2 * n // tpk)))
+        if conc % tpk != 0:
+            raise ValueError(
+                f"concurrency ({conc}) must be a multiple of "
+                f"threads_per_key ({tpk})")
+        opts["concurrency"] = conc
     test = noop_test(
         name=name,
         nodes=nodes,

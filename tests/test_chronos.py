@@ -1,0 +1,108 @@
+"""Chronos job-scheduler checker truth tables (mirroring the coverage
+of chronos/test/jepsen/chronos/checker_test.clj: satisfied schedules,
+missed targets, tardiness forgiveness, incomplete runs, extras, and
+not-yet-due targets)."""
+from jepsen_tpu.history.core import index
+from jepsen_tpu.history.ops import invoke_op, ok_op
+from jepsen_tpu.suites.chronos import (EPSILON_FORGIVENESS, ChronosChecker,
+                                       Job, job_solution, job_targets,
+                                       solution)
+
+JOB = Job(name="j1", start=0, count=3, interval=10, epsilon=2, duration=1)
+
+
+def run(start, end="auto", name="j1"):
+    return {"name": name, "start": start,
+            "end": (start + 1 if end == "auto" else end)}
+
+
+def test_targets_due_and_undue():
+    # read at 40: targets 0,10,20 are due; at 22.5 only 0,10 are
+    # (20 >= 22.5 - epsilon - duration = 19.5 is NOT due)
+    assert job_targets(40, JOB) == [(0, 2 + EPSILON_FORGIVENESS),
+                                    (10, 12 + EPSILON_FORGIVENESS),
+                                    (20, 22 + EPSILON_FORGIVENESS)]
+    assert len(job_targets(22.5, JOB)) == 2
+    # count bounds the schedule even for late reads
+    assert len(job_targets(1000, JOB)) == 3
+
+
+def test_perfect_schedule_valid():
+    s = job_solution(40, JOB, [run(0.5), run(10.1), run(21)])
+    assert s["valid"] is True
+    assert s["extra"] == []
+    assert all(v is not None for v in s["solution"].values())
+
+
+def test_missing_run_invalid():
+    s = job_solution(40, JOB, [run(0.5), run(21)])
+    assert s["valid"] is False
+    assert s["solution"][(10, 12 + EPSILON_FORGIVENESS)] is None
+
+
+def test_tardiness_forgiveness_boundary():
+    # epsilon 2 + forgiveness 5: a run at t+6.9 passes, t+7.1 fails
+    ok = job_solution(40, JOB, [run(0.1), run(16.9), run(20.2)])
+    assert ok["valid"] is True
+    late = job_solution(40, JOB, [run(0.1), run(17.1), run(20.2)])
+    assert late["valid"] is False
+
+
+def test_incomplete_runs_dont_satisfy():
+    s = job_solution(40, JOB, [run(0.5), run(10.1, end=None), run(21)])
+    assert s["valid"] is False
+    assert len(s["incomplete"]) == 1
+
+
+def test_extra_runs_reported_but_valid():
+    s = job_solution(40, JOB, [run(0.5), run(1.0), run(10.1), run(21)])
+    assert s["valid"] is True
+    assert len(s["extra"]) == 1
+
+
+def test_one_run_cannot_satisfy_two_targets():
+    # overlapping-window shape: interval smaller than the window width
+    j = Job(name="t", start=0, count=2, interval=3, epsilon=2, duration=0)
+    # windows: [0, 7] and [3, 10] — one run at 4 could sit in either,
+    # but both targets need their own run
+    s = job_solution(40, j, [{"name": "t", "start": 4, "end": 5}])
+    assert s["valid"] is False
+    ok = job_solution(40, j, [{"name": "t", "start": 4, "end": 5},
+                              {"name": "t", "start": 6, "end": 7}])
+    assert ok["valid"] is True
+
+
+def test_multi_job_solution():
+    j2 = Job(name="j2", start=5, count=1, interval=10, epsilon=2,
+             duration=1)
+    out = solution(40, [JOB, j2],
+                   [run(0.5), run(10.1), run(21),
+                    {"name": "j2", "start": 5.5, "end": 6.5}])
+    assert out["valid"] is True
+    out2 = solution(40, [JOB, j2], [run(0.5), run(10.1), run(21)])
+    assert out2["valid"] is False
+    assert out2["jobs"]["j2"]["valid"] is False
+
+
+def test_checker_over_history():
+    h = index([
+        invoke_op(0, "add-job", None),
+        ok_op(0, "add-job", {"name": "j1", "start": 0, "count": 2,
+                             "interval": 10, "epsilon": 2,
+                             "duration": 1}),
+        invoke_op(1, "read", None),
+        ok_op(1, "read", {"time": 30,
+                          "runs": [run(0.5), run(10.5)]}),
+    ])
+    assert ChronosChecker().check({}, None, h)["valid"] is True
+    h_missing = index([
+        invoke_op(0, "add-job", None),
+        ok_op(0, "add-job", {"name": "j1", "start": 0, "count": 2,
+                             "interval": 10, "epsilon": 2,
+                             "duration": 1}),
+        invoke_op(1, "read", None),
+        ok_op(1, "read", {"time": 30, "runs": [run(0.5)]}),
+    ])
+    assert ChronosChecker().check({}, None, h_missing)["valid"] is False
+    h_unread = index([invoke_op(0, "add-job", None)])
+    assert ChronosChecker().check({}, None, h_unread)["valid"] == "unknown"

@@ -1,0 +1,82 @@
+"""Consul (v1/kv wire CAS) and monotonic-timestamp suites end-to-end
+against real casd processes."""
+import shutil
+import subprocess
+
+import pytest
+
+from jepsen_tpu.runtime import run
+from jepsen_tpu.suites.cockroachdb import monotonic_test
+from jepsen_tpu.suites.consul import consul_test
+
+
+def _cleanup():
+    subprocess.run(["bash", "-c", "pkill -9 -f '[c]asd --port' || true"],
+                   capture_output=True)
+    shutil.rmtree("/tmp/jepsen/consul", ignore_errors=True)
+
+
+@pytest.fixture(autouse=True)
+def clean_casd():
+    _cleanup()
+    yield
+    _cleanup()
+
+
+def _opts(tmp_path, port, **kw):
+    opts = dict(client_timeout=0.4, casd_dir=str(tmp_path / "casd"),
+                base_port=port, time_limit=12)
+    opts.update(kw)
+    return opts
+
+
+def test_consul_healthy_valid(tmp_path):
+    test = consul_test(persist=True,
+                       **_opts(tmp_path, 25100, ops_per_key=40))
+    r = run(test)
+    assert r["results"]["independent"]["valid"] is True, r["results"]
+    # index-CAS really succeeded over the wire
+    cas_ok = sum(1 for op in r["history"]
+                 if op.type == "ok" and op.f == "cas")
+    assert cas_ok >= 1
+
+
+def test_consul_restart_detected_invalid(tmp_path):
+    """A state-wiping restart makes post-restart reads observe ABSENT
+    after acknowledged writes — a linearizability violation over the
+    consul wire protocol."""
+    test = consul_test(nemesis_mode="restart", persist=False,
+                       **_opts(tmp_path, 25110, ops_per_key=200,
+                               n_values=3, nemesis_cadence=1.0,
+                               time_limit=8))
+    r = run(test)
+    assert r["results"]["independent"]["valid"] is False, r["results"]
+
+
+def test_monotonic_healthy_valid(tmp_path):
+    test = monotonic_test(persist=True,
+                          **_opts(tmp_path, 25120, n_ops=150))
+    r = run(test)
+    assert r["results"]["valid"] is True, r["results"]
+    assert r["results"]["grants"] >= 50
+
+
+def test_monotonic_restart_regression_detected(tmp_path):
+    """A reset timestamp oracle grants below completed pre-restart
+    grants: the real-time monotonicity checker must flag it."""
+    test = monotonic_test(nemesis_mode="restart", persist=False,
+                          **_opts(tmp_path, 25130, n_ops=800,
+                                  nemesis_cadence=0.8, time_limit=6))
+    r = run(test)
+    assert r["results"]["valid"] is False, r["results"]
+    assert r["results"]["regression-count"] > 0
+
+
+def test_monotonic_restart_with_persistence_stays_valid(tmp_path):
+    """The persisted oracle replays its grant log: timestamps keep
+    rising across kill+restart."""
+    test = monotonic_test(nemesis_mode="restart", persist=True,
+                          **_opts(tmp_path, 25140, n_ops=500,
+                                  nemesis_cadence=0.9, time_limit=5))
+    r = run(test)
+    assert r["results"]["valid"] is True, r["results"]
