@@ -81,23 +81,26 @@ def test_opts_to_map(opts: argparse.Namespace) -> dict:
     }
 
 
-def _run_test_cmd(opts: argparse.Namespace, test_fn: Callable) -> int:
+def _run_built_test(test: dict, no_store: bool) -> bool:
+    """Run one built test with store/log lifecycle; True iff valid."""
     from . import runtime, store as store_mod
+    if not no_store:
+        store_mod.attach(test)
+    handle = test.get("store_handle")
+    try:
+        test = runtime.run(test)
+    finally:
+        if handle is not None:
+            handle.stop_logging()
+    return (test.get("results") or {}).get("valid") is True
 
+
+def _run_test_cmd(opts: argparse.Namespace, test_fn: Callable) -> int:
     base = test_opts_to_map(opts)
     for i in range(opts.test_count):
         # Suite flags ride along raw; the parsed/normalized test opts win.
         test = test_fn({**vars(opts), **base, "run_index": i})
-        if not opts.no_store:
-            store_mod.attach(test)
-        handle = test.get("store_handle")
-        try:
-            test = runtime.run(test)
-        finally:
-            if handle is not None:
-                handle.stop_logging()
-        valid = (test.get("results") or {}).get("valid")
-        if valid is not True:
+        if not _run_built_test(test, opts.no_store):
             return 1
     return 0
 
@@ -170,8 +173,134 @@ def run_cli(subcommands: Dict[str, dict],
         sys.exit(255)
 
 
+# ------------------------------------------------------ suite registry
+
+# Options forwarded from the CLI to suite test builders (everything
+# else on the namespace is harness plumbing). Every key here has a
+# matching flag in suite_cmd.
+SUITE_OPT_KEYS = ("time_limit", "nemesis_mode", "persist", "n_ops",
+                  "ops_per_key", "threads_per_key", "n_nodes",
+                  "base_port", "casd_dir", "nemesis_cadence", "n_values",
+                  "split_ms", "accounts", "seed")
+
+
+def suite_registry() -> Dict[str, Callable]:
+    """Named local-mode test builders (the reference reaches suites via
+    per-project lein runners; one registry serves the same role here).
+    The real-cluster etcd suite additionally consumes --nodes/--ssh."""
+    from .suites import (aerospike, cockroachdb, consul, elasticsearch,
+                         etcd, hazelcast, rabbitmq)
+    return {
+        "etcd": lambda kw: etcd.etcd_test(**kw),
+        "etcd-casd": lambda kw: etcd.casd_test(**kw),
+        "hazelcast-lock": lambda kw: hazelcast.hazelcast_test("lock", **kw),
+        "hazelcast-ids": lambda kw: hazelcast.hazelcast_test("ids", **kw),
+        "hazelcast-queue": lambda kw: hazelcast.hazelcast_test("queue",
+                                                               **kw),
+        "rabbitmq": lambda kw: rabbitmq.rabbitmq_test(**kw),
+        "aerospike": lambda kw: aerospike.aerospike_test(**kw),
+        "elasticsearch": lambda kw: elasticsearch.elasticsearch_test(**kw),
+        "consul": lambda kw: consul.consul_test(**kw),
+        "bank": lambda kw: cockroachdb.bank_test(**kw),
+        "monotonic": lambda kw: cockroachdb.monotonic_test(**kw),
+    }
+
+
+def suite_cmd() -> dict:
+    """``test --suite NAME``: build and run a registered suite,
+    honoring --test-count and the exit-code contract. Suite defaults
+    win unless a flag is passed explicitly (the local suites derive
+    their own concurrency/ports)."""
+    def add_opts(p):
+        add_test_opts(p)
+        p.add_argument("--suite", required=True,
+                       choices=sorted(suite_registry()),
+                       help="Which suite to run")
+        p.add_argument("--nemesis", dest="nemesis_mode", default=None,
+                       choices=["pause", "restart"],
+                       help="Fault schedule (local suites)")
+        p.add_argument("--no-persist", dest="persist",
+                       action="store_false", default=True,
+                       help="In-memory daemon state (restarts wipe)")
+        p.add_argument("--n-ops", dest="n_ops", type=int, default=None)
+        p.add_argument("--ops-per-key", dest="ops_per_key", type=int,
+                       default=None)
+        p.add_argument("--threads-per-key", dest="threads_per_key",
+                       type=int, default=None)
+        p.add_argument("--n-nodes", dest="n_nodes", type=int,
+                       default=None)
+        p.add_argument("--base-port", dest="base_port", type=int,
+                       default=None)
+        p.add_argument("--casd-dir", dest="casd_dir", default=None)
+        p.add_argument("--nemesis-cadence", dest="nemesis_cadence",
+                       type=float, default=None,
+                       help="Seconds between fault start/stop ops")
+        p.add_argument("--n-values", dest="n_values", type=int,
+                       default=None, help="Register value domain size")
+        p.add_argument("--split-ms", dest="split_ms", type=int,
+                       default=None,
+                       help="bank: seed the split-transfer race")
+        p.add_argument("--accounts", dest="accounts", type=int,
+                       default=None, help="bank: number of accounts")
+        # Suites pick their own concurrency unless the user insists.
+        p.set_defaults(concurrency=None, time_limit=None)
+
+    def run(opts):
+        d = vars(opts)
+        name = d["suite"]
+        kw = {k: d[k] for k in SUITE_OPT_KEYS
+              if d.get(k) is not None and k != "concurrency"}
+        if d.get("concurrency") is not None:
+            kw["concurrency"] = parse_concurrency(
+                d["concurrency"], d.get("n_nodes") or 1)
+        if name == "etcd":   # the real-cluster suite takes node/ssh opts
+            opts.concurrency = d.get("concurrency") or "3n"
+            opts.time_limit = d.get("time_limit") or 60.0
+            m = test_opts_to_map(opts)
+            kw.update(nodes=m["nodes"], ssh=m["ssh"],
+                      concurrency=m["concurrency"],
+                      time_limit=m["time_limit"])
+        builder = suite_registry()[name]
+        for _ in range(d["test_count"]):
+            if not _run_built_test(builder(dict(kw)), d["no_store"]):
+                return 1
+        return 0
+
+    return {"test": {"add_opts": add_opts, "run": run}}
+
+
+def recheck_cmd() -> dict:
+    """``recheck --test NAME``: re-analyze every stored run of a test
+    through the batched device path (the replay seam)."""
+    def add_opts(p):
+        p.add_argument("--test", required=True,
+                       help="Stored test name (store/<name>/...)")
+        p.add_argument("--model", default="cas-absent",
+                       choices=["cas", "cas-absent", "mutex"])
+        p.add_argument("--independent", action="store_true",
+                       help="Strain per-key subhistories first")
+
+    def run(opts):
+        import json as _json
+
+        from .models.core import cas_register, mutex
+        from .store import DEFAULT
+        from .suites.etcd import ABSENT
+        model = {"cas": cas_register(), "mutex": mutex(),
+                 "cas-absent": cas_register(ABSENT)}[opts.model]
+        out = DEFAULT.recheck(opts.test, model,
+                              independent=opts.independent)
+        print(_json.dumps(
+            {"valid": out["valid"],
+             "runs": {ts: r["valid"] for ts, r in out["runs"].items()}},
+            default=str))
+        return 0 if out["valid"] is True else 1
+
+    return {"recheck": {"add_opts": add_opts, "run": run}}
+
+
 def main(argv: Optional[Sequence[str]] = None) -> None:
-    run_cli(serve_cmd(), argv)
+    run_cli({**suite_cmd(), **serve_cmd(), **recheck_cmd()}, argv)
 
 
 if __name__ == "__main__":

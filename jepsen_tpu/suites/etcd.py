@@ -286,6 +286,21 @@ def etcd_test(**opts) -> dict:
     return test
 
 
+def derive_concurrency(n_nodes: int, threads_per_key: int,
+                       concurrency: Optional[int]) -> int:
+    """The concurrent generator requires concurrency to be a multiple
+    of threads_per_key; derive the default (>= 2n workers) and validate
+    explicit pairs up front rather than at first poll."""
+    tpk = threads_per_key
+    if concurrency is None:
+        return tpk * max(1, -(-2 * n_nodes // tpk))
+    if concurrency % tpk != 0:
+        raise ValueError(
+            f"concurrency ({concurrency}) must be a multiple of "
+            f"threads_per_key ({tpk})")
+    return concurrency
+
+
 def _casd_pauser(test) -> Client:
     """SIGSTOP/SIGCONT one node's casd (hammer-time semantics,
     nemesis.clj:227-241, targeted per port so only that logical node
@@ -347,16 +362,8 @@ def casd_test(nemesis_mode: str = "pause", persist: bool = True,
     base = opts.get("base_port", 23790)
     ports = {node: base + i for i, node in enumerate(nodes)}
     db = CasdDB(persist=persist)
-    # The concurrent generator requires concurrency to be a multiple of
-    # threads_per_key; derive the default from it (>= 2n workers) and
-    # validate explicit pairs up front rather than at first poll.
-    tpk = opts.get("threads_per_key", 5)
-    concurrency = opts.get("concurrency",
-                           tpk * max(1, -(-2 * n // tpk)))
-    if concurrency % tpk != 0:
-        raise ValueError(
-            f"concurrency ({concurrency}) must be a multiple of "
-            f"threads_per_key ({tpk})")
+    concurrency = derive_concurrency(n, opts.get("threads_per_key", 5),
+                                     opts.get("concurrency"))
     test = noop_test(
         name=opts.get("name", "etcd-casd"),
         nodes=nodes,

@@ -28,7 +28,8 @@ from .. import gen as g
 from ..client import Client
 from ..os_ import NoopOS
 from ..testing import noop_test
-from .etcd import CasdDB, _casd_pauser, _casd_restarter, _with_nemesis
+from .etcd import (CasdDB, _casd_pauser, _casd_restarter, _with_nemesis,
+                   derive_concurrency)
 
 
 class ServiceClient(Client):
@@ -93,12 +94,8 @@ def service_test(name: str, client: Client, workload: dict,
     # the thread-group size; derive/validate once for every suite.
     tpk = opts.get("threads_per_key")
     if tpk:
-        conc = opts.get("concurrency", tpk * max(1, -(-2 * n // tpk)))
-        if conc % tpk != 0:
-            raise ValueError(
-                f"concurrency ({conc}) must be a multiple of "
-                f"threads_per_key ({tpk})")
-        opts["concurrency"] = conc
+        opts["concurrency"] = derive_concurrency(
+            n, tpk, opts.get("concurrency"))
     test = noop_test(
         name=name,
         nodes=nodes,

@@ -1,0 +1,57 @@
+"""CLI suite registry: real suite runs driven end-to-end from argv,
+with the documented exit-code contract."""
+import shutil
+import subprocess
+
+import pytest
+
+from jepsen_tpu.cli import main
+
+
+def _cleanup():
+    subprocess.run(["bash", "-c", "pkill -9 -f '[c]asd --port' || true"],
+                   capture_output=True)
+    for d in ("aerospike-counter", "hazelcast-ids"):
+        shutil.rmtree(f"/tmp/jepsen/{d}", ignore_errors=True)
+
+
+@pytest.fixture(autouse=True)
+def clean(tmp_path, monkeypatch):
+    _cleanup()
+    monkeypatch.chdir(tmp_path)   # store/ lands in the tmp dir
+    yield
+    _cleanup()
+
+
+def _main_rc(argv):
+    with pytest.raises(SystemExit) as e:
+        main(argv)
+    return e.value.code or 0
+
+
+def test_cli_runs_suite_and_exits_zero(tmp_path):
+    rc = _main_rc(["test", "--suite", "aerospike", "--n-ops", "60",
+                   "--base-port", "25200",
+                   "--time-limit", "12"])
+    assert rc == 0
+    assert (tmp_path / "store" / "aerospike-counter" / "latest").exists()
+
+
+def test_cli_invalid_run_exits_one(tmp_path):
+    rc = _main_rc(["test", "--suite", "hazelcast-ids", "--nemesis",
+                   "restart", "--no-persist", "--n-ops", "800",
+                   "--base-port", "25210", "--time-limit", "6"])
+    assert rc == 1
+
+
+def test_cli_recheck_stored_run(tmp_path):
+    rc = _main_rc(["test", "--suite", "etcd-casd", "--n-ops", "30",
+                   "--ops-per-key", "30", "--threads-per-key", "2",
+                   "--base-port", "25220", "--time-limit", "10"])
+    assert rc == 0
+    rc = _main_rc(["recheck", "--test", "etcd-casd", "--independent"])
+    assert rc == 0
+
+
+def test_cli_bad_usage_exit_254():
+    assert _main_rc(["frobnicate"]) == 254
