@@ -184,6 +184,14 @@ SUITE_OPT_KEYS = ("time_limit", "nemesis_mode", "persist", "n_ops",
                   "split_ms", "accounts", "seed")
 
 
+# Registry names are static so building the parser (--help, serve,
+# usage errors) never pays the suite-module/jax import cost; the
+# builders resolve lazily at run time.
+SUITE_NAMES = ("etcd", "etcd-casd", "hazelcast-lock", "hazelcast-ids",
+               "hazelcast-queue", "rabbitmq", "aerospike",
+               "elasticsearch", "consul", "bank", "monotonic")
+
+
 def suite_registry() -> Dict[str, Callable]:
     """Named local-mode test builders (the reference reaches suites via
     per-project lein runners; one registry serves the same role here).
@@ -214,7 +222,7 @@ def suite_cmd() -> dict:
     def add_opts(p):
         add_test_opts(p)
         p.add_argument("--suite", required=True,
-                       choices=sorted(suite_registry()),
+                       choices=sorted(SUITE_NAMES),
                        help="Which suite to run")
         p.add_argument("--nemesis", dest="nemesis_mode", default=None,
                        choices=["pause", "restart"],
@@ -248,14 +256,15 @@ def suite_cmd() -> dict:
     def run(opts):
         d = vars(opts)
         name = d["suite"]
-        kw = {k: d[k] for k in SUITE_OPT_KEYS
-              if d.get(k) is not None and k != "concurrency"}
+        kw = {k: d[k] for k in SUITE_OPT_KEYS if d.get(k) is not None}
         if d.get("concurrency") is not None:
             kw["concurrency"] = parse_concurrency(
                 d["concurrency"], d.get("n_nodes") or 1)
         if name == "etcd":   # the real-cluster suite takes node/ssh opts
-            opts.concurrency = d.get("concurrency") or "3n"
-            opts.time_limit = d.get("time_limit") or 60.0
+            if d.get("concurrency") is None:
+                opts.concurrency = "3n"
+            if d.get("time_limit") is None:
+                opts.time_limit = 60.0
             m = test_opts_to_map(opts)
             kw.update(nodes=m["nodes"], ssh=m["ssh"],
                       concurrency=m["concurrency"],

@@ -428,7 +428,84 @@ def check_queues_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
     return [decode(r) for r in range(enc.batch)]
 
 
-# --------------------------------------------------- Checker adapters
+# ------------------------------------------------------ queue (FIFO)
+
+_FIFO_KERNELS: Dict[int, object] = {}
+
+
+def _fifo_kernel(Nmax: int):
+    k = _FIFO_KERNELS.get(Nmax)
+    if k is None:
+        def one(typ, f, val):
+            def step(carry, line):
+                buf, head, tail, valid, bad = carry
+                t, fc, v, j = line
+                is_enq = (t == T_INVOKE) & (fc == F_ENQ)
+                is_deq = (t == T_OK) & (fc == F_DEQ)
+                buf = buf.at[jnp.clip(tail, 0, Nmax - 1)].set(
+                    jnp.where(is_enq, v, buf[jnp.clip(tail, 0, Nmax - 1)]))
+                tail = tail + jnp.where(is_enq, 1, 0)
+                empty = head >= tail
+                wrong = is_deq & (empty | (buf[jnp.clip(head, 0, Nmax - 1)]
+                                           != v))
+                head = head + jnp.where(is_deq & ~wrong, 1, 0)
+                first = wrong & valid
+                return (buf, head, tail, valid & ~wrong,
+                        jnp.where(first, j, bad)), None
+
+            N = typ.shape[0]
+            init = (jnp.zeros((Nmax,), jnp.int32), jnp.int32(0),
+                    jnp.int32(0), jnp.bool_(True), jnp.int32(-1))
+            (buf, head, tail, valid, bad), _ = jax.lax.scan(
+                step, init, (typ, f, val,
+                             jnp.arange(N, dtype=jnp.int32)))
+            return valid, bad, head, tail
+
+        k = jax.jit(jax.vmap(one))
+        _FIFO_KERNELS[Nmax] = k
+    return k
+
+
+def check_fifo_queues_batch(histories: Sequence[Sequence[Op]]
+                            ) -> List[dict]:
+    """Strict-order queue fold (the FIFOQueue model's semantics,
+    model.clj:87-105, folded like checker.clj:109-129): assume every
+    non-failing enqueue succeeded in invocation order; each ok dequeue
+    must return the element at the head. The scan carries a ring of
+    enqueued values per history."""
+    enc = _encode(histories, {"enqueue": F_ENQ, "dequeue": F_DEQ})
+    Nmax = max(enc.typ.shape[1], 1)
+    valid, bad, head, tail = (np.asarray(a) for a in _fifo_kernel(Nmax)(
+        enc.typ, enc.f, enc.val))
+    # Reconstruct each row's remaining queue host-side for the valid
+    # result (the enqueue order is the invoke order, so the ring is
+    # just the enqueued values sliced at [head:tail]).
+    enq_vals = [[enc.vocab[vi] for t, fc, vi in
+                 zip(enc.typ[r], enc.f[r], enc.val[r])
+                 if t == T_INVOKE and fc == F_ENQ and vi >= 0]
+                for r in range(enc.batch)]
+
+    from ..models.core import FIFOQueue
+
+    def decode(r: int) -> dict:
+        if valid[r]:
+            return {"valid": True,
+                    "final-queue": FIFOQueue(
+                        enq_vals[r][int(head[r]):int(tail[r])])}
+        j = int(bad[r])
+        v = enc.vocab[enc.val[r, j]] if enc.val[r, j] >= 0 else None
+        # Host-parity error text (models.core.FIFOQueue.step).
+        if int(head[r]) >= _n_enqueues_before(enc, r, j):
+            return {"valid": False,
+                    "error": f"can't dequeue {v!r} from empty queue"}
+        return {"valid": False, "error": f"can't dequeue {v!r}"}
+
+    return [decode(r) for r in range(enc.batch)]
+
+
+def _n_enqueues_before(enc: FoldBatch, r: int, j: int) -> int:
+    return int(((enc.typ[r, :j] == T_INVOKE)
+                & (enc.f[r, :j] == F_ENQ)).sum())
 
 class BatchFoldChecker:
     """Checker-protocol adapter over a batch fold (single histories ride
@@ -460,3 +537,7 @@ def counter_checker_tpu():
 
 def queue_checker_tpu():
     return BatchFoldChecker(check_queues_batch)
+
+
+def fifo_queue_checker_tpu():
+    return BatchFoldChecker(check_fifo_queues_batch)

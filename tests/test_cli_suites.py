@@ -55,3 +55,8 @@ def test_cli_recheck_stored_run(tmp_path):
 
 def test_cli_bad_usage_exit_254():
     assert _main_rc(["frobnicate"]) == 254
+
+
+def test_registry_names_match_builders():
+    from jepsen_tpu.cli import SUITE_NAMES, suite_registry
+    assert set(SUITE_NAMES) == set(suite_registry())

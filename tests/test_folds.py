@@ -209,6 +209,31 @@ def test_queue_fold_parity():
     assert any(r["valid"] is True for r in ref)
 
 
+def test_fifo_queue_fold_parity():
+    """FIFO fold vs the host QueueChecker with the strict-order model:
+    in-order single-consumer dequeues are valid; out-of-order ones are
+    invalid (unordered semantics would accept them)."""
+    from jepsen_tpu.models.core import fifo_queue
+    from jepsen_tpu.ops.folds import check_fifo_queues_batch
+
+    def hist(order):
+        h = []
+        for i in range(4):
+            h.append(invoke_op(0, "enqueue", i))
+            h.append(ok_op(0, "enqueue", i))
+        for v in order:
+            h.append(invoke_op(1, "dequeue", None))
+            h.append(ok_op(1, "dequeue", v))
+        return index(h)
+
+    hs = [hist([0, 1, 2, 3]), hist([0, 2, 1, 3]), hist([0, 1]),
+          hist([1])]
+    got = check_fifo_queues_batch(hs)
+    ref = [QueueChecker().check({}, fifo_queue(), h) for h in hs]
+    assert got == ref            # field-for-field, incl. final-queue
+    assert [g["valid"] for g in got] == [True, False, True, False]
+
+
 def test_fold_checker_protocol_adapters():
     from jepsen_tpu.ops.folds import (counter_checker_tpu, queue_checker_tpu,
                                       set_checker_tpu,
