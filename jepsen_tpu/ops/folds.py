@@ -438,7 +438,7 @@ def _fifo_kernel(Nmax: int):
     if k is None:
         def one(typ, f, val):
             def step(carry, line):
-                buf, head, tail, valid, bad = carry
+                buf, head, tail, valid, bad, bad_head = carry
                 t, fc, v, j = line
                 is_enq = (t == T_INVOKE) & (fc == F_ENQ)
                 is_deq = (t == T_OK) & (fc == F_DEQ)
@@ -448,18 +448,20 @@ def _fifo_kernel(Nmax: int):
                 empty = head >= tail
                 wrong = is_deq & (empty | (buf[jnp.clip(head, 0, Nmax - 1)]
                                            != v))
-                head = head + jnp.where(is_deq & ~wrong, 1, 0)
                 first = wrong & valid
+                head = head + jnp.where(is_deq & ~wrong, 1, 0)
                 return (buf, head, tail, valid & ~wrong,
-                        jnp.where(first, j, bad)), None
+                        jnp.where(first, j, bad),
+                        jnp.where(first, head, bad_head)), None
 
             N = typ.shape[0]
             init = (jnp.zeros((Nmax,), jnp.int32), jnp.int32(0),
-                    jnp.int32(0), jnp.bool_(True), jnp.int32(-1))
-            (buf, head, tail, valid, bad), _ = jax.lax.scan(
+                    jnp.int32(0), jnp.bool_(True), jnp.int32(-1),
+                    jnp.int32(-1))
+            (buf, head, tail, valid, bad, bad_head), _ = jax.lax.scan(
                 step, init, (typ, f, val,
                              jnp.arange(N, dtype=jnp.int32)))
-            return valid, bad, head, tail
+            return valid, bad, bad_head, head, tail
 
         k = jax.jit(jax.vmap(one))
         _FIFO_KERNELS[Nmax] = k
@@ -475,37 +477,38 @@ def check_fifo_queues_batch(histories: Sequence[Sequence[Op]]
     enqueued values per history."""
     enc = _encode(histories, {"enqueue": F_ENQ, "dequeue": F_DEQ})
     Nmax = max(enc.typ.shape[1], 1)
-    valid, bad, head, tail = (np.asarray(a) for a in _fifo_kernel(Nmax)(
-        enc.typ, enc.f, enc.val))
-    # Reconstruct each row's remaining queue host-side for the valid
-    # result (the enqueue order is the invoke order, so the ring is
-    # just the enqueued values sliced at [head:tail]).
-    enq_vals = [[enc.vocab[vi] for t, fc, vi in
-                 zip(enc.typ[r], enc.f[r], enc.val[r])
-                 if t == T_INVOKE and fc == F_ENQ and vi >= 0]
-                for r in range(enc.batch)]
-
+    valid, bad, bad_head, head, tail = (
+        np.asarray(a) for a in _fifo_kernel(Nmax)(enc.typ, enc.f,
+                                                  enc.val))
     from ..models.core import FIFOQueue
+
+    def _value(vi: int):
+        # Sequence payloads round-trip the codec as lists; decode the
+        # interned tuple form back so parity with the host holds.
+        v = enc.vocab[vi]
+        return list(v) if isinstance(v, tuple) else v
 
     def decode(r: int) -> dict:
         if valid[r]:
+            # Remaining queue = enqueued values (invoke order) [head:tail].
+            enq = [_value(vi) for t, fc, vi in
+                   zip(enc.typ[r], enc.f[r], enc.val[r])
+                   if t == T_INVOKE and fc == F_ENQ and vi >= 0]
             return {"valid": True,
                     "final-queue": FIFOQueue(
-                        enq_vals[r][int(head[r]):int(tail[r])])}
+                        enq[int(head[r]):int(tail[r])])}
         j = int(bad[r])
-        v = enc.vocab[enc.val[r, j]] if enc.val[r, j] >= 0 else None
-        # Host-parity error text (models.core.FIFOQueue.step).
-        if int(head[r]) >= _n_enqueues_before(enc, r, j):
+        v = _value(enc.val[r, j]) if enc.val[r, j] >= 0 else None
+        # Host-parity error text (models.core.FIFOQueue.step); empty
+        # iff the head AT THE FAILURE had consumed every prior enqueue.
+        n_enq_before = int(((enc.typ[r, :j] == T_INVOKE)
+                            & (enc.f[r, :j] == F_ENQ)).sum())
+        if int(bad_head[r]) >= n_enq_before:
             return {"valid": False,
                     "error": f"can't dequeue {v!r} from empty queue"}
         return {"valid": False, "error": f"can't dequeue {v!r}"}
 
     return [decode(r) for r in range(enc.batch)]
-
-
-def _n_enqueues_before(enc: FoldBatch, r: int, j: int) -> int:
-    return int(((enc.typ[r, :j] == T_INVOKE)
-                & (enc.f[r, :j] == F_ENQ)).sum())
 
 class BatchFoldChecker:
     """Checker-protocol adapter over a batch fold (single histories ride

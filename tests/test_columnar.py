@@ -110,6 +110,21 @@ def test_columnar_overflow_routes_to_host():
     assert np.array_equal(valid, host)
 
 
+def test_long_histories_stay_linear():
+    """The event axis scales linearly: multi-thousand-line histories
+    check on device with native-engine parity (the long-context axis —
+    the pending WINDOW is what must stay bounded, not history length)."""
+    model = cas_register()
+    cols = synth_cas_columnar(8, seed=9, n_procs=4, n_ops=2000,
+                              n_values=3, corrupt=0.4)
+    valid, bad = check_columnar(model, cols)
+    from jepsen_tpu.native import check_batch_native
+    rs = check_batch_native(model, [columnar_to_ops(cols, r)
+                                    for r in range(8)])
+    assert valid.tolist() == [r["valid"] is True for r in rs]
+    assert {True, False} == set(valid.tolist())
+
+
 def test_columnar_full_completion_rounding():
     # Rows that complete every op have n_events = n_ops + 1; the event
     # axis rounds to 8 and must never exceed the walk's buffers

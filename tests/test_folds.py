@@ -233,6 +233,21 @@ def test_fifo_queue_fold_parity():
     assert got == ref            # field-for-field, incl. final-queue
     assert [g["valid"] for g in got] == [True, False, True, False]
 
+    # review repro: a mismatch followed by in-order dequeues must stay
+    # a mismatch error (head at the FAILURE decides empty-vs-wrong)
+    tricky = index([invoke_op(0, "enqueue", 0), ok_op(0, "enqueue", 0),
+                    invoke_op(1, "dequeue", None), ok_op(1, "dequeue", 1),
+                    invoke_op(1, "dequeue", None), ok_op(1, "dequeue", 0)])
+    g2 = check_fifo_queues_batch([tricky])[0]
+    r2 = QueueChecker().check({}, fifo_queue(), tricky)
+    assert g2 == r2 and "empty" not in g2["error"]
+
+    # list-valued payloads keep field parity through vocab interning
+    lv = index([invoke_op(0, "enqueue", [1, 2]),
+                ok_op(0, "enqueue", [1, 2])])
+    assert check_fifo_queues_batch([lv])[0] == \
+        QueueChecker().check({}, fifo_queue(), lv)
+
 
 def test_fold_checker_protocol_adapters():
     from jepsen_tpu.ops.folds import (counter_checker_tpu, queue_checker_tpu,

@@ -60,9 +60,12 @@ def test_lock_healthy_valid(tmp_path):
 def test_lock_restart_double_grant_detected(tmp_path):
     """Wiping the lock table while a client holds the lock lets a second
     client acquire it: two holders, which the Mutex model rejects."""
+    # A restart only seeds the violation if it lands while the lock is
+    # held (~50% of wall time), so schedule enough restarts that the
+    # all-miss probability is negligible (~0.5^13).
     test = hazelcast_test("lock", nemesis_mode="restart", persist=False,
-                          **_opts(tmp_path, 24710, n_ops=600,
-                                  nemesis_cadence=0.8, time_limit=8))
+                          **_opts(tmp_path, 24710, n_ops=2000,
+                                  nemesis_cadence=0.4, time_limit=11))
     r = run_stored(test, tmp_path)
     assert r["results"]["linear"]["valid"] is False, r["results"]
 
@@ -113,9 +116,12 @@ def test_queue_restart_with_persistence_stays_valid(tmp_path):
 def test_queue_restart_lost_elements_detected(tmp_path):
     """Wiping the queue loses acknowledged enqueues: total-queue must
     report them as lost."""
+    # Restarts only lose elements while the queue is non-empty; pack in
+    # enough kill cycles that every one landing on an empty queue is
+    # vanishingly unlikely.
     test = rabbitmq_test(nemesis_mode="restart", persist=False,
-                         **_opts(tmp_path, 24750, n_ops=500,
-                                 nemesis_cadence=0.8, time_limit=7))
+                         **_opts(tmp_path, 24750, n_ops=800,
+                                 nemesis_cadence=0.5, time_limit=9))
     r = run_stored(test, tmp_path)
     assert r["results"]["total-queue"]["valid"] is False, r["results"]
     assert r["results"]["total-queue"]["lost"]
