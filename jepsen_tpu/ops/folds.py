@@ -38,6 +38,26 @@ _TCODE = {"invoke": T_INVOKE, "ok": T_OK, "fail": T_FAIL, "info": T_INFO}
 
 NONE_SENTINEL = np.int32(-2**31)  # "no value" in int32 value columns
 
+# One jitted kernel is cached per vocabulary/shape key. Bucketing keys
+# to powers of two caps distinct compilations at ~31 per family, and the
+# bound below keeps a long-lived checker process from accumulating
+# compiled kernels without limit (same rationale as DISPATCH_LOG).
+_KERNEL_CACHE_LIMIT = 32
+
+
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n (>= 1)."""
+    return 1 << max(n - 1, 0).bit_length()
+
+
+def _cached_kernel(cache: Dict, key, build):
+    k = cache.get(key)
+    if k is None:
+        if len(cache) >= _KERNEL_CACHE_LIMIT:
+            cache.clear()
+        k = cache[key] = build()
+    return k
+
 
 @dataclass
 class FoldBatch:
@@ -120,8 +140,7 @@ _SET_KERNELS: Dict[int, object] = {}
 
 
 def _set_kernel(V: int):
-    k = _SET_KERNELS.get(V)
-    if k is None:
+    def build():
         def one(typ, f, val, final_read):
             att = _counts(typ, f, val, T_INVOKE, F_ADD, V) > 0
             add = _counts(typ, f, val, T_OK, F_ADD, V) > 0
@@ -131,9 +150,9 @@ def _set_kernel(V: int):
             recovered = ok & ~add
             return att, ok, unexpected, lost, recovered
 
-        k = jax.jit(jax.vmap(one))
-        _SET_KERNELS[V] = k
-    return k
+        return jax.jit(jax.vmap(one))
+
+    return _cached_kernel(_SET_KERNELS, V, build)
 
 
 def check_sets_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
@@ -157,7 +176,7 @@ def check_sets_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
             if v not in vocab_idx:
                 vocab_idx[v] = len(enc.vocab)
                 enc.vocab.append(v)
-    V = max(len(enc.vocab), 1)
+    V = _pow2(max(len(enc.vocab), 1))
     final = np.zeros((enc.batch, V), bool)
     has_read = np.zeros(enc.batch, bool)
     for r, fr in enumerate(finals):
@@ -199,8 +218,7 @@ _TQ_KERNELS: Dict[int, object] = {}
 
 
 def _tq_kernel(V: int):
-    k = _TQ_KERNELS.get(V)
-    if k is None:
+    def build():
         def one(typ, f, val):
             att = _counts(typ, f, val, T_INVOKE, F_ENQ, V)
             enq = _counts(typ, f, val, T_OK, F_ENQ, V)
@@ -212,9 +230,9 @@ def _tq_kernel(V: int):
             recovered = jnp.maximum(ok - enq, 0)
             return att, ok, unexpected, duplicated, lost, recovered
 
-        k = jax.jit(jax.vmap(one))
-        _TQ_KERNELS[V] = k
-    return k
+        return jax.jit(jax.vmap(one))
+
+    return _cached_kernel(_TQ_KERNELS, V, build)
 
 
 def check_total_queues_batch(histories: Sequence[Sequence[Op]]
@@ -224,7 +242,7 @@ def check_total_queues_batch(histories: Sequence[Sequence[Op]]
     from ..checkers.simple import expand_queue_drain_ops
     histories = [expand_queue_drain_ops(list(h)) for h in histories]
     enc = _encode(histories, {"enqueue": F_ENQ, "dequeue": F_DEQ})
-    V = max(len(enc.vocab), 1)
+    V = _pow2(max(len(enc.vocab), 1))
     att, ok, unexpected, duplicated, lost, recovered = (
         np.asarray(a) for a in _tq_kernel(V)(enc.typ, enc.f, enc.val))
 
@@ -256,16 +274,15 @@ _IDS_KERNELS: Dict[int, object] = {}
 
 
 def _ids_kernel(V: int):
-    k = _IDS_KERNELS.get(V)
-    if k is None:
+    def build():
         def one(typ, f, val):
             acks = _counts(typ, f, val, T_OK, F_GEN, V)
             attempted = ((typ == T_INVOKE) & (f == F_GEN)).sum()
             return acks, attempted
 
-        k = jax.jit(jax.vmap(one))
-        _IDS_KERNELS[V] = k
-    return k
+        return jax.jit(jax.vmap(one))
+
+    return _cached_kernel(_IDS_KERNELS, V, build)
 
 
 def check_unique_ids_batch(histories: Sequence[Sequence[Op]]
@@ -273,7 +290,7 @@ def check_unique_ids_batch(histories: Sequence[Sequence[Op]]
     """Batch twin of checkers.simple.UniqueIdsChecker — acknowledged
     :generate ops return distinct ids (checker.clj:273-318)."""
     enc = _encode(histories, {"generate": F_GEN})
-    V = max(len(enc.vocab), 1)
+    V = _pow2(max(len(enc.vocab), 1))
     acks, attempted = (np.asarray(a) for a in _ids_kernel(V)(
         enc.typ, enc.f, enc.val))
 
@@ -338,15 +355,46 @@ def _counter_kernel():
     return _COUNTER_KERNEL
 
 
+def _counter_overflow_risk(history: Sequence[Op]) -> bool:
+    """True when a history's counter arithmetic cannot safely ride the
+    int32 device path: a value outside int32 range (which also covers a
+    collision with NONE_SENTINEL = -2^31), or running add sums that
+    could exceed int32 bounds. jax x64 is off, so the honest fallback is
+    the arbitrary-precision host checker, not a downcast int64 column."""
+    lim = 2**31 - 1
+    total = 0
+    for op in history:
+        v = op.value
+        if v is None or op.f not in ("add", "read"):
+            continue
+        if not isinstance(v, int) or not (-lim <= v <= lim):
+            return True  # non-int (e.g. float) or out of int32 range
+        if op.f == "add":
+            total += abs(v)
+            if total > lim:
+                return True
+    return False
+
+
 def check_counters_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
     """Batch twin of checkers.simple.CounterChecker — each ok read lies
     within [ok adds at invoke, attempted adds at completion]
     (checker.clj:321-374). Order-dependent: a vmapped scan carries the
-    running bounds and per-process pending reads."""
+    running bounds and per-process pending reads. Rows whose values or
+    running sums could overflow int32 detour to the host checker."""
+    from ..checkers.simple import CounterChecker
     from ..history.core import complete
     histories = [complete(list(h)) for h in histories]
-    enc = _encode(histories, {"add": F_ADD, "read": F_READ},
-                  raw_values=True)
+    out: List[Optional[dict]] = [None] * len(histories)
+    host = [r for r, h in enumerate(histories)
+            if _counter_overflow_risk(h)]
+    for r in host:
+        out[r] = CounterChecker().check(None, None, histories[r])
+    dev = [r for r in range(len(histories)) if out[r] is None]
+    if not dev:
+        return out
+    enc = _encode([histories[r] for r in dev],
+                  {"add": F_ADD, "read": F_READ}, raw_values=True)
     # densify processes per row
     proc = np.zeros_like(enc.proc)
     for r in range(enc.batch):
@@ -354,7 +402,7 @@ def check_counters_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
         live = enc.typ[r] != PAD
         for j in np.nonzero(live)[0]:
             proc[r, j] = dense.setdefault(int(enc.proc[r, j]), len(dense))
-    P = max(int(proc.max(initial=0)) + 1, 1)
+    P = _pow2(max(int(proc.max(initial=0)) + 1, 1))
     lows, vals, ups, emits = (np.asarray(a) for a in _counter_kernel()(
         enc.typ, enc.f, enc.val, proc, P))
 
@@ -367,7 +415,9 @@ def check_counters_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
                   if rd[1] is None or not (rd[0] <= rd[1] <= rd[2])]
         return {"valid": not errors, "reads": reads, "errors": errors}
 
-    return [decode(r) for r in range(enc.batch)]
+    for i, r in enumerate(dev):
+        out[r] = decode(i)
+    return out
 
 
 # ------------------------------------------------- queue (unordered)
@@ -376,8 +426,7 @@ _QUEUE_KERNELS: Dict[int, object] = {}
 
 
 def _queue_kernel(V: int):
-    k = _QUEUE_KERNELS.get(V)
-    if k is None:
+    def build():
         def one(typ, f, val):
             def step(carry, line):
                 counts, valid, bad = carry
@@ -400,9 +449,9 @@ def _queue_kernel(V: int):
                 step, init, (typ, f, val, jnp.arange(N, dtype=jnp.int32)))
             return valid, bad, counts
 
-        k = jax.jit(jax.vmap(one))
-        _QUEUE_KERNELS[V] = k
-    return k
+        return jax.jit(jax.vmap(one))
+
+    return _cached_kernel(_QUEUE_KERNELS, V, build)
 
 
 def check_queues_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
@@ -411,7 +460,7 @@ def check_queues_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
     succeeded, only ok dequeues succeeded; a dequeue of an element not
     in the multiset is the violation."""
     enc = _encode(histories, {"enqueue": F_ENQ, "dequeue": F_DEQ})
-    V = max(len(enc.vocab), 1)
+    V = _pow2(max(len(enc.vocab), 1))
     valid, bad, counts = (np.asarray(a) for a in _queue_kernel(V)(
         enc.typ, enc.f, enc.val))
 
@@ -434,8 +483,7 @@ _FIFO_KERNELS: Dict[int, object] = {}
 
 
 def _fifo_kernel(Nmax: int):
-    k = _FIFO_KERNELS.get(Nmax)
-    if k is None:
+    def build():
         def one(typ, f, val):
             def step(carry, line):
                 buf, head, tail, valid, bad, bad_head = carry
@@ -463,9 +511,9 @@ def _fifo_kernel(Nmax: int):
                              jnp.arange(N, dtype=jnp.int32)))
             return valid, bad, bad_head, head, tail
 
-        k = jax.jit(jax.vmap(one))
-        _FIFO_KERNELS[Nmax] = k
-    return k
+        return jax.jit(jax.vmap(one))
+
+    return _cached_kernel(_FIFO_KERNELS, Nmax, build)
 
 
 def check_fifo_queues_batch(histories: Sequence[Sequence[Op]]
@@ -476,7 +524,7 @@ def check_fifo_queues_batch(histories: Sequence[Sequence[Op]]
     must return the element at the head. The scan carries a ring of
     enqueued values per history."""
     enc = _encode(histories, {"enqueue": F_ENQ, "dequeue": F_DEQ})
-    Nmax = max(enc.typ.shape[1], 1)
+    Nmax = _pow2(max(enc.typ.shape[1], 1))
     valid, bad, bad_head, head, tail = (
         np.asarray(a) for a in _fifo_kernel(Nmax)(enc.typ, enc.f,
                                                   enc.val))

@@ -630,8 +630,6 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
                 valid[i] = r["valid"] is True
                 if r["valid"] is False:
                     bad[i] = r["op"].get("index", -1)
-                if details:
-                    results[i] = r
     for batch, out in run_buckets_threaded(buckets,
                                            return_frontier=details):
         if isinstance(out, WindowOverflow):

@@ -301,10 +301,13 @@ def derive_concurrency(n_nodes: int, threads_per_key: int,
     return concurrency
 
 
-def _casd_pauser(test) -> Client:
+def _casd_pauser(test, targeter=None) -> Client:
     """SIGSTOP/SIGCONT one node's casd (hammer-time semantics,
     nemesis.clj:227-241, targeted per port so only that logical node
-    stalls)."""
+    stalls). casd nodes don't replicate and every client routes to
+    nodes[0], so the default target is the node clients actually talk
+    to — a random target would mostly stall daemons with no traffic,
+    making seeded violations unobservable."""
     def start(test, node):
         # casd may be absent mid-restart; pkill's exit 1 must not abort
         # the nemesis worker.
@@ -317,15 +320,15 @@ def _casd_pauser(test) -> Client:
                     f"{test['casd_ports'][node]}' || true")
         return "resumed"
 
-    import random as _r
-    return nem.node_start_stopper(lambda nodes: _r.choice(nodes),
+    return nem.node_start_stopper(targeter or (lambda nodes: nodes[0]),
                                   start, stop)
 
 
-def _casd_restarter(db: CasdDB) -> Client:
+def _casd_restarter(db: CasdDB, targeter=None) -> Client:
     """Kill -9 one node's casd and restart it — with persist=False this
     wipes the register, a real consistency violation the checker must
-    flag.
+    flag. Default target = nodes[0], the node clients talk to (see
+    _casd_pauser).
 
     Kill and restart happen within ONE nemesis op so the node's dead
     window is just the daemon's own startup time; independent keys are
@@ -341,8 +344,7 @@ def _casd_restarter(db: CasdDB) -> Client:
     def stop(test, node):
         return "nop"
 
-    import random as _r
-    return nem.node_start_stopper(lambda nodes: _r.choice(nodes),
+    return nem.node_start_stopper(targeter or (lambda nodes: nodes[0]),
                                   start, stop)
 
 

@@ -106,6 +106,10 @@ def service_test(name: str, client: Client, workload: dict,
         client=client,
         casd_ports=ports,
         casd_dir=opts.get("casd_dir", f"/tmp/jepsen/{name}"),
+        # casd nodes don't replicate: every client routes to nodes[0]'s
+        # store so the workload still reads as one shared object (etcd
+        # suite rationale, etcd.casd_test); the pause/restart nemeses
+        # default-target nodes[0] for the same reason.
         client_urls={node: f"http://127.0.0.1:{ports[nodes[0]]}"
                      for node in nodes},
         **workload)

@@ -26,7 +26,13 @@ class QueueClient(ServiceClient):
     """enqueue / dequeue / drain over /queue/<name>. Dequeue of an
     empty queue is a definite :fail (the reference's empty-queue
     convention); drain returns the remaining elements as one op, which
-    the total-queue checker expands into dequeue pairs."""
+    the total-queue checker expands into dequeue pairs.
+
+    Unlike real RabbitMQ (which redelivers un-acked messages, letting
+    the reference map dequeue timeouts to :fail, rabbitmq.clj:152-166),
+    casd pops the element immediately with no ack — a timed-out dequeue
+    the daemon still processed has removed an element, so every op here
+    is mutating (timeout -> :info)."""
 
     def invoke(self, test, op):
         f = op["f"]
@@ -50,7 +56,7 @@ class QueueClient(ServiceClient):
                         "value": [int(v) for v in r["vs"]]}
             raise ValueError(f"unknown op {f}")
 
-        return self.guarded(op, body, mutating=f != "dequeue")
+        return self.guarded(op, body, mutating=True)
 
 
 def queue_workload(opts: dict) -> dict:

@@ -191,6 +191,27 @@ def test_counter_fold_parity():
     assert any(r["valid"] is True for r in ref)
 
 
+def test_counter_fold_overflow_guard():
+    """Values or running sums beyond int32 detour to the host checker
+    instead of silently wrapping in the int32 device scan (and a value
+    of exactly -2^31 can't collide with the none-sentinel)."""
+    big = index([invoke_op(0, "add", 2**40), ok_op(0, "add", 2**40),
+                 invoke_op(1, "read", None), ok_op(1, "read", 2**40)])
+    wrap = index([op for i in range(3) for op in
+                  (invoke_op(0, "add", 2**30), ok_op(0, "add", 2**30))]
+                 + [invoke_op(1, "read", None),
+                    ok_op(1, "read", 3 * 2**30)])
+    sentinel = index([invoke_op(0, "add", -2**31), ok_op(0, "add", -2**31),
+                      invoke_op(1, "read", None), ok_op(1, "read", -2**31)])
+    small = index([invoke_op(0, "add", 1), ok_op(0, "add", 1),
+                   invoke_op(1, "read", None), ok_op(1, "read", 1)])
+    hs = [big, wrap, sentinel, small]
+    got = check_counters_batch(hs)
+    ref = [CounterChecker().check({}, None, h) for h in hs]
+    assert got == ref
+    assert all(r["valid"] is True for r in got)
+
+
 def test_unique_ids_fold_parity():
     hs = [synth_ids_history(s) for s in range(N_HIST)]
     got = check_unique_ids_batch(hs)
