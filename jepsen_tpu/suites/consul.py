@@ -9,9 +9,9 @@ family, consul.clj:141-179).
 
 Local mode drives casd's /v1/kv emulation of the same API subset, so
 the client's wire handling (base64, index CAS, 404-as-absent) is
-exercised against a real server; real-Consul automation (agent
-bootstrap, consul.clj:21-54) slots behind the DB protocol as in the
-etcd suite.
+exercised against a real server; ``ConsulDB`` is the real-cluster
+automation (agent bootstrap + join, consul.clj:21-54) behind the DB
+protocol, command-stream tested like EtcdDB.
 """
 from __future__ import annotations
 
@@ -20,8 +20,68 @@ import json
 import urllib.error
 
 from .. import independent
+from ..control import core as c
+from ..control import net_helpers
+from ..control import util as cu
+from ..db import DB
 from ..suites import etcd as etcd_suite
 from .local_common import ServiceClient, service_test
+
+CONSUL_VERSION = "1.18.1"
+CONSUL_URL = ("https://releases.hashicorp.com/consul/"
+              f"{CONSUL_VERSION}/consul_{CONSUL_VERSION}_linux_amd64.zip")
+DIR = "/opt/consul"
+BINARY = f"{DIR}/consul"
+PIDFILE = "/var/run/consul.pid"
+DATA_DIR = "/var/lib/consul"
+LOG_FILE = "/var/log/consul.log"
+
+
+class ConsulDB(DB):
+    """Real consul agents forming one cluster (consul.clj:21-54): the
+    primary bootstraps, every other node joins it by IP; teardown kills
+    the agent and wipes its data dir. Consul ships as a single static Go
+    binary in a zip, deployed with the shared install_archive path."""
+
+    def _install(self, test) -> None:
+        """Fetch + unzip the agent binary into DIR. Not install_archive:
+        consul's zip holds a single top-level FILE (the binary), which
+        install_archive's sole-root rule would move to DIR itself;
+        unzipping inside DIR yields DIR/consul."""
+        url = test.get("consul_url", CONSUL_URL)
+        c.exec_("mkdir", "-p", cu.TMP_DIR_BASE)
+        with c.cd(cu.TMP_DIR_BASE):
+            zip_path = c.expand_path(cu.wget(url))
+        c.exec_("mkdir", "-p", DIR)
+        with c.cd(DIR):
+            c.exec_("unzip", "-o", zip_path)
+        c.exec_("chmod", "+x", BINARY)
+
+    def setup(self, test, node):
+        nodes = test.get("nodes") or []
+        prim = nodes[0] if nodes else node
+        with c.su():
+            self._install(test)
+            args = ["agent", "-server", "-log-level", "debug",
+                    "-client", "0.0.0.0",
+                    "-bind", net_helpers.ip(str(node)),
+                    "-data-dir", DATA_DIR,
+                    "-node", str(node)]
+            if node == prim:
+                args += ["-bootstrap"]
+            else:
+                args += ["-join", net_helpers.ip(str(prim))]
+            cu.start_daemon(
+                {"logfile": LOG_FILE, "pidfile": PIDFILE, "chdir": DIR},
+                BINARY, *args)
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(c.exec_, "killall", "-9", "consul")
+            c.exec_("rm", "-rf", PIDFILE, DATA_DIR, DIR)
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
 
 
 class ConsulClient(ServiceClient):

@@ -1,0 +1,424 @@
+"""The real-cluster path: EtcdDB automation and the SSH transport.
+
+The reference pins exactly this seam with core_test.clj:30-84 (ssh-test:
+a full run! against reified OS/DB asserting the command/log round-trip)
+and control_test.clj. Here:
+
+  * EtcdDB setup/teardown/log_files run over a DummyTransport responder
+    and the EXACT command stream is asserted — install tarball, etcd
+    start-daemon flags, wipe (etcd.clj:45-99 semantics).
+  * A complete runtime.run() goes over dummy SSH with DebianOS +
+    EtcdDB + the iptables Net + a partitioner nemesis, asserting each
+    layer's commands landed on every node and logs were snarfed.
+  * SSHTransport round-trips exec/upload/download/close through fake
+    ssh/scp shims on PATH that execute locally — driving the genuine
+    subprocess path and asserting the exact OpenSSH argv (ControlMaster
+    mux, port, target). No sshd exists in CI; the shim is the seam.
+  * The exit-255 retry discipline (control.clj:140-160) is exercised at
+    both the ssh_run level and through the real SSHTransport.
+"""
+import os
+import stat as stat_mod
+import threading
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu.control import core as c
+from jepsen_tpu.control.core import (DummyTransport, RemoteError,
+                                     SSHTransport, exec_, session,
+                                     with_session)
+from jepsen_tpu.suites.etcd import ETCD_URL, EtcdDB
+
+
+# --------------------------------------------------------- responders
+
+def etcd_responder(host, cmd):
+    """Answer the node-side queries EtcdDB's setup makes: nothing exists
+    yet, /opt/etcd's parent is /opt, and the extracted tarball has one
+    root directory."""
+    import re
+    if re.search(r"\bstat\b", cmd):   # not --initial-cluster-state
+        return "", "No such file or directory", 1
+    if "dirname" in cmd:
+        return "/opt\n", "", 0
+    if "ls -A" in cmd:
+        return "etcd-v3.5.12-linux-amd64\n", "", 0
+    if "dpkg --get-selections" in cmd:
+        return "", "", 0          # nothing installed -> install all
+    return "", "", 0
+
+
+def dummy_node(host="n1", responder=etcd_responder):
+    return session(host, {"dummy": True}, responder)
+
+
+# ------------------------------------------------- EtcdDB command stream
+
+def test_etcd_db_setup_command_stream():
+    """setup = tarball install + start-stop-daemon with the cluster
+    bootstrap flags (etcd.clj:45-78)."""
+    s = dummy_node()
+    test = {"nodes": ["n1", "n2", "n3"]}
+    with with_session("n1", s):
+        EtcdDB().setup(test, "n1")
+    cmds = s.transport.commands
+
+    def first(substr):
+        for i, cmd in enumerate(cmds):
+            if substr in cmd:
+                return i
+        raise AssertionError(
+            f"no command containing {substr!r} in:\n" + "\n".join(cmds))
+
+    # Every command runs as root (the su() wrapper).
+    assert all("sudo -S -u root bash -c" in cmd for cmd in cmds), cmds
+    i_wget = first(f"wget --tries 20")
+    assert ETCD_URL in cmds[i_wget]
+    i_tar = first("tar xf")
+    i_mv = first("mv etcd-v3.5.12-linux-amd64 /opt/etcd")
+    i_start = first("start-stop-daemon --start")
+    assert i_wget < i_tar < i_mv < i_start, cmds
+    start = cmds[i_start]
+    # The full bootstrap flag set, on one start-stop-daemon invocation.
+    assert "--exec /opt/etcd/etcd" in start
+    assert "--pidfile /opt/etcd/etcd.pid" in start
+    assert "--chdir /opt/etcd" in start
+    assert "--name n1" in start
+    assert "--listen-peer-urls http://n1:2380" in start
+    assert "--listen-client-urls http://0.0.0.0:2379" in start
+    assert "--advertise-client-urls http://n1:2379" in start
+    assert "--initial-cluster-state new" in start
+    assert ("--initial-cluster n1=http://n1:2380,n2=http://n2:2380,"
+            "n3=http://n3:2380") in start
+    assert "--enable-v2" in start
+    assert start.rstrip('"').endswith("2>&1")
+
+
+def test_etcd_db_teardown_and_log_files():
+    """teardown kills etcd and wipes /opt/etcd (etcd.clj:80-87);
+    log_files names the daemon log for snarfing."""
+    s = dummy_node()
+    test = {"nodes": ["n1"]}
+    db = EtcdDB()
+    with with_session("n1", s):
+        db.teardown(test, "n1")
+        assert db.log_files(test, "n1") == ["/opt/etcd/etcd.log"]
+    cmds = s.transport.commands
+    assert any("ps aux | grep etcd | grep -v grep" in cmd and
+               "kill -9" in cmd for cmd in cmds), cmds
+    assert any("rm -rf /opt/etcd" in cmd for cmd in cmds), cmds
+
+
+# ------------------------------------------- full run() over dummy SSH
+
+def test_full_run_over_dummy_ssh(tmp_path):
+    """The ssh-test analog (core_test.clj:30-84): a COMPLETE
+    runtime.run() — debian OS setup, EtcdDB cycle, partitioner over the
+    iptables Net, client ops, log snarf, teardown — over dummy SSH on
+    three nodes, asserting the whole command stream and a valid verdict.
+    The data plane is the in-process atom register (tests.clj:34-56);
+    the control plane is the real one."""
+    from jepsen_tpu import gen as g
+    from jepsen_tpu import net
+    from jepsen_tpu.checkers.linearizable import linearizable
+    from jepsen_tpu.models.core import cas_register
+    from jepsen_tpu.nemesis import core as nem
+    from jepsen_tpu.os_impl.debian import DebianOS
+    from jepsen_tpu.runtime import run
+    from jepsen_tpu.store import StoreHandle
+    from jepsen_tpu.testing import AtomClient, noop_test
+
+    transports = {}
+    lock = threading.Lock()
+
+    def responder(host, cmd):
+        return etcd_responder(host, cmd)
+
+    # Capture each node's transport as with_ssh opens it.
+    orig_session = c.session
+
+    def capture_session(host, cfg=None, resp=None):
+        s = orig_session(host, cfg, resp)
+        with lock:
+            transports[host] = s.transport
+        return s
+
+    c.session = capture_session
+    try:
+        import itertools
+        nodes = ["n1", "n2", "n3"]
+        nem_gen = g.seq(itertools.cycle(
+            [{"type": "info", "f": "start"}, g.sleep(0.3),
+             {"type": "info", "f": "stop"}, g.sleep(0.3)]))
+        client_gen = g.limit(30, g.stagger(1 / 100, g.cas_gen()))
+        test = noop_test(
+            name="ssh-test",
+            nodes=nodes,
+            concurrency=3,
+            ssh={"dummy": True, "responder": responder},
+            os=DebianOS(),
+            db=EtcdDB(),
+            net=net.iptables,
+            client=AtomClient(),
+            nemesis=nem.partition_random_halves(),
+            generator=g.time_limit(
+                10, g.nemesis(nem_gen, client_gen)),
+            checker=linearizable(),
+            model=cas_register(),
+            store_handle=StoreHandle(tmp_path / "run"),
+        )
+        test = run(test)
+    finally:
+        c.session = orig_session
+
+    assert test["results"]["valid"] is True
+    assert set(transports) == set(nodes)
+    for node, t in transports.items():
+        cmds = t.commands
+        # L1: debian OS setup ran (apt update since cache stat failed,
+        # then base package install).
+        assert any("apt-get update" in x for x in cmds), node
+        assert any("apt-get install -y" in x and "iptables" in x
+                   for x in cmds), node
+        # L1: db cycle = teardown (wipe) then setup (install + start).
+        i_wipe = next(i for i, x in enumerate(cmds)
+                      if "rm -rf /opt/etcd" in x)
+        i_start = next(i for i, x in enumerate(cmds)
+                       if "start-stop-daemon --start" in x)
+        assert i_wipe < i_start, node
+        assert f"--name {node}" in cmds[i_start]
+        # L3: the partitioner healed at setup and cut links at :start —
+        # iptables flush plus getent-resolved DROP rules.
+        assert any("iptables -F -w" in x for x in cmds), node
+        assert any("getent ahosts" in x and
+                   "iptables -A INPUT -s" in x and "-j DROP" in x
+                   for x in cmds), node
+        # L6: the daemon log was snarfed into the store per node.
+        assert ("/opt/etcd/etcd.log",
+                str(tmp_path / "run" / node / "opt/etcd/etcd.log")) \
+            in t.downloads, (node, t.downloads)
+        # Final teardown killed etcd again after the case.
+        assert sum("rm -rf /opt/etcd" in x for x in cmds) >= 2, node
+
+
+# --------------------------------------------------- ssh_run 255 retry
+
+def test_ssh_run_retries_transport_failures(monkeypatch):
+    """Exit 255 (OpenSSH transport failure) is retried with backoff up
+    to the session's retry budget (control.clj:140-160)."""
+    monkeypatch.setattr(c.time, "sleep", lambda s: None)
+    calls = []
+
+    def flaky(host, cmd):
+        calls.append(cmd)
+        return ("", "connection reset", 255) if len(calls) < 3 \
+            else ("pong\n", "", 0)
+
+    s = session("n1", {"dummy": True, "retries": 5}, flaky)
+    with with_session("n1", s):
+        assert exec_("ping") == "pong"
+    assert len(calls) == 3
+
+    # Budget exhausted -> the 255 surfaces as a RemoteError.
+    calls.clear()
+    s = session("n1", {"dummy": True, "retries": 2},
+                lambda h, cmd: ("", "dead", 255))
+    with with_session("n1", s):
+        with pytest.raises(RemoteError, match="255"):
+            exec_("ping")
+
+
+# ------------------------------------------- SSHTransport via shim PATH
+
+SSH_SHIM = """#!/bin/bash
+# Fake ssh: records argv, strips OpenSSH options, executes the command
+# locally. -O control operations succeed silently.
+echo "ssh $*" >> "$SHIM_LOG"
+args=()
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -o|-i|-p) shift 2 ;;
+    -O) exit 0 ;;
+    *) args+=("$1"); shift ;;
+  esac
+done
+if [ -n "$SSH_SHIM_FAILS" ] && [ -s "$SSH_SHIM_FAILS" ]; then
+  n=$(cat "$SSH_SHIM_FAILS")
+  if [ "$n" -gt 0 ]; then echo $((n-1)) > "$SSH_SHIM_FAILS"; exit 255; fi
+fi
+exec bash -c "${args[1]}"
+"""
+
+SCP_SHIM = """#!/bin/bash
+# Fake scp: records argv, strips options and the user@host: prefix,
+# copies locally.
+echo "scp $*" >> "$SHIM_LOG"
+args=()
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -o|-i|-P) shift 2 ;;
+    -r) shift ;;
+    *) args+=("$1"); shift ;;
+  esac
+done
+src="${args[0]#tester@localhost:}"
+dst="${args[1]#tester@localhost:}"
+exec cp -r "$src" "$dst"
+"""
+
+
+@pytest.fixture
+def ssh_shim(tmp_path, monkeypatch):
+    """Install fake ssh/scp executables on PATH; returns the argv log."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    log = tmp_path / "argv.log"
+    log.write_text("")
+    for name, body in (("ssh", SSH_SHIM), ("scp", SCP_SHIM)):
+        p = bin_dir / name
+        p.write_text(body)
+        p.chmod(p.stat().st_mode | stat_mod.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    monkeypatch.setenv("SHIM_LOG", str(log))
+    return log
+
+
+def test_ssh_transport_roundtrip(ssh_shim, tmp_path):
+    """exec/upload/download/close through the real SSHTransport
+    subprocess path, asserting the OpenSSH mux argv."""
+    cfg = {"username": "tester", "port": 2222}
+    s = session("localhost", cfg)
+    assert isinstance(s.transport, SSHTransport)
+    src = tmp_path / "payload.txt"
+    src.write_text("etcd tarball bytes")
+    up = tmp_path / "uploaded.txt"
+    down = tmp_path / "downloaded.txt"
+    try:
+        with with_session("localhost", s):
+            assert exec_("echo", "hello from shim") == "hello from shim"
+            with c.cd("/tmp"):
+                assert exec_("pwd") == "/tmp"
+            c.upload(str(src), str(up))
+            assert up.read_text() == "etcd tarball bytes"
+            c.download(str(up), str(down))
+            assert down.read_text() == "etcd tarball bytes"
+            # Nonzero remote exits surface as RemoteError, not retries.
+            with pytest.raises(RemoteError, match="exit status 3"):
+                exec_("bash", "-c", "exit 3")
+    finally:
+        s.close()
+    lines = ssh_shim.read_text().splitlines()
+    ssh_lines = [x for x in lines if x.startswith("ssh ")]
+    scp_lines = [x for x in lines if x.startswith("scp ")]
+    assert ssh_lines and len(scp_lines) == 2
+    for line in ssh_lines:
+        # The persistent-connection mux discipline (control.clj:270-286's
+        # session reuse, pushed into ssh(1)).
+        assert "-o ControlMaster=auto" in line
+        assert "-o ControlPath=" in line
+        assert "-o ControlPersist=60" in line
+        assert "-o BatchMode=yes" in line
+        assert "tester@localhost" in line
+        assert "-p 2222" in line
+    for line in scp_lines:
+        assert "-P 2222" in line and "tester@localhost:" in line
+    # close() issued the control-socket exit.
+    assert any("-O exit" in x for x in lines)
+
+
+def test_ssh_transport_255_retry(ssh_shim, tmp_path, monkeypatch):
+    """A transport-level 255 from the real ssh subprocess is retried by
+    ssh_run until the shim recovers."""
+    monkeypatch.setattr(c.time, "sleep", lambda s: None)
+    fails = tmp_path / "fails"
+    fails.write_text("2")
+    monkeypatch.setenv("SSH_SHIM_FAILS", str(fails))
+    s = session("localhost", {"username": "tester", "port": 2222,
+                              "retries": 5})
+    try:
+        with with_session("localhost", s):
+            assert exec_("echo", "recovered") == "recovered"
+    finally:
+        s.close()
+    assert fails.read_text().strip() == "0"
+    assert sum(1 for x in ssh_shim.read_text().splitlines()
+               if "recovered" in x) == 3
+
+
+def test_consul_db_command_stream():
+    """ConsulDB's cluster bootstrap (consul.clj:21-54): the primary
+    starts with -bootstrap; other nodes resolve the primary's IP and
+    -join it; teardown kills the agent and wipes data + install dirs."""
+    import re
+
+    from jepsen_tpu.suites.consul import CONSUL_URL, ConsulDB
+
+    ips = {"n1": "10.0.0.1", "n2": "10.0.0.2"}
+
+    def responder(host, cmd):
+        m = re.search(r"getent ahosts ([\w.-]+)", cmd)
+        if m:
+            node = m.group(1)
+            return f"{ips[node]} STREAM {node}\n", "", 0
+        if re.search(r"\bstat\b", cmd):
+            return "", "No such file or directory", 1
+        return "", "", 0
+
+    test = {"nodes": ["n1", "n2"]}
+    db = ConsulDB()
+    streams = {}
+    for node in test["nodes"]:
+        s = session(node, {"dummy": True}, responder)
+        with with_session(node, s):
+            db.setup(test, node)
+            db.teardown(test, node)
+            assert db.log_files(test, node) == ["/var/log/consul.log"]
+        streams[node] = s.transport.commands
+
+    for node, cmds in streams.items():
+        assert any(CONSUL_URL in x and "wget" in x for x in cmds), node
+        # The zip holds one top-level file: it must be unzipped INSIDE
+        # the install dir (not install_archive'd, which would make
+        # /opt/consul the binary itself).
+        i_unzip = next(i for i, x in enumerate(cmds)
+                       if "cd /opt/consul; unzip -o" in x)
+        assert "consul_1.18.1_linux_amd64.zip" in cmds[i_unzip], node
+        assert any("chmod +x /opt/consul/consul" in x for x in cmds), node
+        start = next(x for x in cmds if "start-stop-daemon --start" in x)
+        assert "--exec /opt/consul/consul" in start
+        assert "--pidfile /var/run/consul.pid" in start
+        assert ("agent -server -log-level debug -client 0.0.0.0 "
+                f"-bind {ips[node]} -data-dir /var/lib/consul "
+                f"-node {node}") in start
+        assert any("killall -9 consul" in x for x in cmds), node
+        assert any("rm -rf /var/run/consul.pid /var/lib/consul "
+                   "/opt/consul" in x for x in cmds), node
+    # Primary bootstraps; the follower joins the primary's IP.
+    assert "-bootstrap" in next(x for x in streams["n1"]
+                                if "start-stop-daemon" in x)
+    assert "-join 10.0.0.1" in next(x for x in streams["n2"]
+                                    if "start-stop-daemon" in x)
+
+
+def test_etcd_real_cluster_wiring_over_shim(ssh_shim, tmp_path):
+    """EtcdDB's log_files + the SSH transport download path compose: the
+    snarf seam (core.clj:92-123) moves a real file over the transport."""
+    d = tmp_path / "opt-etcd"
+    d.mkdir()
+    (d / "etcd.log").write_text("raft: elected leader\n")
+
+    class LocalEtcdDB(EtcdDB):
+        def log_files(self, test, node):
+            return [str(d / "etcd.log")]
+
+    s = session("localhost", {"username": "tester", "port": 2222})
+    db = LocalEtcdDB()
+    local = tmp_path / "snarfed" / "etcd.log"
+    try:
+        with with_session("localhost", s):
+            for remote in db.log_files({}, "localhost"):
+                c.download(remote, str(local))
+    finally:
+        s.close()
+    assert local.read_text() == "raft: elected leader\n"
