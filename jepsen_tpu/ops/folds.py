@@ -155,14 +155,11 @@ def _set_kernel(V: int):
     return _cached_kernel(_SET_KERNELS, V, build)
 
 
-def check_sets_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
-    """Batch twin of checkers.simple.SetChecker — :add ops + a final
-    :read of the whole set (checker.clj:131-178); one device dispatch
-    for the whole batch."""
-    enc = _encode(histories, {"add": F_ADD})
-    # Final read per row is a value *list*: lower to a [B, V] bitmap.
-    # Never-attempted elements extend the decoded domain; collect them
-    # first so the bitmap allocates once at its final width.
+def _final_read_bitmap(histories, enc: FoldBatch):
+    """Lower each row's last ok :read (a value *list*) to a [B, V]
+    bitmap over the batch vocabulary. Never-attempted elements extend
+    the decoded domain first so the bitmap allocates once at its final
+    pow2 width. Returns (V, final, has_read, finals)."""
     vocab_idx = {v: i for i, v in enumerate(enc.vocab)}
     finals: List[Optional[list]] = []
     for h in histories:
@@ -186,6 +183,15 @@ def check_sets_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
         for v in fr:
             final[r, vocab_idx[tuple(v) if isinstance(v, list) else v]] = \
                 True
+    return V, final, has_read, finals
+
+
+def check_sets_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
+    """Batch twin of checkers.simple.SetChecker — :add ops + a final
+    :read of the whole set (checker.clj:131-178); one device dispatch
+    for the whole batch."""
+    enc = _encode(histories, {"add": F_ADD})
+    V, final, has_read, _ = _final_read_bitmap(histories, enc)
     att, ok, unexpected, lost, recovered = (
         np.asarray(a) for a in _set_kernel(V)(enc.typ, enc.f, enc.val,
                                               final))
@@ -208,6 +214,80 @@ def check_sets_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
         }
 
     return [decode(r) for r in range(enc.batch)]
+
+
+# ---------------------------------------------- cockroach-style sets
+
+_CRDB_SET_KERNELS: Dict[int, object] = {}
+
+
+def _crdb_set_kernel(V: int):
+    def build():
+        def one(typ, f, val, final_read):
+            att = _counts(typ, f, val, T_INVOKE, F_ADD, V) > 0
+            add = _counts(typ, f, val, T_OK, F_ADD, V) > 0
+            failed = _counts(typ, f, val, T_FAIL, F_ADD, V) > 0
+            unsure = _counts(typ, f, val, T_INFO, F_ADD, V) > 0
+            ok = final_read & add
+            unexpected = final_read & ~att
+            revived = final_read & failed
+            lost = add & ~final_read
+            recovered = final_read & unsure
+            return att, failed, ok, unexpected, revived, lost, recovered
+
+        return jax.jit(jax.vmap(one))
+
+    return _cached_kernel(_CRDB_SET_KERNELS, V, build)
+
+
+def check_crdb_sets_batch(histories: Sequence[Sequence[Op]]) -> List[dict]:
+    """The cockroach sets checker (cockroachdb/src/jepsen/cockroach/
+    sets.clj:21-101), distinct from the knossos-style set fold: ok means
+    read AND definitely added; ``revived`` elements were reported failed
+    yet appear in the final read; ``recovered`` were indeterminate adds
+    that appear; duplicates in the final read list are violations.
+    Valid iff no lost, unexpected, duplicate, or revived elements."""
+    from collections import Counter
+
+    from ..history.core import complete
+    histories = [complete(list(h)) for h in histories]
+    enc = _encode(histories, {"add": F_ADD})
+    V, final, has_read, finals = _final_read_bitmap(histories, enc)
+    dups = [sorted(v for v, c in Counter(
+                tuple(x) if isinstance(x, list) else x
+                for x in (fr or ())).items() if c > 1)
+            for fr in finals]
+    att, failed, ok, unexpected, revived, lost, recovered = (
+        np.asarray(a) for a in _crdb_set_kernel(V)(enc.typ, enc.f,
+                                                   enc.val, final))
+
+    def decode(r: int) -> dict:
+        if not has_read[r]:
+            return {"valid": "unknown", "error": "Set was never read"}
+        els = lambda m: {enc.vocab[i] for i in np.nonzero(m[r])[0]}  # noqa
+        n_att = int(att[r].sum())
+        n_fail = int(failed[r].sum())
+        return {
+            "valid": (not lost[r].any() and not unexpected[r].any()
+                      and not dups[r] and not revived[r].any()),
+            "duplicates": dups[r],
+            "ok": integer_interval_set_str(els(ok)),
+            "lost": integer_interval_set_str(els(lost)),
+            "unexpected": integer_interval_set_str(els(unexpected)),
+            "recovered": integer_interval_set_str(els(recovered)),
+            "revived": integer_interval_set_str(els(revived)),
+            "ok-frac": fraction(int(ok[r].sum()), n_att),
+            "revived-frac": fraction(int(revived[r].sum()), n_fail),
+            "unexpected-frac": fraction(int(unexpected[r].sum()), n_att),
+            "lost-frac": fraction(int(lost[r].sum()), n_att),
+            "recovered-frac": fraction(int(recovered[r].sum()), n_att),
+        }
+
+    return [decode(r) for r in range(enc.batch)]
+
+
+def crdb_set_checker_tpu():
+    return BatchFoldChecker(check_crdb_sets_batch)
 
 
 # ---------------------------------------------------------- total-queue

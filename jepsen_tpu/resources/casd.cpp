@@ -115,7 +115,8 @@ std::string b64_decode(const std::string& in) {
 
 // Append one replayable record. Codes: S/D kv set/delete, L/U lock
 // acquire/release, I id grant, Z timestamp grant, K/X consul-kv
-// set(b64)/delete, C counter add, Q/R queue enq/deq, E set add.
+// set(b64)/delete, C counter add, Q/R queue enq/deq, E set add,
+// B bank init, T in-bank transfer, M cross-bank transfer.
 void plog(char code, const std::string& a, const std::string& b) {
   if (g_persist_path.empty()) return;
   std::ofstream f(g_persist_path, std::ios::app);
@@ -175,6 +176,12 @@ void replay() {
       long amount = atol(value.c_str() + c2 + 1);
       g_banks[key][from] -= amount;
       g_banks[key][to] += amount;
+    } else if (op == "M") {            // xtransfer; key=from, "to:amount"
+      auto c1 = value.find(':');
+      std::string tob = value.substr(0, c1);
+      long amount = atol(value.c_str() + c1 + 1);
+      g_banks[key][0] -= amount;
+      g_banks[tob][0] += amount;
     }
     ++g_index;
   }
@@ -461,6 +468,56 @@ void handle_bank(int fd, Request& req, const std::string& name) {
     plog('T', name, std::to_string(from) + ":" + std::to_string(to) +
                         ":" + std::to_string(amount));
     respond(fd, 200, "{\"ok\":true}");
+  } else if (op == "xtransfer") {
+    // Cross-bank transfer: account 0 of bank `from` -> account 0 of
+    // bank `to`, one bank per "table" (the multitable-bank shape,
+    // cockroachdb/src/jepsen/cockroach/bank.clj:180-228). Honors the
+    // same split-ms seeded race, now across distinct banks.
+    const std::string& fromb = req.form["from"];
+    const std::string& tob = req.form["to"];
+    long amount = atol(req.form["amount"].c_str());
+    std::unique_lock<std::mutex> lock(g_mu);
+    if (g_banks.find(fromb) == g_banks.end() ||
+        g_banks.find(tob) == g_banks.end()) {
+      respond(fd, 404, "{\"error\":\"no such bank\"}");
+      return;
+    }
+    if (g_banks[fromb][0] < amount) {
+      respond(fd, 409, "{\"error\":\"insufficient\"}");
+      return;
+    }
+    g_banks[fromb][0] -= amount;
+    if (g_bank_split_ms > 0) {
+      lock.unlock();
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(g_bank_split_ms));
+      lock.lock();
+    }
+    g_banks[tob][0] += amount;
+    plog('M', fromb, tob + ":" + std::to_string(amount));
+    respond(fd, 200, "{\"ok\":true}");
+  } else if (op == "xread") {
+    // Atomic snapshot across named banks (the multitable read txn,
+    // bank.clj:198-206): form banks=a,b,c -> {"balances":{a:..,b:..}}.
+    // Reads must not create banks: an unknown name is a 404, so a
+    // wiped store surfaces as absence rather than phantom zeros.
+    std::lock_guard<std::mutex> lock(g_mu);
+    std::ostringstream os;
+    os << "{\"balances\":{";
+    std::istringstream is(req.form["banks"]);
+    std::string b;
+    bool first = true;
+    while (std::getline(is, b, ',')) {
+      auto bit = g_banks.find(b);
+      if (bit == g_banks.end()) {
+        respond(fd, 404, "{\"error\":\"no such bank\"}");
+        return;
+      }
+      os << (first ? "" : ",") << "\"" << b << "\":" << bit->second[0];
+      first = false;
+    }
+    os << "}}";
+    respond(fd, 200, os.str());
   } else {  // GET: atomic snapshot of all balances
     std::lock_guard<std::mutex> lock(g_mu);
     auto& bank = g_banks[name];

@@ -1,5 +1,7 @@
-"""Cockroachdb-family suite: the bank serializability workload and the
-nemesis-product sweep runner — north-star config #5.
+"""Cockroachdb-family suite: all seven reference workloads (bank,
+multitable bank, register, sets, sequential, comments, Adya G2, plus
+the monotonic-timestamp oracle) and the nemesis-product sweep runner —
+north-star config #5.
 
 Mirrors the reference's richest suite:
 
@@ -25,9 +27,12 @@ as in the etcd suite.
 from __future__ import annotations
 
 import itertools
+import threading
+import time
 import urllib.error
 
 from .. import gen as g
+from .. import independent
 from ..checkers.core import Checker, merge_valid
 from .local_common import ServiceClient, service_test
 
@@ -218,6 +223,527 @@ def monotonic_test(**opts) -> dict:
         "cockroach-monotonic",
         TimestampClient(opts.get("client_timeout", 0.5)),
         monotonic_workload(opts), **opts)
+
+
+# ------------------------------------------------------------- register
+
+def register_workload(opts: dict) -> dict:
+    """Independent-keys CAS register with the reference's generator
+    shape (register.clj:85-103): per key, a reserved band of writer/cas
+    threads vs readers, delay_til-aligned to provoke races, checked by
+    the device-batched linearizable checker."""
+    from .etcd import ABSENT
+    from ..models.core import cas_register
+
+    per_key = opts.get("ops_per_key", 60)
+    tpk = opts.get("threads_per_key", 4)
+    nv = opts.get("n_values", 5)
+
+    def r(test, process, ctx):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def w(test, process, ctx):
+        return {"type": "invoke", "f": "write",
+                "value": ctx.rng.randrange(nv)}
+
+    def cas(test, process, ctx):
+        return {"type": "invoke", "f": "cas",
+                "value": [ctx.rng.randrange(nv), ctx.rng.randrange(nv)]}
+
+    generator = independent.concurrent_generator(
+        tpk, itertools.count(1),
+        lambda k: g.limit(per_key, g.stagger(
+            1 / 50, g.delay_til(
+                0.05, g.reserve(max(1, tpk // 2), g.mix([w, cas, cas]),
+                                r)))))
+    return {"generator": generator,
+            "checker": independent.batch_checker(),
+            "model": cas_register(ABSENT)}
+
+
+def register_test(**opts) -> dict:
+    from .etcd import EtcdClient
+    opts.setdefault("threads_per_key", 4)
+    return service_test("cockroach-register",
+                        EtcdClient(timeout=opts.get("client_timeout", 0.5)),
+                        register_workload(opts), **opts)
+
+
+# ----------------------------------------------------------------- sets
+
+class SetsClient(ServiceClient):
+    """Blind adds + one final whole-set read over /set/jepsen
+    (sets.clj:103-133's insert/select)."""
+
+    def invoke(self, test, op):
+        f = op["f"]
+
+        def body():
+            if f == "add":
+                self._req("POST", "/set/jepsen", {"v": op["value"]})
+                return {**op, "type": "ok"}
+            if f == "read":
+                r = self._req("GET", "/set/jepsen")
+                return {**op, "type": "ok",
+                        "value": [int(v) for v in r["vs"]]}
+            raise ValueError(f"unknown op {f}")
+
+        return self.guarded(op, body, mutating=f == "add")
+
+
+def sets_workload(opts: dict) -> dict:
+    """Sequential-int adds, then a final read, checked by the cockroach
+    sets fold (lost/unexpected/duplicate/revived, sets.clj:21-101)."""
+    from ..ops.folds import crdb_set_checker_tpu
+    n_ops = opts.get("n_ops", 150)
+    adds = g.seq({"type": "invoke", "f": "add", "value": i}
+                 for i in itertools.count())
+    main = g.limit(n_ops, g.stagger(1 / 100, adds))
+    final = g.once({"type": "invoke", "f": "read", "value": None})
+    return {"generator": g.phases(main, final),
+            "checker": crdb_set_checker_tpu(),
+            "model": None}
+
+
+def sets_test(**opts) -> dict:
+    return service_test("cockroach-sets",
+                        SetsClient(opts.get("client_timeout", 0.5)),
+                        sets_workload(opts), **opts)
+
+
+# ----------------------------------------------------------- sequential
+
+SEQ_KEY_COUNT = 5
+
+
+def subkeys(key_count: int, k) -> list:
+    """The subkeys used for a given key, in write order
+    (sequential.clj:43-46)."""
+    return [f"{k}_{i}" for i in range(key_count)]
+
+
+class SequentialClient(ServiceClient):
+    """Writes insert a key's subkeys in order, each in its own request;
+    reads fetch them in reverse order (sequential.clj:57-105). Client
+    order vs store order: if a later subkey is visible, every earlier
+    one must be too.
+
+    Each subkey read retries transient transport faults (the
+    reference's per-query with-txn-retry, sequential.clj:88-96): reads
+    deliberately span multiple requests — NOT one transaction — so a
+    read must survive a mid-read restart to witness the later-visible /
+    earlier-missing state."""
+
+    def __init__(self, timeout: float = 0.5,
+                 key_count: int = SEQ_KEY_COUNT):
+        super().__init__(timeout)
+        self.key_count = key_count
+
+    def setup(self, test, node):
+        cl = super().setup(test, node)
+        cl.key_count = self.key_count
+        return cl
+
+    def _get_retry(self, path: str, deadline: float):
+        while True:
+            try:
+                return self._req("GET", path)
+            except urllib.error.HTTPError:
+                raise                       # a real server answer (404)
+            except (ConnectionError, urllib.error.URLError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    def invoke(self, test, op):
+        f = op["f"]
+        k = op["value"]
+        ks = subkeys(self.key_count, k)
+
+        def body():
+            if f == "write":
+                for sk in ks:
+                    self._req("PUT", f"/v2/keys/seq-{sk}", {"value": sk})
+                return {**op, "type": "ok"}
+            if f == "read":
+                out = []
+                deadline = time.monotonic() + 2.0
+                for sk in reversed(ks):
+                    try:
+                        r = self._get_retry(f"/v2/keys/seq-{sk}",
+                                            deadline)
+                        out.append(r["node"]["value"])
+                    except urllib.error.HTTPError as e:
+                        if e.code == 404:
+                            out.append(None)
+                        else:
+                            raise
+                return {**op, "type": "ok", "value": [k, out]}
+            raise ValueError(f"unknown op {f}")
+
+        return self.guarded(op, body, mutating=f == "write")
+
+
+def trailing_none(coll) -> bool:
+    """A None anywhere after a non-None element (sequential.clj:150-153)
+    — reads run newest-subkey-first, so this means a later write was
+    visible without an earlier one."""
+    it = iter(coll)
+    for x in it:
+        if x is not None:
+            return any(y is None for y in it)
+    return False
+
+
+class SequentialChecker(Checker):
+    """Counts all/some/none reads; trailing-None reads are the
+    violations (sequential.clj:155-173)."""
+
+    def __init__(self, key_count: int = SEQ_KEY_COUNT):
+        self.key_count = key_count
+
+    def check(self, test, model, history, opts=None) -> dict:
+        reads = [op.value for op in history
+                 if op.type == "ok" and op.f == "read"
+                 and isinstance(op.value, list)]
+        none = [r for r in reads if all(x is None for x in r[1])]
+        some = [r for r in reads if any(x is None for x in r[1])]
+        bad = [r for r in reads if trailing_none(r[1])]
+        full = [r for r in reads
+                if r[1] == list(reversed(subkeys(self.key_count, r[0])))]
+        return {"valid": not bad,
+                "all-count": len(full), "some-count": len(some),
+                "none-count": len(none), "bad-count": len(bad),
+                "bad": bad[:10]}
+
+
+def sequential_workload(opts: dict) -> dict:
+    """n writer threads emitting sequential keys; the rest read recently
+    written keys (sequential.clj:107-137's writes/reads over a
+    last-written buffer)."""
+    n_writers = opts.get("n_writers", 2)
+    n_ops = opts.get("n_ops", 120)
+    key_count = opts.get("key_count", SEQ_KEY_COUNT)
+    counter = itertools.count()
+    last_written: list = []
+    lock = threading.Lock()
+
+    def writes(test, process, ctx):
+        with lock:
+            k = next(counter)
+            last_written.append(k)
+            del last_written[:-2 * n_writers]
+        return {"type": "invoke", "f": "write", "value": k}
+
+    def reads(test, process, ctx):
+        with lock:
+            if not last_written:
+                k = 0
+            else:
+                k = ctx.rng.choice(last_written)
+        return {"type": "invoke", "f": "read", "value": k}
+
+    return {"generator": g.limit(n_ops, g.stagger(
+                1 / 100, g.reserve(n_writers, writes, reads))),
+            "checker": SequentialChecker(key_count),
+            "model": None}
+
+
+def sequential_test(**opts) -> dict:
+    key_count = opts.get("key_count", SEQ_KEY_COUNT)
+    return service_test(
+        "cockroach-sequential",
+        SequentialClient(opts.get("client_timeout", 0.5), key_count),
+        sequential_workload(opts), **opts)
+
+
+# ------------------------------------------------------------- comments
+
+class CommentsClient(ServiceClient):
+    """Blind inserts of globally-ordered ids per key; reads return every
+    id visible for the key (comments.clj:42-86). Backed by a per-key
+    casd set."""
+
+    def invoke(self, test, op):
+        f = op["f"]
+        k, v = op["value"] if independent.is_kv(op["value"]) \
+            else (None, op["value"])
+
+        def done(typ, value=v, **extra):
+            out = {**op, "type": typ, **extra}
+            out["value"] = independent.tuple_(k, value) if k is not None \
+                else value
+            return out
+
+        def body():
+            if f == "write":
+                self._req("POST", f"/set/comments-{k}", {"v": v})
+                return done("ok")
+            if f == "read":
+                r = self._req("GET", f"/set/comments-{k}")
+                return done("ok", sorted(int(x) for x in r["vs"]))
+            raise ValueError(f"unknown op {f}")
+
+        return self.guarded(op, body, mutating=f == "write")
+
+
+class CommentsChecker(Checker):
+    """Strict-serializability probe (comments.clj:88-147): replaying the
+    history, every write's invoke records the set of writes already
+    completed; a read that sees write w but misses a write completed
+    before w's invoke witnesses T1 < T2 with only T2 visible."""
+
+    def check(self, test, model, history, opts=None) -> dict:
+        completed: set = set()
+        expected: dict = {}
+        errors = []
+        for op in history:
+            if op.f == "write":
+                if op.type == "invoke":
+                    expected[op.value] = frozenset(completed)
+                elif op.type == "ok":
+                    completed.add(op.value)
+            elif op.f == "read" and op.type == "ok" \
+                    and isinstance(op.value, list):
+                seen = set(op.value)
+                our_expected: set = set()
+                for s in op.value:
+                    our_expected |= expected.get(s, frozenset())
+                missing = our_expected - seen
+                if missing:
+                    errors.append({"op": op.to_dict(),
+                                   "missing": sorted(missing),
+                                   "expected-count": len(our_expected)})
+        return {"valid": not errors, "errors": errors[:10],
+                "error-count": len(errors)}
+
+
+def comments_workload(opts: dict) -> dict:
+    n_threads = opts.get("threads_per_key", 2)
+    per_key = opts.get("ops_per_key", 50)
+    ids = itertools.count()
+    lock = threading.Lock()
+
+    def writes(test, process, ctx):
+        with lock:
+            i = next(ids)
+        return {"type": "invoke", "f": "write", "value": i}
+
+    def reads(test, process, ctx):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    generator = independent.concurrent_generator(
+        n_threads, itertools.count(1),
+        lambda k: g.limit(per_key, g.stagger(1 / 100,
+                                             g.mix([reads, writes]))))
+    return {"generator": generator,
+            "checker": independent.checker(CommentsChecker()),
+            "model": None}
+
+
+def comments_test(**opts) -> dict:
+    opts.setdefault("threads_per_key", 2)
+    return service_test("cockroach-comments",
+                        CommentsClient(opts.get("client_timeout", 0.5)),
+                        comments_workload(opts), **opts)
+
+
+# ------------------------------------------------------ multitable bank
+
+class MultiBankClient(ServiceClient):
+    """One bank ("table") per account, single balance each
+    (bank.clj:180-228 MultiBankClient): transfers move between banks
+    atomically via casd's cross-bank op; reads snapshot every bank in
+    one request."""
+
+    def __init__(self, timeout: float = 0.5, accounts: int = 5,
+                 balance: int = 10):
+        super().__init__(timeout)
+        self.accounts = accounts
+        self.balance = balance
+
+    def _bank(self, i) -> str:
+        return f"acct{i}"
+
+    def setup(self, test, node):
+        cl = super().setup(test, node)
+        cl.accounts = self.accounts
+        cl.balance = self.balance
+        for i in range(cl.accounts):
+            cl._req("POST", f"/bank/{cl._bank(i)}",
+                    {"op": "init", "accounts": 1, "balance": cl.balance})
+        return cl
+
+    def invoke(self, test, op):
+        f = op["f"]
+
+        def body():
+            if f == "transfer":
+                v = op["value"]
+                try:
+                    self._req("POST", "/bank/x",
+                              {"op": "xtransfer",
+                               "from": self._bank(v["from"]),
+                               "to": self._bank(v["to"]),
+                               "amount": v["amount"]})
+                    return {**op, "type": "ok"}
+                except urllib.error.HTTPError as e:
+                    if e.code == 409:
+                        return {**op, "type": "fail",
+                                "error": "insufficient"}
+                    if e.code == 404:
+                        return {**op, "type": "fail",
+                                "error": "no-such-bank"}
+                    raise
+            if f == "read":
+                names = ",".join(self._bank(i)
+                                 for i in range(self.accounts))
+                r = self._req("POST", "/bank/x",
+                              {"op": "xread", "banks": names})
+                balances = {int(k[4:]): int(v)
+                            for k, v in r["balances"].items()}
+                return {**op, "type": "ok", "value": balances}
+            raise ValueError(f"unknown op {f}")
+
+        return self.guarded(op, body, mutating=f == "transfer")
+
+
+def multibank_test(split_ms: int = 0, **opts) -> dict:
+    """The multitable bank: same invariant and checker as bank, but
+    every balance lives in its own bank object and transfers cross
+    banks; ``split_ms`` seeds the cross-bank race."""
+    daemon_args = (["--bank-split-ms", str(split_ms)] if split_ms else [])
+    return service_test(
+        "cockroach-multibank",
+        MultiBankClient(opts.get("client_timeout", 0.5),
+                        opts.get("accounts", 5), opts.get("balance", 10)),
+        bank_workload(opts), daemon_args=daemon_args, **opts)
+
+
+# ------------------------------------------------------------------- g2
+
+class G2Client(ServiceClient):
+    """The G2 anti-dependency-cycle client (cockroach/adya.clj:24-84):
+    an insert first predicate-reads both of the key's tables; if either
+    is nonempty the other transaction already committed (:fail
+    too-late), else insert into table a or b per the id pair. The
+    read-then-insert pair is NOT atomic — exactly the window a
+    serializable store must close. ``serialized=True`` closes it with a
+    per-key lock (the valid control)."""
+
+    def __init__(self, timeout: float = 0.5, serialized: bool = False):
+        super().__init__(timeout)
+        self.serialized = serialized
+
+    def setup(self, test, node):
+        cl = super().setup(test, node)
+        cl.serialized = self.serialized
+        return cl
+
+    def _vs(self, table, k) -> list:
+        return self._req("GET", f"/set/g2{table}-{k}")["vs"]
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+
+        def txn():
+            a_id, b_id = v
+            if self._vs("a", k) or self._vs("b", k):
+                return {**op, "type": "fail", "error": "too-late"}
+            table, vid = ("a", a_id) if a_id is not None else ("b", b_id)
+            self._req("POST", f"/set/g2{table}-{k}", {"v": vid})
+            return {**op, "type": "ok"}
+
+        def body():
+            assert op["f"] == "insert"
+            if not self.serialized:
+                return txn()
+            owner = f"p{op.get('process', '?')}"
+            deadline = time.monotonic() + 2.0
+            while True:
+                try:
+                    self._req("POST", f"/lock/g2-{k}",
+                              {"op": "acquire", "owner": owner})
+                    break
+                except urllib.error.HTTPError as e:
+                    # 409 held by OUR owner string: a lost acquire
+                    # response — we do hold the lock; proceed.
+                    if e.code == 409:
+                        import json
+                        try:
+                            held = json.loads(
+                                e.read().decode(errors="replace"))["held"]
+                        except Exception:
+                            held = None
+                        if held == owner:
+                            break
+                        if time.monotonic() <= deadline:
+                            time.sleep(0.002)
+                            continue
+                    raise
+            try:
+                return txn()
+            finally:
+                # Release must not starve later inserts on this key:
+                # retry transport faults briefly; a committed insert's
+                # verdict must not be downgraded by a flaky release.
+                rel_deadline = time.monotonic() + 1.0
+                while True:
+                    try:
+                        self._req("POST", f"/lock/g2-{k}",
+                                  {"op": "release", "owner": owner})
+                        break
+                    except urllib.error.HTTPError:
+                        break       # not holder: already released
+                    except Exception:
+                        if time.monotonic() > rel_deadline:
+                            break
+                        time.sleep(0.02)
+
+        return self.guarded(op, body, mutating=True)
+
+
+def g2_test(serialized: bool = False, **opts) -> dict:
+    """Adya G2 over casd (jepsen/src/jepsen/adya.clj wired as
+    cockroach/adya.clj does): pairs of concurrent inserts per key; at
+    most one may commit. Unserialized inserts race between predicate
+    read and insert — a REAL G2 anomaly the checker must catch;
+    serialized=True is the anomaly-free control."""
+    from ..adya import g2_checker, g2_gen
+    opts.setdefault("threads_per_key", 2)
+    workload = {"generator": g.limit(opts.get("n_ops", 60), g2_gen()),
+                "checker": g2_checker(),
+                "model": None}
+    return service_test(
+        "cockroach-g2",
+        G2Client(opts.get("client_timeout", 0.5), serialized),
+        workload, **opts)
+
+
+# ------------------------------------------------------ workload registry
+
+WORKLOADS = {
+    "bank": bank_test,
+    "multibank": multibank_test,
+    "register": register_test,
+    "sets": sets_test,
+    "sequential": sequential_test,
+    "comments": comments_test,
+    "g2": g2_test,
+    "monotonic": monotonic_test,
+}
+
+
+def cockroach_test(workload: str = "bank", **opts) -> dict:
+    """Build one cockroach-family test by workload name — the suite's
+    `--workload` dispatch (runner.clj:59-93's test-by-name routing over
+    the seven reference workloads)."""
+    builder = WORKLOADS.get(workload)
+    if builder is None:
+        raise ValueError(
+            f"unknown cockroach workload {workload!r}; "
+            f"one of {sorted(WORKLOADS)}")
+    return builder(**opts)
 
 
 def product_sweep(build_test, dimensions: dict, run_fn=None) -> dict:

@@ -70,7 +70,11 @@ class ConsulDB(DB):
             if node == prim:
                 args += ["-bootstrap"]
             else:
-                args += ["-join", net_helpers.ip(str(prim))]
+                # -retry-join, not -join: DB setup runs on all nodes in
+                # parallel, so a follower may start before the primary
+                # is listening; one-shot -join would fail and kill the
+                # agent.
+                args += ["-retry-join", net_helpers.ip(str(prim))]
             cu.start_daemon(
                 {"logfile": LOG_FILE, "pidfile": PIDFILE, "chdir": DIR},
                 BINARY, *args)

@@ -55,7 +55,7 @@ class ServiceClient(Client):
         data = urllib.parse.urlencode(form).encode() \
             if form is not None else b""
         req = urllib.request.Request(
-            url, data=data if method == "POST" else None, method=method)
+            url, data=data if method != "GET" else None, method=method)
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
             return json.loads(r.read().decode())
 

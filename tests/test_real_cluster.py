@@ -397,8 +397,8 @@ def test_consul_db_command_stream():
     # Primary bootstraps; the follower joins the primary's IP.
     assert "-bootstrap" in next(x for x in streams["n1"]
                                 if "start-stop-daemon" in x)
-    assert "-join 10.0.0.1" in next(x for x in streams["n2"]
-                                    if "start-stop-daemon" in x)
+    assert "-retry-join 10.0.0.1" in next(x for x in streams["n2"]
+                                          if "start-stop-daemon" in x)
 
 
 def test_etcd_real_cluster_wiring_over_shim(ssh_shim, tmp_path):

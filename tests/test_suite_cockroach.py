@@ -1,0 +1,285 @@
+"""Cockroach-family workloads end-to-end: the five round-4 additions
+(register, sets, sequential, comments, multitable bank) plus Adya G2,
+each against REAL casd processes with a seeded violation its checker
+must catch, mirroring the reference's seven-workload suite
+(cockroachdb/src/jepsen/cockroach/{register,sets,sequential,comments,
+bank,adya}.clj)."""
+import shutil
+import subprocess
+
+import pytest
+
+from jepsen_tpu.history.core import index
+from jepsen_tpu.history.ops import fail_op, info_op, invoke_op, ok_op
+from jepsen_tpu.runtime import run
+from jepsen_tpu.suites.cockroachdb import (CommentsChecker,
+                                           SequentialChecker, WORKLOADS,
+                                           cockroach_test, comments_test,
+                                           g2_test, multibank_test,
+                                           register_test, sequential_test,
+                                           sets_test, trailing_none)
+
+
+def _cleanup():
+    subprocess.run(["bash", "-c", "pkill -9 -f '[c]asd --port' || true"],
+                   capture_output=True)
+    shutil.rmtree("/tmp/jepsen/cockroach-register", ignore_errors=True)
+
+
+@pytest.fixture(autouse=True)
+def clean_casd():
+    _cleanup()
+    yield
+    _cleanup()
+
+
+def _opts(tmp_path, port, **kw):
+    opts = dict(client_timeout=0.5, casd_dir=str(tmp_path / "casd"),
+                base_port=port, time_limit=12)
+    opts.update(kw)
+    return opts
+
+
+# ----------------------------------------------- checker truth tables
+
+def test_crdb_sets_fold_truth_table():
+    """The cockroach sets semantics (sets.clj:21-101): lost /
+    unexpected / duplicate / revived each invalidate; recovered
+    (indeterminate adds that appear) does not."""
+    from jepsen_tpu.ops.folds import check_crdb_sets_batch
+
+    def h(adds, final):
+        ops = []
+        for v, typ in adds:
+            ops.append(invoke_op(0, "add", v))
+            ops.append({"ok": ok_op, "fail": fail_op,
+                        "info": info_op}[typ](0, "add", v))
+        ops += [invoke_op(1, "read", None), ok_op(1, "read", final)]
+        return index(ops)
+
+    rows = [
+        h([(1, "ok"), (2, "ok")], [1, 2]),           # clean
+        h([(1, "ok"), (2, "ok")], [1]),              # lost 2
+        h([(1, "ok")], [1, 9]),                      # unexpected 9
+        h([(1, "ok"), (2, "fail")], [1, 2]),         # revived 2
+        h([(1, "ok"), (2, "info")], [1, 2]),         # recovered 2: fine
+        h([(1, "ok")], [1, 1]),                      # duplicate 1
+        index([invoke_op(0, "add", 1), ok_op(0, "add", 1)]),  # no read
+    ]
+    out = check_crdb_sets_batch(rows)
+    assert [r["valid"] for r in out] == [
+        True, False, False, False, True, False, "unknown"]
+    assert out[1]["lost"] == "#{2}"
+    assert out[2]["unexpected"] == "#{9}"
+    assert out[3]["revived"] == "#{2}"
+    assert out[4]["recovered"] == "#{2}"
+    assert out[5]["duplicates"] == [1]
+
+
+def test_trailing_none_and_sequential_checker():
+    assert not trailing_none([None, None, None])
+    assert not trailing_none([None, "a", "b"])      # older missing is fine
+    assert trailing_none(["b", None, "a"])
+    assert trailing_none([None, "b", None])
+    # reads are [key, [newest..oldest subkey values]]
+    good = [invoke_op(0, "read", 7),
+            ok_op(0, "read", [7, ["7_1", "7_0"]]),
+            invoke_op(0, "read", 8),
+            ok_op(0, "read", [8, [None, "8_0"]]),
+            invoke_op(0, "read", 9),
+            ok_op(0, "read", [9, [None, None]])]
+    r = SequentialChecker(2).check({}, None, index(good))
+    assert r["valid"] is True
+    assert r["all-count"] == 1 and r["none-count"] == 1 \
+        and r["some-count"] == 2
+    bad = [invoke_op(0, "read", 7),
+           ok_op(0, "read", [7, ["7_1", None]])]
+    r = SequentialChecker(2).check({}, None, index(bad))
+    assert r["valid"] is False and r["bad-count"] == 1
+
+
+def test_comments_checker_truth_table():
+    """A read seeing w2 but missing w1, where w1 completed before w2's
+    invoke, is the strict-serializability violation
+    (comments.clj:88-147)."""
+    ok_h = index([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "write", 2), ok_op(1, "write", 2),
+        invoke_op(2, "read", None), ok_op(2, "read", [1, 2]),
+        # concurrent writes: seeing either alone is legal
+        invoke_op(3, "write", 3),
+        invoke_op(2, "read", None), ok_op(2, "read", [1, 2]),
+        ok_op(3, "write", 3),
+    ])
+    assert CommentsChecker().check({}, None, ok_h)["valid"] is True
+
+    bad_h = index([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "write", 2), ok_op(1, "write", 2),
+        invoke_op(2, "read", None), ok_op(2, "read", [2]),   # missing 1
+    ])
+    r = CommentsChecker().check({}, None, bad_h)
+    assert r["valid"] is False
+    assert r["errors"][0]["missing"] == [1]
+
+
+def test_workload_registry_dispatch():
+    assert set(WORKLOADS) == {"bank", "multibank", "register", "sets",
+                              "sequential", "comments", "g2", "monotonic"}
+    with pytest.raises(ValueError, match="unknown cockroach workload"):
+        cockroach_test("zonefetch")
+
+
+# ------------------------------------------------------------ register
+
+def test_register_healthy_valid(tmp_path):
+    test = cockroach_test("register", persist=True,
+                          **_opts(tmp_path, 26000, ops_per_key=40,
+                                  time_limit=10))
+    r = run(test)
+    assert r["results"]["valid"] is True, r["results"]
+
+
+def test_register_restart_wipe_detected(tmp_path):
+    """A state-wiping restart makes post-wipe reads observe ABSENT after
+    acknowledged writes — not linearizable."""
+    test = register_test(nemesis_mode="restart", persist=False,
+                         **_opts(tmp_path, 26010, ops_per_key=60,
+                                 nemesis_cadence=0.5, time_limit=8))
+    r = run(test)
+    assert r["results"]["valid"] is False, r["results"]
+
+
+# ---------------------------------------------------------------- sets
+
+def test_sets_healthy_valid(tmp_path):
+    test = sets_test(persist=True, **_opts(tmp_path, 26020, n_ops=120,
+                                           time_limit=10))
+    r = run(test)
+    res = r["results"]
+    assert res["valid"] is True, res
+    assert res["lost"] == "#{}" and res["duplicates"] == []
+    assert res["ok"] != "#{}"
+
+
+def test_sets_restart_lost_elements_detected(tmp_path):
+    """Adds are unique ints, so any acknowledged add wiped by a restart
+    can never reappear: the final read must come up short."""
+    # A wipe only seeds the violation if it lands inside the add phase;
+    # --delay-ms stretches that phase across many 0.2s restart cycles so
+    # scheduling jitter (1-CPU CI) can't push every kill past the final
+    # read.
+    test = sets_test(nemesis_mode="restart", persist=False,
+                     daemon_args=["--delay-ms", "5"],
+                     **_opts(tmp_path, 26030, n_ops=400,
+                             nemesis_cadence=0.2, time_limit=10))
+    r = run(test)
+    res = r["results"]
+    assert res["valid"] is False, res
+    assert res["lost"] != "#{}"
+
+
+# ----------------------------------------------------------- sequential
+
+def test_sequential_healthy_valid(tmp_path):
+    test = sequential_test(persist=True,
+                           **_opts(tmp_path, 26040, n_ops=100,
+                                   time_limit=10))
+    r = run(test)
+    res = r["results"]
+    assert res["valid"] is True, res
+    assert res["all-count"] >= 1
+
+
+def test_sequential_restart_trailing_none_detected(tmp_path):
+    """--delay-ms stretches each subkey PUT so writers are mid-sequence
+    most of the time; a wipe then leaves later subkeys present without
+    earlier ones (written pre-wipe), and reads of recent keys see a
+    trailing None."""
+    test = sequential_test(nemesis_mode="restart", persist=False,
+                           daemon_args=["--delay-ms", "10"],
+                           **_opts(tmp_path, 26050, n_ops=2000,
+                                   nemesis_cadence=0.4, time_limit=11))
+    r = run(test)
+    res = r["results"]
+    assert res["valid"] is False, res
+    assert res["bad-count"] >= 1
+
+
+# ------------------------------------------------------------- comments
+
+def test_comments_healthy_valid(tmp_path):
+    test = comments_test(persist=True,
+                         **_opts(tmp_path, 26060, ops_per_key=40,
+                                 time_limit=10))
+    r = run(test)
+    assert r["results"]["valid"] is True, r["results"]
+
+
+def test_comments_restart_missing_writes_detected(tmp_path):
+    """A wipe mid-key erases completed comments; later reads of that key
+    see newer ids without the ones completed before them."""
+    test = comments_test(nemesis_mode="restart", persist=False,
+                         **_opts(tmp_path, 26070, ops_per_key=60,
+                                 nemesis_cadence=0.5, time_limit=10))
+    r = run(test)
+    assert r["results"]["valid"] is False, r["results"]
+
+
+# ------------------------------------------------------ multitable bank
+
+def test_multibank_healthy_valid(tmp_path):
+    test = multibank_test(**_opts(tmp_path, 26080, n_ops=250))
+    r = run(test)
+    res = r["results"]
+    assert res["valid"] is True, res
+    assert res["reads"] >= 20
+    transfers = sum(1 for op in r["history"]
+                    if op.type == "ok" and op.f == "transfer")
+    assert transfers >= 20
+
+
+def test_multibank_split_transfer_detected(tmp_path):
+    """The split race now crosses banks: the atomic xread snapshot
+    observes the debited-but-not-credited state."""
+    test = multibank_test(split_ms=10,
+                          **_opts(tmp_path, 26090, n_ops=400))
+    r = run(test)
+    res = r["results"]
+    assert res["valid"] is False, res
+    assert "total" in res["bad-reads"][0]["error"]
+
+
+def test_multibank_restart_with_persistence_stays_valid(tmp_path):
+    """Cross-bank transfers land in the WAL ('M' records): kill -9 +
+    replay preserves the invariant."""
+    test = multibank_test(nemesis_mode="restart", persist=True,
+                          **_opts(tmp_path, 26095, n_ops=300,
+                                  nemesis_cadence=0.9, time_limit=6))
+    r = run(test)
+    assert r["results"]["valid"] is True, r["results"]
+
+
+# ------------------------------------------------------------------- g2
+
+def test_g2_serialized_control_valid(tmp_path):
+    """With the per-key lock closing the read->insert window, at most
+    one insert per key commits: no anomaly."""
+    test = g2_test(serialized=True,
+                   **_opts(tmp_path, 26100, n_ops=40, time_limit=10))
+    r = run(test)
+    res = r["results"]
+    assert res["valid"] is True, res
+    assert res["key-count"] >= 5
+
+
+def test_g2_unserialized_anomaly_detected(tmp_path):
+    """Without serialization, paired inserts race between predicate read
+    and insert (window widened by --delay-ms): both commit for some key
+    — a real G2 anti-dependency anomaly the checker must flag."""
+    test = g2_test(serialized=False, daemon_args=["--delay-ms", "10"],
+                   **_opts(tmp_path, 26110, n_ops=120, time_limit=11))
+    r = run(test)
+    res = r["results"]
+    assert res["valid"] is False, res
+    assert res["illegal-count"] >= 1
