@@ -296,6 +296,73 @@ def node_start_stopper(targeter: Callable, start_fn: Callable,
     return NodeStartStopper(targeter, start_fn, stop_fn)
 
 
+class Slowing(Client):
+    """Wraps a nemesis: before its :start, slow the network; once its
+    :stop resolves, restore speeds (cockroach nemesis.clj:153-176's
+    slowing)."""
+
+    def __init__(self, nem: Client, mean_ms: int = 500):
+        self.nem = nem
+        self.mean_ms = mean_ms
+
+    def setup(self, test, node):
+        test["net"].fast(test)
+        inner = self.nem.setup(test, node)
+        return Slowing(inner, self.mean_ms)
+
+    def invoke(self, test, op):
+        if op["f"] == "start":
+            test["net"].slow(test, mean_ms=self.mean_ms)
+            return self.nem.invoke(test, op)
+        if op["f"] == "stop":
+            try:
+                return self.nem.invoke(test, op)
+            finally:
+                test["net"].fast(test)
+        return self.nem.invoke(test, op)
+
+    def teardown(self, test):
+        test["net"].fast(test)
+        self.nem.teardown(test)
+
+
+def slowing(nem: Client, mean_ms: int = 500) -> Client:
+    return Slowing(nem, mean_ms)
+
+
+class Restarting(Client):
+    """Wraps a nemesis: after its :stop completes, restart the database
+    on every node (cockroach nemesis.clj:178-199's restarting) — clock
+    nemeses may have crashed time-sensitive daemons."""
+
+    def __init__(self, nem: Client, restart_fn: Callable):
+        self.nem = nem
+        self.restart_fn = restart_fn
+
+    def setup(self, test, node):
+        return Restarting(self.nem.setup(test, node), self.restart_fn)
+
+    def invoke(self, test, op):
+        out = self.nem.invoke(test, op)
+        if op["f"] == "stop":
+            def f(t, node):
+                try:
+                    self.restart_fn(t, node)
+                    return "started"
+                except Exception as e:  # noqa: BLE001 — reported in value
+                    return str(e)
+            status = on_nodes(test, f)
+            return {**out, "value": [out.get("value"), status]}
+        return out
+
+    def teardown(self, test):
+        self.nem.teardown(test)
+
+
+def restarting(nem: Client, restart_fn: Callable) -> Client:
+    return Restarting(nem, restart_fn)
+
+
 def hammer_time(process: str, targeter: Optional[Callable] = None) -> Client:
     """SIGSTOP a process on targeted nodes at :start; SIGCONT at :stop
     (nemesis.clj:227-241)."""

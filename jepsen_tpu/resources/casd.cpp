@@ -60,6 +60,17 @@ std::map<std::string, std::set<std::string>> g_sets;
 std::map<std::string, std::map<long, long>> g_banks;      // name -> acct->bal
 long g_next_id = 0;
 long g_next_ts = 0;                 // monotonic timestamp oracle
+// --ts-wall: /ts/next returns wall-clock-derived timestamps instead of
+// the counter — a naive clock-trusting oracle (the seam cockroach's
+// hybrid-logical clock papers over). /ctl/clock skews this process's
+// notion of wall time by an offset, the local-mode analog of the
+// bump-time/strobe-time C tools run against a node's real clock
+// (jepsen/resources/bump-time.c; cockroach nemesis.clj:233-255): a
+// negative bump makes later grants regress below earlier ones, which
+// the monotonic checker must catch.
+bool g_ts_wall = false;
+long g_clock_offset_ms = 0;
+long g_ts_seq = 0;                  // sub-ms disambiguator
 std::map<std::string, std::string> g_kv;       // consul-style KV
 std::map<std::string, long> g_kv_index;        // per-key ModifyIndex
 long g_kv_counter = 0;
@@ -308,9 +319,31 @@ void handle_service(int fd, Request& req) {
     plog('I', "-", "-");
     respond(fd, 200, "{\"id\":" + std::to_string(id) + "}");
   } else if (req.path == "/ts/next") {
-    long ts = g_next_ts++;
-    plog('Z', "-", "-");
+    long ts;
+    if (g_ts_wall) {
+      auto now = std::chrono::duration_cast<std::chrono::milliseconds>(
+                     std::chrono::system_clock::now().time_since_epoch())
+                     .count();
+      // Trusts the (possibly skewed) clock: no max() against the
+      // previous grant — that trust is what the monotonic test probes.
+      // seq is unbounded (not mod-wrapped): under a steady clock ts
+      // stays strictly increasing even past 1000 grants/ms; a skew
+      // bump (>=100ms = 1e5 ticks) still dwarfs seq drift.
+      ts = (now + g_clock_offset_ms) * 1000 + g_ts_seq++;
+    } else {
+      ts = g_next_ts++;
+      plog('Z', "-", "-");
+    }
     respond(fd, 200, "{\"ts\":" + std::to_string(ts) + "}");
+  } else if (req.path == "/ctl/clock") {
+    // Skew this daemon's wall clock by delta_ms (cumulative); absolute
+    // reset via set_ms. Admin seam for the local clock nemesis.
+    if (req.form.count("set_ms"))
+      g_clock_offset_ms = atol(req.form["set_ms"].c_str());
+    else
+      g_clock_offset_ms += atol(req.form["delta_ms"].c_str());
+    respond(fd, 200,
+            "{\"offset_ms\":" + std::to_string(g_clock_offset_ms) + "}");
   } else if (starts_with(req.path, "/v1/kv/", &name)) {
     // consul KV subset: base64 values, index-based check-and-set.
     auto it = g_kv.find(name);
@@ -534,7 +567,7 @@ void handle_bank(int fd, Request& req, const std::string& name) {
 }
 
 bool is_service_path(const std::string& p) {
-  return p == "/ids/next" || p == "/ts/next" ||
+  return p == "/ids/next" || p == "/ts/next" || p == "/ctl/clock" ||
          p.rfind("/v1/kv/", 0) == 0 || p.rfind("/lock/", 0) == 0 ||
          p.rfind("/counter/", 0) == 0 || p.rfind("/queue/", 0) == 0 ||
          p.rfind("/set/", 0) == 0;
@@ -606,6 +639,8 @@ int main(int argc, char** argv) {
     if (!strcmp(argv[i], "--bank-split-ms"))
       g_bank_split_ms = atoi(argv[i + 1]);
   }
+  for (int i = 1; i < argc; ++i)
+    if (!strcmp(argv[i], "--ts-wall")) g_ts_wall = true;
   replay();
   signal(SIGPIPE, SIG_IGN);
 

@@ -216,9 +216,17 @@ def monotonic_workload(opts: dict) -> dict:
 
 
 def monotonic_test(**opts) -> dict:
-    """Timestamp-oracle monotonicity test; a state-wiping restart
-    resets the oracle, and post-restart grants regress below completed
-    pre-restart grants — the seeded violation."""
+    """Timestamp-oracle monotonicity test. Violation seams: a
+    state-wiping restart resets the counter oracle (post-restart grants
+    regress below completed pre-restart grants); with ``ts_wall=True``
+    the oracle trusts the daemon's wall clock, and the clock/strobe
+    nemeses (nemesis_mode="clock"/"strobe") skew it backwards — the
+    local composition of clock skew against a time-sensitive workload
+    (cockroach monotonic.clj x nemesis.clj:233-269)."""
+    if opts.get("ts_wall"):
+        opts["daemon_args"] = list(opts.get("daemon_args", ())) + \
+            ["--ts-wall"]
+    opts.pop("ts_wall", None)
     return service_test(
         "cockroach-monotonic",
         TimestampClient(opts.get("client_timeout", 0.5)),

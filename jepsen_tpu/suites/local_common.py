@@ -77,6 +77,73 @@ class ServiceClient(Client):
             return {**op, "type": "info", "error": str(reason)}
 
 
+# The reference's named skew magnitudes (cockroach nemesis.clj:257-269:
+# small/subcritical/critical are within/at/over the HLC max-offset;
+# big/huge pair with `slowing`). Values in ms; the bumper applies them
+# as negative offsets (backwards skew is what regresses an oracle).
+SKEWS = {"small": 100, "subcritical": 200, "critical": 250,
+         "big": 500, "huge": 5000}
+
+
+def _clock_curl(t, node, **form) -> None:
+    """POST the daemon's /ctl/clock admin seam from ON the node (rides
+    the control plane, like the real clock tools in nemesis/time.py)."""
+    from ..control.core import exec_star
+    port = t["casd_ports"][node]
+    data = "&".join(f"{k}={v}" for k, v in form.items())
+    exec_star(f"curl -sf -X POST -d {data} "
+              f"http://127.0.0.1:{port}/ctl/clock >/dev/null")
+
+
+def _clock_reset(t, node) -> str:
+    _clock_curl(t, node, set_ms=0)
+    return "reset"
+
+
+def _casd_clock_bumper(offset_ms: int = -60000, targeter=None,
+                       skew: Optional[str] = None):
+    """Bump the targeted daemon's wall clock by offset_ms at :start
+    (or by a named reference magnitude via ``skew``), reset at :stop —
+    the local-mode analog of bump-time on a node's real clock
+    (cockroach nemesis.clj:233-255's bump-time; the C tool path is
+    nemesis/time.py)."""
+    from ..nemesis import core as nem
+    if skew is not None:
+        offset_ms = -SKEWS[skew]
+
+    def start(t, node):
+        _clock_curl(t, node, delta_ms=offset_ms)
+        return f"bumped {offset_ms}ms"
+
+    return nem.node_start_stopper(targeter or (lambda nodes: nodes[0]),
+                                  start, _clock_reset)
+
+
+def _casd_clock_strober(delta_ms: int = 200, period_ms: int = 10,
+                        duration_s: float = 2.0, targeter=None):
+    """Flip the daemon's clock between +delta and normal every period
+    for duration (strobe-time semantics, nemesis.clj:202-230 /
+    resources/strobe-time.c), as one blocking node-side loop."""
+    from ..control.core import exec_star
+    from ..nemesis import core as nem
+
+    flips = max(1, int(duration_s * 1000 / (2 * period_ms)))
+
+    def start(t, node):
+        port = t["casd_ports"][node]
+        url = f"http://127.0.0.1:{port}/ctl/clock"
+        exec_star(
+            f"for i in $(seq {flips}); do "
+            f"curl -sf -X POST -d set_ms={delta_ms} {url} >/dev/null; "
+            f"sleep {period_ms / 1000}; "
+            f"curl -sf -X POST -d set_ms=0 {url} >/dev/null; "
+            f"sleep {period_ms / 1000}; done")
+        return f"strobed {flips}x{delta_ms}ms"
+
+    return nem.node_start_stopper(targeter or (lambda nodes: nodes[0]),
+                                  start, _clock_reset)
+
+
 def service_test(name: str, client: Client, workload: dict,
                  nemesis_mode: Optional[str] = None, persist: bool = True,
                  daemon_args=(), **opts) -> dict:
@@ -117,6 +184,15 @@ def service_test(name: str, client: Client, workload: dict,
         test["nemesis"] = _casd_pauser(test)
     elif nemesis_mode == "restart":
         test["nemesis"] = _casd_restarter(db)
+    elif nemesis_mode == "clock":
+        test["nemesis"] = _casd_clock_bumper(
+            opts.get("clock_offset_ms", -60000),
+            skew=opts.get("clock_skew"))
+    elif nemesis_mode == "strobe":
+        test["nemesis"] = _casd_clock_strober(
+            opts.get("strobe_delta_ms", 200),
+            opts.get("strobe_period_ms", 10),
+            opts.get("strobe_duration_s", 2.0))
     nem_gen = None
     if test.get("nemesis"):
         import itertools

@@ -1,0 +1,225 @@
+"""Clock skew composed against time-sensitive workloads: the casd
+wall-clock oracle under bump/strobe nemeses (cockroach monotonic.clj x
+nemesis.clj:202-269), the slowing/restarting nemesis wrappers, and the
+{workload} x {nemesis} product sweep with an expected-verdict matrix
+(runner.clj:94-138's nemesis-dimension discipline)."""
+import shutil
+import subprocess
+
+import pytest
+
+from jepsen_tpu.runtime import run
+from jepsen_tpu.suites.cockroachdb import (bank_test, monotonic_test,
+                                           product_sweep)
+from jepsen_tpu.suites.local_common import SKEWS
+
+
+def _cleanup():
+    subprocess.run(["bash", "-c", "pkill -9 -f '[c]asd --port' || true"],
+                   capture_output=True)
+    shutil.rmtree("/tmp/jepsen/cockroach-monotonic", ignore_errors=True)
+
+
+@pytest.fixture(autouse=True)
+def clean_casd():
+    _cleanup()
+    yield
+    _cleanup()
+
+
+def _opts(tmp_path, port, **kw):
+    opts = dict(client_timeout=0.5, casd_dir=str(tmp_path / "casd"),
+                base_port=port, time_limit=8)
+    opts.update(kw)
+    return opts
+
+
+# ------------------------------------------------- wall-clock oracle
+
+def test_wall_oracle_healthy_valid(tmp_path):
+    """With no skew, wall-clock-derived grants only move forward."""
+    test = monotonic_test(ts_wall=True,
+                          **_opts(tmp_path, 26500, n_ops=150))
+    r = run(test)
+    res = r["results"]
+    assert res["valid"] is True, res
+    assert res["grants"] >= 100
+
+
+def test_clock_bump_regression_detected(tmp_path):
+    """A -60s bump on the node the clients talk to makes post-bump
+    grants regress below completed pre-bump grants: the monotonic
+    checker must flag them."""
+    # Grants flow at ~400/s; the first bump must land inside the grant
+    # window, so cycle from t=0.4s.
+    test = monotonic_test(ts_wall=True, nemesis_mode="clock",
+                          **_opts(tmp_path, 26510, n_ops=900,
+                                  nemesis_cadence=0.4, time_limit=8))
+    r = run(test)
+    res = r["results"]
+    assert res["valid"] is False, res
+    assert res["regression-count"] >= 1
+    assert any(op.f == "start" and "bumped" in str(op.value)
+               for op in r["history"])
+
+
+def test_clock_strobe_regression_detected(tmp_path):
+    """Strobing the clock +200ms/normal every 10ms interleaves grants
+    from both phases: regressions across every flip."""
+    test = monotonic_test(ts_wall=True, nemesis_mode="strobe",
+                          **_opts(tmp_path, 26520, n_ops=600,
+                                  nemesis_cadence=0.5, time_limit=8,
+                                  strobe_duration_s=2.0))
+    r = run(test)
+    res = r["results"]
+    assert res["valid"] is False, res
+    assert res["regression-count"] >= 1
+    assert any(op.f == "start" and "strobed" in str(op.value)
+               for op in r["history"])
+
+
+def test_counter_oracle_immune_to_clock_skew(tmp_path):
+    """The default counter oracle never consults the clock: the same
+    bump schedule must leave it valid (the checker discriminates the
+    oracle, not the nemesis)."""
+    test = monotonic_test(ts_wall=False, nemesis_mode="clock",
+                          **_opts(tmp_path, 26530, n_ops=300,
+                                  nemesis_cadence=1.0, time_limit=6))
+    r = run(test)
+    assert r["results"]["valid"] is True, r["results"]
+
+
+# ------------------------------------------------- nemesis wrappers
+
+class _RecordingNet:
+    def __init__(self):
+        self.calls = []
+
+    def slow(self, test, mean_ms=500, **kw):
+        self.calls.append(("slow", mean_ms))
+
+    def fast(self, test):
+        self.calls.append(("fast",))
+
+    def heal(self, test):
+        self.calls.append(("heal",))
+
+
+class _RecordingNemesis:
+    def __init__(self, log=None):
+        self.log = log if log is not None else []
+
+    def setup(self, test, node):
+        return _RecordingNemesis(self.log)
+
+    def invoke(self, test, op):
+        self.log.append(op["f"])
+        return {**op, "value": "inner"}
+
+    def teardown(self, test):
+        self.log.append("teardown")
+
+
+def test_slowing_wrapper_brackets_start_stop():
+    """slowing: net.slow before the inner :start, net.fast after the
+    inner :stop resolves (nemesis.clj:153-176)."""
+    from jepsen_tpu.nemesis.core import slowing
+
+    net = _RecordingNet()
+    test = {"net": net}
+    inner = _RecordingNemesis()
+    nem = slowing(inner, mean_ms=250).setup(test, None)
+    assert net.calls == [("fast",)]          # setup restores speeds
+    net.calls.clear()
+    out = nem.invoke(test, {"type": "info", "f": "start"})
+    assert out["value"] == "inner"
+    assert net.calls == [("slow", 250)]
+    net.calls.clear()
+    nem.invoke(test, {"type": "info", "f": "stop"})
+    assert net.calls == [("fast",)]
+    assert inner.log == ["start", "stop"]
+    nem.teardown(test)
+    assert inner.log[-1] == "teardown"
+
+
+def test_restarting_wrapper_restarts_after_stop():
+    """restarting: after the inner :stop, the restart fn runs on every
+    node and its status lands in the op value (nemesis.clj:178-199)."""
+    from jepsen_tpu.control.core import session
+    from jepsen_tpu.nemesis.core import restarting
+
+    test = {"nodes": ["n1", "n2"],
+            "sessions": {n: session(n, {"dummy": True})
+                         for n in ("n1", "n2")}}
+    restarted = []
+    inner = _RecordingNemesis()
+
+    def restart(t, node):
+        restarted.append(node)
+
+    nem = restarting(inner, restart).setup(test, None)
+    out = nem.invoke(test, {"type": "info", "f": "start"})
+    assert restarted == [] and out["value"] == "inner"
+    out = nem.invoke(test, {"type": "info", "f": "stop"})
+    assert sorted(restarted) == ["n1", "n2"]
+    assert out["value"] == ["inner", {"n1": "started", "n2": "started"}]
+
+
+def test_named_skews_wire_to_bumper_command():
+    """A clock_skew name resolves through SKEWS to a negative bump in
+    the actual node-side command (nemesis.clj:257-269's named skews)."""
+    from jepsen_tpu.control.core import session
+    from jepsen_tpu.suites.local_common import _casd_clock_bumper
+
+    test = {"nodes": ["n1"],
+            "sessions": {"n1": session("n1", {"dummy": True})},
+            "casd_ports": {"n1": 4242}}
+    nem = _casd_clock_bumper(skew="huge").setup(test, None)
+    out = nem.invoke(test, {"type": "info", "f": "start"})
+    assert out["value"] == {"n1": f"bumped {-SKEWS['huge']}ms"}
+    nem.invoke(test, {"type": "info", "f": "stop"})
+    cmds = test["sessions"]["n1"].transport.commands
+    assert any("delta_ms=-5000" in c and ":4242/ctl/clock" in c
+               for c in cmds), cmds
+    assert any("set_ms=0" in c for c in cmds), cmds
+
+
+# ------------------------------------------ workload x nemesis sweep
+
+def test_clock_sweep_expected_verdicts(tmp_path):
+    """The sweep over {bank, monotonic} x {none, pause, clock, restart}
+    (persisted daemons, wall oracle for monotonic): exactly the
+    monotonic x clock cell is invalid — partitions and restarts don't
+    break a persisted oracle, and the bank invariant is
+    clock-insensitive."""
+    ports = iter(range(26540, 26700, 10))
+
+    def build(workload, nemesis_mode):
+        opts = _opts(tmp_path, next(ports), time_limit=5,
+                     nemesis_cadence=0.4,
+                     casd_dir=str(tmp_path / "casd" /
+                                  f"{workload}-{nemesis_mode}"))
+        if workload == "bank":
+            return bank_test(nemesis_mode=nemesis_mode, persist=True,
+                             n_ops=150, **opts)
+        return monotonic_test(ts_wall=True, nemesis_mode=nemesis_mode,
+                              persist=True, n_ops=900, **opts)
+
+    out = product_sweep(build, {
+        "workload": ["bank", "monotonic"],
+        "nemesis_mode": [None, "pause", "clock", "restart"],
+    })
+    assert len(out["runs"]) == 8
+    verdicts = {label: r["valid"] for label, r in out["runs"].items()}
+    expected = {
+        "workload=bank,nemesis_mode=None": True,
+        "workload=bank,nemesis_mode=pause": True,
+        "workload=bank,nemesis_mode=clock": True,
+        "workload=bank,nemesis_mode=restart": True,
+        "workload=monotonic,nemesis_mode=None": True,
+        "workload=monotonic,nemesis_mode=pause": True,
+        "workload=monotonic,nemesis_mode=clock": False,
+        "workload=monotonic,nemesis_mode=restart": True,
+    }
+    assert verdicts == expected, verdicts
+    assert out["valid"] is False
