@@ -181,15 +181,27 @@ def run_cli(subcommands: Dict[str, dict],
 SUITE_OPT_KEYS = ("time_limit", "nemesis_mode", "persist", "n_ops",
                   "ops_per_key", "threads_per_key", "n_nodes",
                   "base_port", "casd_dir", "nemesis_cadence", "n_values",
-                  "split_ms", "accounts", "seed")
+                  "split_ms", "accounts", "seed", "workload", "clock_skew",
+                  "ts_wall", "serialized")
 
 
 # Registry names are static so building the parser (--help, serve,
 # usage errors) never pays the suite-module/jax import cost; the
 # builders resolve lazily at run time.
-SUITE_NAMES = ("etcd", "etcd-casd", "hazelcast-lock", "hazelcast-ids",
-               "hazelcast-queue", "rabbitmq", "aerospike",
-               "elasticsearch", "consul", "bank", "monotonic")
+SUITE_NAMES = ("etcd", "etcd-casd", "hazelcast", "hazelcast-lock",
+               "hazelcast-ids", "hazelcast-queue", "rabbitmq", "aerospike",
+               "elasticsearch", "consul", "cockroach", "bank", "monotonic")
+
+# Suites whose builder dispatches on --workload (hazelcast.clj:278-343's
+# :workload flag; cockroach runner.clj:59-93's test-by-name routing).
+WORKLOAD_SUITES = {"hazelcast": ("lock", "ids", "queue"),
+                   "cockroach": ("bank", "multibank", "register", "sets",
+                                 "sequential", "comments", "g2",
+                                 "monotonic")}
+
+# Mirrors suites.local_common.SKEWS (kept literal here so parser build
+# stays import-light; test_cli_suites pins the two in sync).
+SKEW_NAMES = ("small", "subcritical", "critical", "big", "huge")
 
 
 def suite_registry() -> Dict[str, Callable]:
@@ -201,6 +213,8 @@ def suite_registry() -> Dict[str, Callable]:
     return {
         "etcd": lambda kw: etcd.etcd_test(**kw),
         "etcd-casd": lambda kw: etcd.casd_test(**kw),
+        "hazelcast": lambda kw: hazelcast.hazelcast_test(
+            kw.pop("workload", None) or "lock", **kw),
         "hazelcast-lock": lambda kw: hazelcast.hazelcast_test("lock", **kw),
         "hazelcast-ids": lambda kw: hazelcast.hazelcast_test("ids", **kw),
         "hazelcast-queue": lambda kw: hazelcast.hazelcast_test("queue",
@@ -209,6 +223,8 @@ def suite_registry() -> Dict[str, Callable]:
         "aerospike": lambda kw: aerospike.aerospike_test(**kw),
         "elasticsearch": lambda kw: elasticsearch.elasticsearch_test(**kw),
         "consul": lambda kw: consul.consul_test(**kw),
+        "cockroach": lambda kw: cockroachdb.cockroach_test(
+            kw.pop("workload", None) or "bank", **kw),
         "bank": lambda kw: cockroachdb.bank_test(**kw),
         "monotonic": lambda kw: cockroachdb.monotonic_test(**kw),
     }
@@ -224,9 +240,22 @@ def suite_cmd() -> dict:
         p.add_argument("--suite", required=True,
                        choices=sorted(SUITE_NAMES),
                        help="Which suite to run")
+        p.add_argument("--workload", default=None,
+                       help="Sub-workload for dispatching suites "
+                            "(hazelcast: lock|ids|queue; cockroach: "
+                            "bank|multibank|register|sets|sequential|"
+                            "comments|g2|monotonic)")
         p.add_argument("--nemesis", dest="nemesis_mode", default=None,
-                       choices=["pause", "restart"],
+                       choices=["pause", "restart", "clock", "strobe"],
                        help="Fault schedule (local suites)")
+        p.add_argument("--clock-skew", dest="clock_skew", default=None,
+                       choices=list(SKEW_NAMES),
+                       help="Named skew magnitude for --nemesis clock")
+        p.add_argument("--ts-wall", dest="ts_wall", action="store_true",
+                       default=False,
+                       help="monotonic: wall-clock oracle (skewable)")
+        p.add_argument("--serialized", action="store_true", default=False,
+                       help="g2: close the race with a per-key lock")
         p.add_argument("--no-persist", dest="persist",
                        action="store_false", default=True,
                        help="In-memory daemon state (restarts wipe)")
@@ -257,6 +286,45 @@ def suite_cmd() -> dict:
         d = vars(opts)
         name = d["suite"]
         kw = {k: d[k] for k in SUITE_OPT_KEYS if d.get(k) is not None}
+        # store_true flags ride only when set; a workload flag is only
+        # meaningful for the dispatching suites.
+        for flag in ("ts_wall", "serialized"):
+            if not kw.get(flag):
+                kw.pop(flag, None)
+        workload = kw.get("workload")
+        if workload is not None:
+            allowed = WORKLOAD_SUITES.get(name)
+            if allowed is None:
+                print(f"--workload only applies to suites "
+                      f"{sorted(WORKLOAD_SUITES)}, not {name!r}")
+                return 254
+            if workload not in allowed:
+                print(f"--suite {name} workloads: {', '.join(allowed)}")
+                return 254
+        # Reject flag combinations that would otherwise be silent
+        # no-ops — a fault-free run must never masquerade as a survived
+        # fault schedule.
+        if name == "etcd" and kw.get("nemesis_mode"):
+            print("--nemesis doesn't apply to the real-cluster etcd "
+                  "suite (it runs its own partitioner)")
+            return 254
+        if name == "etcd-casd" and kw.get("nemesis_mode") in ("clock",
+                                                              "strobe"):
+            print("--nemesis clock/strobe needs a clock-sensitive "
+                  "workload; etcd-casd supports pause|restart")
+            return 254
+        if kw.get("ts_wall") and not (
+                name == "monotonic" or
+                (name == "cockroach" and workload == "monotonic")):
+            print("--ts-wall only applies to the monotonic workload")
+            return 254
+        if kw.get("serialized") and not (name == "cockroach"
+                                         and workload == "g2"):
+            print("--serialized only applies to the g2 workload")
+            return 254
+        if kw.get("clock_skew") and kw.get("nemesis_mode") != "clock":
+            print("--clock-skew requires --nemesis clock")
+            return 254
         if d.get("concurrency") is not None:
             kw["concurrency"] = parse_concurrency(
                 d["concurrency"], d.get("n_nodes") or 1)

@@ -632,15 +632,18 @@ void handle(int fd) {
 
 int main(int argc, char** argv) {
   int port = 2379;
-  for (int i = 1; i < argc - 1; ++i) {
+  for (int i = 1; i < argc; ++i) {
+    if (!strcmp(argv[i], "--ts-wall")) {  // valueless, may be last
+      g_ts_wall = true;
+      continue;
+    }
+    if (i + 1 >= argc) continue;
     if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
     if (!strcmp(argv[i], "--persist")) g_persist_path = argv[i + 1];
     if (!strcmp(argv[i], "--delay-ms")) g_delay_ms = atoi(argv[i + 1]);
     if (!strcmp(argv[i], "--bank-split-ms"))
       g_bank_split_ms = atoi(argv[i + 1]);
   }
-  for (int i = 1; i < argc; ++i)
-    if (!strcmp(argv[i], "--ts-wall")) g_ts_wall = true;
   replay();
   signal(SIGPIPE, SIG_IGN);
 

@@ -11,7 +11,8 @@ from jepsen_tpu.cli import main
 def _cleanup():
     subprocess.run(["bash", "-c", "pkill -9 -f '[c]asd --port' || true"],
                    capture_output=True)
-    for d in ("aerospike-counter", "hazelcast-ids"):
+    for d in ("aerospike-counter", "hazelcast-ids", "hazelcast-queue",
+              "cockroach-sets", "cockroach-monotonic"):
         shutil.rmtree(f"/tmp/jepsen/{d}", ignore_errors=True)
 
 
@@ -60,3 +61,67 @@ def test_cli_bad_usage_exit_254():
 def test_registry_names_match_builders():
     from jepsen_tpu.cli import SUITE_NAMES, suite_registry
     assert set(SUITE_NAMES) == set(suite_registry())
+
+
+def test_workload_and_skew_registries_in_sync():
+    """The CLI's static choice lists mirror the suite modules (kept
+    literal in cli.py so parser build stays import-light)."""
+    from jepsen_tpu.cli import SKEW_NAMES, WORKLOAD_SUITES
+    from jepsen_tpu.suites.cockroachdb import WORKLOADS as CRDB
+    from jepsen_tpu.suites.hazelcast import WORKLOADS as HZ
+    from jepsen_tpu.suites.local_common import SKEWS
+    assert set(WORKLOAD_SUITES["hazelcast"]) == set(HZ)
+    assert set(WORKLOAD_SUITES["cockroach"]) == set(CRDB)
+    assert set(SKEW_NAMES) == set(SKEWS)
+
+
+def test_cli_workload_dispatch_roundtrip(tmp_path):
+    """--suite hazelcast --workload queue and --suite cockroach
+    --workload sets round-trip through argv to real runs (the
+    hazelcast.clj:340-343 / runner.clj:59-93 flag surface)."""
+    rc = _main_rc(["test", "--suite", "hazelcast", "--workload", "queue",
+                   "--n-ops", "50", "--base-port", "25230",
+                   "--time-limit", "10"])
+    assert rc == 0
+    assert (tmp_path / "store" / "hazelcast-queue" / "latest").exists()
+    rc = _main_rc(["test", "--suite", "cockroach", "--workload", "sets",
+                   "--n-ops", "60", "--base-port", "25240",
+                   "--time-limit", "10"])
+    assert rc == 0
+    assert (tmp_path / "store" / "cockroach-sets" / "latest").exists()
+
+
+def test_cli_clock_nemesis_flags_detect_violation(tmp_path):
+    """The full clock surface over argv: wall oracle + named skew +
+    clock nemesis must exit 1 on the seeded regression."""
+    rc = _main_rc(["test", "--suite", "cockroach", "--workload",
+                   "monotonic", "--ts-wall", "--nemesis", "clock",
+                   "--clock-skew", "huge", "--n-ops", "900",
+                   "--nemesis-cadence", "0.4", "--base-port", "25250",
+                   "--time-limit", "8"])
+    assert rc == 1
+
+
+def test_cli_workload_on_plain_suite_is_usage_error(tmp_path):
+    assert _main_rc(["test", "--suite", "rabbitmq", "--workload", "queue",
+                     "--base-port", "25260"]) == 254
+    assert _main_rc(["test", "--suite", "cockroach", "--workload",
+                     "zonefetch", "--base-port", "25260"]) == 254
+
+
+def test_cli_silent_noop_flag_combos_are_usage_errors(tmp_path):
+    """Flags that would inject no fault (or configure nothing) must be
+    a 254, never a spuriously-green run."""
+    assert _main_rc(["test", "--suite", "etcd-casd", "--nemesis",
+                     "clock", "--base-port", "25270"]) == 254
+    assert _main_rc(["test", "--suite", "etcd", "--nemesis",
+                     "pause"]) == 254
+    assert _main_rc(["test", "--suite", "cockroach", "--workload",
+                     "register", "--ts-wall",
+                     "--base-port", "25270"]) == 254
+    assert _main_rc(["test", "--suite", "cockroach", "--workload",
+                     "sets", "--serialized",
+                     "--base-port", "25270"]) == 254
+    assert _main_rc(["test", "--suite", "cockroach", "--workload",
+                     "monotonic", "--clock-skew", "huge",
+                     "--base-port", "25270"]) == 254
