@@ -308,14 +308,16 @@ def suite_cmd() -> dict:
             print("--nemesis doesn't apply to the real-cluster etcd "
                   "suite (it runs its own partitioner)")
             return 254
-        if name == "etcd-casd" and kw.get("nemesis_mode") in ("clock",
-                                                              "strobe"):
-            print("--nemesis clock/strobe needs a clock-sensitive "
-                  "workload; etcd-casd supports pause|restart")
+        is_monotonic = (name == "monotonic" or
+                        (name == "cockroach" and workload == "monotonic"))
+        if kw.get("nemesis_mode") in ("clock", "strobe") and not (
+                is_monotonic and kw.get("ts_wall")):
+            print("--nemesis clock/strobe requires the monotonic "
+                  "workload with --ts-wall: the wall-clock oracle is "
+                  "the only clock-sensitive seam, so any other combo "
+                  "injects a fault nothing observes")
             return 254
-        if kw.get("ts_wall") and not (
-                name == "monotonic" or
-                (name == "cockroach" and workload == "monotonic")):
+        if kw.get("ts_wall") and not is_monotonic:
             print("--ts-wall only applies to the monotonic workload")
             return 254
         if kw.get("serialized") and not (name == "cockroach"

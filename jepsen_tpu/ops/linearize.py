@@ -231,11 +231,19 @@ def batch_kernel(V: int, W: int, shared_target: bool = False):
 # HBM even for info-heavy windows (W=16 → 0.5 MB/history).
 MAX_FRONTIER_ELEMENTS = 1 << 26
 
-# Pending-window width the single-device kernel accepts; wider windows
-# split their mask axis over the mesh's "frontier" devices (the
-# sequence-parallel path, jepsen_tpu.parallel.frontier) — the TPU answer
-# to the reference handing Knossos a 32 GB JVM heap (project.clj:22).
+# Pending-window width the single-device kernel accepts at its
+# VMEM-resident sweet spot; wider windows split their mask axis over the
+# mesh's "frontier" devices (the sequence-parallel path,
+# jepsen_tpu.parallel.frontier) — the TPU answer to the reference
+# handing Knossos a 32 GB JVM heap (project.clj:22).
 DATA_MAX_SLOTS = 16
+
+# Without enough frontier devices, a single device still hosts this many
+# extra window bits by letting the mask axis spill to HBM (2^18 masks =
+# 2 MB/history/word) and shrinking the batch chunk to compensate — time
+# and bandwidth traded for not falling back to the host engine. W=17-18
+# buckets on the one-chip bench env ride this instead of the CPU.
+SINGLE_DEVICE_EXTRA_SLOTS = 2
 
 # Don't pay an SPMD compile to spread a handful of rows: batches below
 # this many rows per device stay on one device.
@@ -254,15 +262,17 @@ _SHARDED_KERNELS: Dict[Tuple, object] = {}
 
 def device_frontier_capacity() -> int:
     """Extra pending-window bits the attached devices can host beyond
-    DATA_MAX_SLOTS: log2 of the largest power-of-two device count. The
-    encoder may window up to DATA_MAX_SLOTS + capacity slots before a
-    history must fall back to the host engine."""
+    DATA_MAX_SLOTS: log2 of the largest power-of-two device count (the
+    frontier-sharded path), and never less than the single-device
+    HBM-spill margin (the data1wide path). The encoder may window up to
+    DATA_MAX_SLOTS + capacity slots before a history must fall back to
+    the host engine."""
     import jax
     try:
         nd = len(jax.devices())
     except Exception:
-        return 0
-    return max(nd.bit_length() - 1, 0)
+        return 0   # no backend at all: no data1wide path either
+    return max(nd.bit_length() - 1, SINGLE_DEVICE_EXTRA_SLOTS)
 
 
 def production_mesh(n_frontier: int = 1):
@@ -350,11 +360,19 @@ def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
     if batch.W > DATA_MAX_SLOTS:
         D = 1 << (batch.W - DATA_MAX_SLOTS)
         mesh = production_mesh(D)
-        if mesh is None:
+        if mesh is not None:
+            pending = _dispatch_sharded("frontier", batch, mesh,
+                                        return_frontier)
+        elif batch.W - DATA_MAX_SLOTS <= SINGLE_DEVICE_EXTRA_SLOTS:
+            # Not enough devices to shard the mask axis: run the wide
+            # window on one device, HBM-resident, with the batch chunk
+            # shrunk in proportion (time for memory — the single-chip
+            # degradation path).
+            pending = _data1_dispatch(batch, return_frontier,
+                                      label="data1wide")
+        else:
             raise WindowOverflow(
                 f"window W={batch.W} needs {D} frontier devices")
-        pending = _dispatch_sharded("frontier", batch, mesh,
-                                    return_frontier)
     else:
         mesh = production_mesh(1)
         if mesh is not None and \
@@ -362,21 +380,7 @@ def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
             pending = _dispatch_sharded("dataN", batch, mesh,
                                         return_frontier)
         else:
-            kern = batch_kernel(batch.V, batch.W, batch.shared_target)
-            per_hist = n_state_words(batch.V) << batch.W
-            chunk = max(1, MAX_FRONTIER_ELEMENTS // per_hist)
-            DISPATCH_LOG.append(("data1", batch.V, batch.W, batch.batch))
-            pending = []
-            for lo in range(0, batch.batch, chunk):
-                hi = min(lo + chunk, batch.batch)
-                valid, bad, front = kern(
-                    batch.ev_type[lo:hi], batch.ev_slot[lo:hi],
-                    batch.ev_slots[lo:hi],
-                    batch.target[0] if batch.shared_target
-                    else batch.target[lo:hi])
-                pending.append((valid, bad,
-                                front if return_frontier else None,
-                                hi - lo))
+            pending = _data1_dispatch(batch, return_frontier)
 
     valids, bads, fronts = [], [], []
     for valid, bad, front, nb in pending:
@@ -386,6 +390,29 @@ def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
             fronts.append(np.asarray(front)[:nb])
     return (np.concatenate(valids), np.concatenate(bads),
             np.concatenate(fronts) if return_frontier else None)
+
+
+def _data1_dispatch(batch: EncodedBatch, return_frontier: bool,
+                    label: str = "data1"):
+    """Single-device vmapped dispatch, batch-chunked so the in-flight
+    frontier words stay inside MAX_FRONTIER_ELEMENTS (wide windows get
+    proportionally smaller chunks)."""
+    kern = batch_kernel(batch.V, batch.W, batch.shared_target)
+    per_hist = n_state_words(batch.V) << batch.W
+    chunk = max(1, MAX_FRONTIER_ELEMENTS // per_hist)
+    DISPATCH_LOG.append((label, batch.V, batch.W, batch.batch))
+    pending = []
+    for lo in range(0, batch.batch, chunk):
+        hi = min(lo + chunk, batch.batch)
+        valid, bad, front = kern(
+            batch.ev_type[lo:hi], batch.ev_slot[lo:hi],
+            batch.ev_slots[lo:hi],
+            batch.target[0] if batch.shared_target
+            else batch.target[lo:hi])
+        pending.append((valid, bad,
+                        front if return_frontier else None,
+                        hi - lo))
+    return pending
 
 
 class WindowOverflow(Exception):

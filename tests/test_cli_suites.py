@@ -125,3 +125,8 @@ def test_cli_silent_noop_flag_combos_are_usage_errors(tmp_path):
     assert _main_rc(["test", "--suite", "cockroach", "--workload",
                      "monotonic", "--clock-skew", "huge",
                      "--base-port", "25270"]) == 254
+    # clock faults without the wall oracle are observed by nothing
+    assert _main_rc(["test", "--suite", "monotonic", "--nemesis",
+                     "clock", "--base-port", "25270"]) == 254
+    assert _main_rc(["test", "--suite", "hazelcast", "--nemesis",
+                     "strobe", "--base-port", "25270"]) == 254

@@ -100,6 +100,66 @@ def test_production_route_frontier_w17():
     assert rs[1]["op"]["f"] == "read"
 
 
+def test_single_chip_wide_window_w17_w18(monkeypatch):
+    """With NO multi-device mesh (the one-chip bench env), W=17-18
+    buckets run the wide single-device kernel (mask axis HBM-resident,
+    batch chunk shrunk) instead of host-fallback — with host parity and
+    full counterexample decoding."""
+    from jepsen_tpu.ops import linearize as lin
+    from jepsen_tpu.workloads.synth import synth_wide_window_history
+    monkeypatch.setattr(lin, "production_mesh", lambda n_frontier=1: None)
+    model = cas_register()
+    for width in (17, 18):
+        hs = [synth_wide_window_history(width=width),
+              synth_wide_window_history(width=width, invalid=True)]
+        lin.DISPATCH_LOG.clear()
+        rs = lin.check_batch_tpu(model, hs)
+        log = list(lin.DISPATCH_LOG)
+        assert any(p == "data1wide" and w == width
+                   for p, _, w, _ in log), (width, log)
+        assert rs[0]["valid"] is True
+        assert rs[1]["valid"] is False
+        assert "fallback" not in rs[0] and "fallback" not in rs[1]
+        assert rs[1]["op"]["f"] == "read"
+        host = [wgl_check(model, h)["valid"] for h in hs]
+        assert [r["valid"] for r in rs] == host
+
+
+def test_single_chip_wide_window_columnar(monkeypatch):
+    """Same degradation through the columnar entry: verdict-only W=17
+    on one device, no host fallbacks."""
+    from jepsen_tpu.history.columnar import ops_to_columnar
+    from jepsen_tpu.ops import linearize as lin
+    from jepsen_tpu.workloads.synth import synth_wide_window_history
+    monkeypatch.setattr(lin, "production_mesh", lambda n_frontier=1: None)
+    model = cas_register()
+    hs = [synth_wide_window_history(width=17),
+          synth_wide_window_history(width=17, invalid=True)]
+    cols = ops_to_columnar(model, hs)
+    lin.DISPATCH_LOG.clear()
+    valid, bad = lin.check_columnar(model, cols)
+    assert any(p == "data1wide" and w == 17
+               for p, _, w, _ in lin.DISPATCH_LOG), lin.DISPATCH_LOG
+    assert valid.tolist() == [True, False]
+    assert int(bad[1]) == hs[1][-1].index
+
+
+def test_window_beyond_single_chip_margin_falls_back(monkeypatch):
+    """W=19 exceeds DATA_MAX_SLOTS + SINGLE_DEVICE_EXTRA_SLOTS on one
+    device: the row must still be decided (host engine), flagged as a
+    fallback."""
+    from jepsen_tpu.ops import linearize as lin
+    from jepsen_tpu.workloads.synth import synth_wide_window_history
+    monkeypatch.setattr(lin, "production_mesh", lambda n_frontier=1: None)
+    monkeypatch.setattr(lin, "device_frontier_capacity",
+                        lambda: lin.SINGLE_DEVICE_EXTRA_SLOTS)
+    model = cas_register()
+    hs = [synth_wide_window_history(width=19, invalid=True)]
+    rs = lin.check_batch_tpu(model, hs)
+    assert rs[0]["valid"] is False
+    assert "fallback" in rs[0]
+
+
 def test_production_route_frontier_columnar_w18():
     """Same through the columnar entry at W=18 (4 frontier devices)."""
     from jepsen_tpu.history.columnar import ops_to_columnar
