@@ -190,7 +190,9 @@ SUITE_OPT_KEYS = ("time_limit", "nemesis_mode", "persist", "n_ops",
 # builders resolve lazily at run time.
 SUITE_NAMES = ("etcd", "etcd-casd", "hazelcast", "hazelcast-lock",
                "hazelcast-ids", "hazelcast-queue", "rabbitmq", "aerospike",
-               "elasticsearch", "consul", "cockroach", "bank", "monotonic")
+               "elasticsearch", "consul", "cockroach", "bank", "monotonic",
+               "zookeeper", "logcabin", "rethinkdb", "mongodb", "crate",
+               "disque", "robustirc")
 
 # Suites whose builder dispatches on --workload (hazelcast.clj:278-343's
 # :workload flag; cockroach runner.clj:59-93's test-by-name routing).
@@ -208,8 +210,10 @@ def suite_registry() -> Dict[str, Callable]:
     """Named local-mode test builders (the reference reaches suites via
     per-project lein runners; one registry serves the same role here).
     The real-cluster etcd suite additionally consumes --nodes/--ssh."""
-    from .suites import (aerospike, cockroachdb, consul, elasticsearch,
-                         etcd, hazelcast, rabbitmq)
+    from .suites import (aerospike, cockroachdb, consul, crate, disque,
+                         elasticsearch, etcd, hazelcast, logcabin,
+                         mongodb, rabbitmq, rethinkdb, robustirc,
+                         zookeeper)
     return {
         "etcd": lambda kw: etcd.etcd_test(**kw),
         "etcd-casd": lambda kw: etcd.casd_test(**kw),
@@ -227,6 +231,13 @@ def suite_registry() -> Dict[str, Callable]:
             kw.pop("workload", None) or "bank", **kw),
         "bank": lambda kw: cockroachdb.bank_test(**kw),
         "monotonic": lambda kw: cockroachdb.monotonic_test(**kw),
+        "zookeeper": lambda kw: zookeeper.zookeeper_test(**kw),
+        "logcabin": lambda kw: logcabin.logcabin_test(**kw),
+        "rethinkdb": lambda kw: rethinkdb.rethinkdb_test(**kw),
+        "mongodb": lambda kw: mongodb.mongodb_test(**kw),
+        "crate": lambda kw: crate.crate_test(**kw),
+        "disque": lambda kw: disque.disque_test(**kw),
+        "robustirc": lambda kw: robustirc.robustirc_test(**kw),
     }
 
 

@@ -185,6 +185,18 @@ def stop_daemon(pidfile: str, cmd: Optional[str] = None) -> None:
         meh(exec_, "rm", "-rf", pidfile)
 
 
+def await_cmd(probe: str, desc: str, tries: int = 60,
+              sleep: float = 1.0) -> None:
+    """Poll a node-side probe command until it exits 0, failing loudly
+    after ``tries`` attempts — the shared readiness-wait loop behind
+    every "service is up" check (the reference's per-suite wait loops,
+    e.g. elasticsearch core.clj:247-261, mongodb core.clj:228-232)."""
+    exec_star(
+        f"for i in $(seq {tries}); do "
+        f"{probe} && exit 0; sleep {sleep}; done; "
+        f"echo {desc} never became ready; exit 1")
+
+
 def daemon_running(pidfile: str) -> bool:
     """Is the pidfile's process alive?"""
     if not exists(pidfile):

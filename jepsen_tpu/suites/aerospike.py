@@ -9,15 +9,100 @@ at completion].
 
 Local mode drives casd's /counter endpoints; a state-wiping restart
 zeroes the counter, so later reads fall below the lower bound — the
-seeded violation. Real-Aerospike automation (core.clj:80-130, including
-the faketime-skewed install) slots behind the DB protocol as in the
-etcd suite.
+seeded violation. ``AerospikeDB`` is the real-cluster automation
+(aerospike/src/aerospike/core.clj:95-180: versioned .deb install with
+the faketime-skew wrapper, mesh-seed config, service start + recovery
+policy), behind the DB protocol and command-stream tested like EtcdDB.
 """
 from __future__ import annotations
 
 from .. import gen as g
+from ..control import core as c
+from ..control import net_helpers
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
 from ..ops.folds import counter_checker_tpu
+from ..os_impl import debian
+from ..runtime import primary
 from .local_common import ServiceClient, service_test
+
+AS_CONF = "/etc/aerospike/aerospike.conf"
+AS_LOG = "/var/log/aerospike/aerospike.log"
+
+# The reference's faketime wrapper body (core.clj:116-119): every asd
+# start gets a random offset and rate skew, provoking clock-dependent
+# bugs on every restart.
+ASD_WRAPPER = ('#!/bin/bash\nfaketime -m -f "+$((RANDOM%100))s '
+               'x1.${RANDOM}" /usr/local/bin/asd')
+
+
+def aerospike_conf(node, test: dict) -> str:
+    """The reference's resources/aerospike.conf with $NODE_ADDRESS and
+    $MESH_ADDRESS substituted (core.clj:121-132): this node's IP, and
+    the primary as the mesh seed."""
+    return "\n".join([
+        "service {",
+        "  paxos-single-replica-limit 1",
+        "  pidfile /var/run/aerospike/asd.pid",
+        "}",
+        f"logging {{ file {AS_LOG} {{ context any info }} }}",
+        "network {",
+        f"  service {{ address {net_helpers.ip(str(node))} port 3000 }}",
+        "  heartbeat {",
+        "    mode mesh",
+        f"    mesh-seed-address-port "
+        f"{net_helpers.ip(str(primary(test)))} 3002",
+        "    port 3002",
+        "  }",
+        "}",
+        "namespace jepsen { replication-factor 3 }",
+    ])
+
+
+class AerospikeDB(DB):
+    """Versioned .deb Aerospike cluster (core.clj:95-180)."""
+
+    def __init__(self, version: str = "3.5.4"):
+        self.version = version
+
+    def setup(self, test, node):
+        with c.su():
+            if debian.installed_version("aerospike-server-community") \
+                    != f"{self.version}-1":
+                debian.install(["python"])
+                cu.meh(debian.uninstall,
+                       ["aerospike-server-community", "aerospike-tools"])
+                with c.cd("/tmp"):
+                    c.exec_("wget", "-O", "aerospike.tgz",
+                            "http://www.aerospike.com/download/server/"
+                            f"{self.version}/artifact/debian7")
+                    c.exec_("tar", "xvfz", "aerospike.tgz")
+                with c.cd(f"/tmp/aerospike-server-community-"
+                          f"{self.version}-debian7"):
+                    c.exec_("dpkg", "-i",
+                            lit("aerospike-server-community-*.deb"))
+                    c.exec_("dpkg", "-i", lit("aerospike-tools-*.deb"))
+                # faketime-skew the server binary (core.clj:115-119).
+                c.exec_("mv", "/usr/bin/asd", "/usr/local/bin/asd")
+                c.exec_("echo", ASD_WRAPPER, lit(">"), "/usr/bin/asd")
+                c.exec_("chmod", "0755", "/usr/bin/asd")
+            c.exec_("echo", aerospike_conf(node, test), lit(">"), AS_CONF)
+            c.exec_("service", "aerospike", "start")
+            c.exec_("asinfo", "-v",
+                    "config-set:context=service;"
+                    "paxos-recovery-policy=auto-dun-master")
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(c.exec_, "service", "aerospike", "stop")
+            cu.meh(c.exec_, "killall", "-9", "asd")
+            cu.meh(c.exec_, "truncate", "--size", "0", AS_LOG)
+            for d in ("data", "smd", "udf"):
+                c.exec_("rm", "-rf", lit(f"/opt/aerospike/{d}/*"))
+
+    def log_files(self, test, node):
+        return [AS_LOG]
 
 
 class CounterClient(ServiceClient):

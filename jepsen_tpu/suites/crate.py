@@ -1,0 +1,87 @@
+"""Crate suite: register + set semantics on the Crate SQL cluster.
+
+Mirrors the reference suite (crate/src/jepsen/crate.clj): apt-repo
+install with the crate signing key + pinned version and boot-disable
+(167-180), crate.yml templating — node name, expected node count,
+majority for minimum_master_nodes, unicast host list (187-202) —
+``service crate start`` (205-209), and grepkill + log/data wipe
+teardown (db at 211-229). Its workloads are version-read registers
+(crate.clj:232-320) and the lost-updates/dirty-read set family
+(lost_updates.clj, dirty_read.clj) — the register family here runs
+against casd in local mode.
+"""
+from __future__ import annotations
+
+import json
+
+from ..control import core as c
+from ..control import net_helpers
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
+from ..os_impl import debian
+from ..utils.core import majority
+from .etcd import EtcdClient, workload as register_workload
+from .local_common import service_test
+
+KEY_URL = "https://cdn.crate.io/downloads/apt/DEB-GPG-KEY-crate"
+REPO_LINE = "deb https://cdn.crate.io/downloads/apt/stable/ jessie main"
+CONF = "/etc/crate/crate.yml"
+LOG_FILE = "/var/log/crate/crate.log"
+
+
+def crate_yml(node, test: dict) -> str:
+    """The reference's resources/crate.yml with $NAME/$N/$MAJORITY/
+    $HOSTS substituted (crate.clj:187-202)."""
+    nodes = test.get("nodes") or []
+    hosts = json.dumps([net_helpers.ip(str(n)) for n in nodes])
+    return "\n".join([
+        "cluster.name: jepsen",
+        f"node.name: {node}",
+        f"gateway.expected_nodes: {len(nodes)}",
+        f"gateway.recover_after_nodes: {majority(len(nodes))}",
+        f"discovery.zen.minimum_master_nodes: {majority(len(nodes))}",
+        "discovery.zen.ping.multicast.enabled: false",
+        f"discovery.zen.ping.unicast.hosts: {hosts}",
+        "network.host: 0.0.0.0",
+    ])
+
+
+class CrateDB(DB):
+    """Apt-repo Crate cluster (crate.clj:167-229)."""
+
+    def __init__(self, version: str = "0.55.2-1~jessie"):
+        self.version = version
+
+    def setup(self, test, node):
+        with c.su():
+            debian.install(["apt-transport-https"])
+            debian.install_jdk()
+            with c.cd("/tmp"):
+                c.exec_("wget", KEY_URL)
+                c.exec_("apt-key", "add", "DEB-GPG-KEY-crate")
+                c.exec_("rm", "DEB-GPG-KEY-crate")
+            debian.add_repo("crate", REPO_LINE)
+            debian.install([f"crate={self.version}"])
+            c.exec_("update-rc.d", "crate", "disable")
+            c.exec_("echo", crate_yml(node, test), lit(">"), CONF)
+            c.exec_("service", "crate", "start")
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.grepkill("crate")
+            c.exec_("rm", "-rf", lit("/var/log/crate/*"))
+            c.exec_("rm", "-rf", lit("/var/lib/crate/*"))
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+def crate_test(**opts) -> dict:
+    """The version-read register workload (crate.clj:232-320) in local
+    mode against casd."""
+    opts.setdefault("threads_per_key", 2)
+    return service_test(
+        "crate",
+        EtcdClient(opts.get("client_timeout", 0.5)),
+        register_workload(opts), **opts)

@@ -1,0 +1,88 @@
+"""Disque suite: total-queue accounting on the Disque job queue.
+
+Mirrors the reference suite (disque/src/jepsen/disque.clj): build from
+source on the node (git clone + make at a pinned rev, 40-53), deploy
+the config file (55-62), start under start-stop-daemon with a pidfile
+(72-92), join every node to the primary via ``disque cluster meet
+<primary-ip> 7711`` (94-104), and stop/wipe with killall + data rm
+(106-119). The workload (disque.clj:121-213) is the queue/total-queue
+family with a drain phase — shared with the rabbitmq module here — run
+against casd's queue endpoints in local mode.
+"""
+from __future__ import annotations
+
+from ..control import core as c
+from ..control import net_helpers
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
+from ..os_impl import debian
+from ..runtime import primary, synchronize
+from .local_common import service_test
+from .rabbitmq import QueueClient, queue_workload
+
+GIT_URL = "https://github.com/antirez/disque.git"
+DIR = "/opt/disque"
+DATA_DIR = "/var/lib/disque"
+PIDFILE = "/var/run/disque.pid"
+BINARY = f"{DIR}/src/disque-server"
+CONTROL = f"{DIR}/src/disque"
+CONFIG_FILE = f"{DIR}/disque.conf"
+LOG_FILE = f"{DATA_DIR}/log"
+PORT = 7711
+
+# The reference's resources/disque.conf with %DATA_DIR% substituted
+# (disque.clj:55-62).
+CONFIG = "\n".join([
+    f"port {PORT}",
+    f"dir {DATA_DIR}",
+    "appendonly yes",
+])
+
+
+class DisqueDB(DB):
+    """Source-built Disque cluster (disque.clj:40-119)."""
+
+    def __init__(self, version: str = "8a9290c"):
+        self.version = version
+
+    def setup(self, test, node):
+        with c.su():
+            debian.install(["git-core", "build-essential"])
+            with c.cd("/opt"):
+                if not cu.exists("disque"):
+                    c.exec_("git", "clone", GIT_URL)
+            with c.cd(DIR):
+                c.exec_("git", "pull")
+                c.exec_("git", "reset", "--hard", self.version)
+                c.exec_("make")
+            c.exec_("echo", CONFIG, lit(">"), CONFIG_FILE)
+            c.exec_("mkdir", "-p", DATA_DIR)
+            cu.start_daemon(
+                {"logfile": LOG_FILE, "pidfile": PIDFILE, "chdir": DIR},
+                BINARY, CONFIG_FILE)
+        # Everyone meets the primary (disque.clj:94-104).
+        synchronize(test)
+        p = primary(test)
+        if node != p:
+            out = c.exec_(CONTROL, "-p", str(PORT), "cluster", "meet",
+                          net_helpers.ip(str(p)), str(PORT))
+            assert out.strip() == "OK", out
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(c.exec_, "killall", "-9", "disque-server")
+            cu.meh(c.exec_, "rm", "-rf", PIDFILE)
+            cu.meh(c.exec_, "rm", "-rf", lit(f"{DATA_DIR}/*"), LOG_FILE)
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+def disque_test(**opts) -> dict:
+    """The queue+drain workload (disque.clj:121-213) in local mode
+    against casd's queue endpoints."""
+    return service_test(
+        "disque",
+        QueueClient(opts.get("client_timeout", 0.5)),
+        queue_workload(opts), **opts)

@@ -7,16 +7,89 @@ checked by the set checker's lost/unexpected/recovered accounting
 (checker.clj:131-178).
 
 Local mode drives casd's /set endpoints; a state-wiping restart loses
-acknowledged elements — the seeded ``lost`` violation. Real-server
-automation slots behind the DB protocol as in the etcd suite.
+acknowledged elements — the seeded ``lost`` violation. ``EsDB`` is the
+real-cluster automation (tarball install + elasticsearch.yml templating
++ daemon start with a green-health wait, core.clj:212-296), behind the
+DB protocol and command-stream tested like EtcdDB.
 """
 from __future__ import annotations
 
+import json
 import threading
 
 from .. import gen as g
+from ..control import core as c
+from ..control import net_helpers
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
 from ..ops.folds import set_checker_tpu
+from ..os_impl import debian
+from ..utils.core import majority
 from .local_common import ServiceClient, service_test
+
+ES_USER = "elasticsearch"
+ES_DIR = "/opt/elasticsearch"
+ES_PIDFILE = "/tmp/elasticsearch.pid"
+ES_LOG = f"{ES_DIR}/logs/stdout.log"
+CLUSTER = "jepsen"
+
+
+def elasticsearch_yml(node, test: dict) -> str:
+    """The reference's resources/elasticsearch.yml with $CLUSTER/$NAME/
+    $N/$MAJORITY/$HOSTS substituted (core.clj:221-238)."""
+    nodes = test.get("nodes") or []
+    hosts = json.dumps([net_helpers.ip(str(n)) for n in nodes])
+    return "\n".join([
+        f"cluster.name: {CLUSTER}",
+        f"node.name: {node}",
+        f"gateway.expected_nodes: {len(nodes)}",
+        f"gateway.recover_after_nodes: {majority(len(nodes))}",
+        f"discovery.zen.minimum_master_nodes: {majority(len(nodes))}",
+        f"discovery.zen.ping.unicast.hosts: {hosts}",
+        "network.host: 0.0.0.0",
+    ])
+
+
+class EsDB(DB):
+    """Tarball-installed Elasticsearch cluster (core.clj:212-296):
+    jdk + dedicated user + install_archive, yml templating, daemon
+    start under the es user with a cluster-health wait, teardown =
+    stop + data wipe + log truncation."""
+
+    def __init__(self, tarball_url: str):
+        self.tarball_url = tarball_url
+
+    def setup(self, test, node):
+        with c.su():
+            debian.install_jdk()
+            cu.ensure_user(ES_USER)
+            cu.install_archive(self.tarball_url, ES_DIR)
+            c.exec_("chown", "-R", f"{ES_USER}:{ES_USER}", ES_DIR)
+            c.exec_("echo", elasticsearch_yml(node, test), lit(">"),
+                    f"{ES_DIR}/config/elasticsearch.yml")
+            c.exec_("sysctl", "-w", "vm.max_map_count=262144")
+        with c.cd(ES_DIR), c.sudo(ES_USER):
+            c.exec_("mkdir", "-p", f"{ES_DIR}/logs")
+            cu.start_daemon(
+                {"logfile": ES_LOG, "pidfile": ES_PIDFILE,
+                 "chdir": ES_DIR},
+                "bin/elasticsearch")
+        # wait for green (core.clj:247-261's `wait`).
+        cu.await_cmd(
+            "curl -sf 'http://localhost:9200/_cluster/health"
+            "?wait_for_status=green&timeout=1s' >/dev/null",
+            "elasticsearch-green")
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.stop_daemon(ES_PIDFILE, "java")
+            c.exec_("rm", "-rf", lit(f"{ES_DIR}/data/*"))
+            for log_ in self.log_files(test, node):
+                cu.meh(c.exec_, "truncate", "--size", "0", log_)
+
+    def log_files(self, test, node):
+        return [ES_LOG, f"{ES_DIR}/logs/{CLUSTER}.log"]
 
 
 class SetClient(ServiceClient):

@@ -134,10 +134,9 @@ class CasdDB(DB):
              "chdir": d},
             f"{d}/casd", *args)
         # Wait for the listener before declaring the node up.
-        c.exec_star(
-            f"for i in $(seq 50); do "
-            f"curl -sf http://127.0.0.1:{port}/health >/dev/null && exit 0; "
-            f"sleep 0.1; done; echo casd never came up; exit 1")
+        cu.await_cmd(
+            f"curl -sf http://127.0.0.1:{port}/health >/dev/null",
+            "casd", tries=50, sleep=0.1)
 
     def teardown(self, test, node):
         d = self._dir(test, node)

@@ -8,9 +8,10 @@ Local mode drives the casd daemon's /lock, /ids, /queue endpoints
 (resources/casd.cpp) — real processes under real kill/pause nemeses;
 a state-wiping restart double-grants a held lock, resets the id
 sequence (duplicate ids), and loses queued elements, each caught by
-its family's checker. Real-Hazelcast automation (the reference ships a
-server uberjar, hazelcast.clj:33-95) would slot behind the DB protocol
-exactly as EtcdDB does in the etcd suite.
+its family's checker. ``HazelcastDB`` is the real-cluster automation
+(jdk install + server-uberjar upload + java -jar with the peer member
+list, hazelcast.clj:63-112), behind the DB protocol and command-stream
+tested like EtcdDB.
 """
 from __future__ import annotations
 
@@ -21,9 +22,52 @@ from .. import gen as g
 from ..checkers.core import compose
 from ..checkers.linearizable import linearizable
 from ..checkers.timeline import html_timeline
+from ..control import core as c
+from ..control import net_helpers
+from ..control import util as cu
+from ..db import DB
 from ..models.core import mutex
 from ..ops.folds import total_queue_checker_tpu, unique_ids_checker_tpu
+from ..os_impl import debian
 from .local_common import ServiceClient, service_test
+
+HZ_DIR = "/opt/hazelcast"
+HZ_JAR = f"{HZ_DIR}/server.jar"
+HZ_PIDFILE = f"{HZ_DIR}/server.pid"
+HZ_LOG = f"{HZ_DIR}/server.log"
+
+
+class HazelcastDB(DB):
+    """Uberjar Hazelcast cluster (hazelcast.clj:63-112): the server jar
+    (built locally by the reference's lein sub-project; here a
+    caller-supplied artifact) is uploaded to every node and launched
+    with ``--members`` listing every peer's IP; teardown stops the
+    daemon and removes its log/pid."""
+
+    def __init__(self, server_jar: str):
+        self.server_jar = server_jar
+
+    def setup(self, test, node):
+        with c.su():
+            debian.install_jdk()
+            c.exec_("mkdir", "-p", HZ_DIR)
+            c.upload(self.server_jar, HZ_JAR)
+            members = ",".join(net_helpers.ip(str(n))
+                               for n in test.get("nodes") or []
+                               if n != node)
+            with c.cd(HZ_DIR):
+                cu.start_daemon(
+                    {"logfile": HZ_LOG, "pidfile": HZ_PIDFILE,
+                     "chdir": HZ_DIR},
+                    "/usr/bin/java", "-jar", HZ_JAR, "--members", members)
+
+    def teardown(self, test, node):
+        with c.cd(HZ_DIR), c.su():
+            cu.stop_daemon(HZ_PIDFILE)
+            c.exec_("rm", "-rf", HZ_LOG, HZ_PIDFILE)
+
+    def log_files(self, test, node):
+        return [HZ_LOG]
 
 
 class LockClient(ServiceClient):

@@ -1,0 +1,105 @@
+"""LogCabin suite: CAS register on the LogCabin Raft store.
+
+Mirrors the reference suite (logcabin/src/jepsen/logcabin.clj): install
+by building from source on the node (git clone + scons, 23-46), write
+the per-node config (serverId + listenAddresses, 66-76), bootstrap the
+initial cluster on the primary (78-84), start every daemon (86-93),
+reconfigure the full member set from the primary (103-115), and tear
+down with grepkill + storage wipe (95-101, db at 120-150). The workload
+is the CAS-register family (TreeOps write/read/cas there), run against
+casd in local mode.
+"""
+from __future__ import annotations
+
+from ..control import core as c
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
+from ..os_impl import debian
+from ..runtime import primary, synchronize
+from .etcd import EtcdClient, workload as register_workload
+from .local_common import service_test
+
+GIT_URL = "https://github.com/logcabin/logcabin.git"
+BUILD_DEPS = ["git-core", "protobuf-compiler", "libprotobuf-dev",
+              "libcrypto++-dev", "g++", "scons"]
+CONFIG_FILE = "/root/logcabin.conf"
+LOG_FILE = "/root/logcabin.log"
+PID_FILE = "/root/logcabin.pid"
+STORE_DIR = "/root/storage"
+BINARY = "/root/LogCabin"
+RECONFIGURE = "/root/Reconfigure"
+TREEOPS = "/root/TreeOps"
+PORT = 5254
+
+
+def server_id(node) -> str:
+    """Node name minus the 'n' prefix (logcabin.clj:48-50)."""
+    return str(node).lstrip("n") or "1"
+
+
+def server_addr(node) -> str:
+    return f"{node}:{PORT}"
+
+
+class LogCabinDB(DB):
+    """Source-built LogCabin cluster (logcabin.clj:23-150): clone +
+    scons on each node, per-node config, primary bootstraps the initial
+    single-server cluster, then reconfigures to the full member set once
+    every daemon is up."""
+
+    def setup(self, test, node):
+        with c.su():
+            debian.install(BUILD_DEPS)
+            with c.cd("/"):
+                if not cu.exists("logcabin"):
+                    c.exec_("git", "clone", "--depth", "1", GIT_URL)
+                    with c.cd("/logcabin"):
+                        c.exec_("git", "submodule", "update", "--init")
+            with c.cd("/logcabin"):
+                c.exec_("scons")
+            for built, dest in (("build/LogCabin", BINARY),
+                                ("build/Examples/Reconfigure", RECONFIGURE),
+                                ("build/Examples/TreeOps", TREEOPS)):
+                c.exec_("cp", "-f", f"/logcabin/{built}", dest)
+            c.exec_("echo",
+                    f"serverId = {server_id(node)}\n"
+                    f"listenAddresses = {server_addr(node)}",
+                    lit(">"), CONFIG_FILE)
+            if node == primary(test):
+                # Bootstrap seeds the Raft log with a one-server
+                # configuration (logcabin.clj:78-84); only the primary
+                # does it, exactly once.
+                with c.cd("/root"):
+                    c.exec_(BINARY, "-c", CONFIG_FILE, "-l", LOG_FILE,
+                            "--bootstrap")
+            synchronize(test)
+            with c.cd("/root"):
+                c.exec_(BINARY, "-c", CONFIG_FILE, "-d", "-l", LOG_FILE,
+                        "-p", PID_FILE)
+            synchronize(test)
+            if node == primary(test):
+                # Grow the cluster to the full member set
+                # (logcabin.clj:103-115).
+                addrs = ",".join(server_addr(n) for n in test["nodes"])
+                with c.cd("/root"):
+                    c.exec_(RECONFIGURE, "-c", lit(addrs), "set",
+                            *[lit(server_addr(n)) for n in test["nodes"]])
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.grepkill("LogCabin")
+            c.exec_("rm", "-rf", PID_FILE, STORE_DIR, LOG_FILE)
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+def logcabin_test(**opts) -> dict:
+    """The register workload (logcabin.clj TreeOps client) in local
+    mode against casd."""
+    opts.setdefault("threads_per_key", 2)
+    return service_test(
+        "logcabin",
+        EtcdClient(opts.get("client_timeout", 0.5)),
+        register_workload(opts), **opts)

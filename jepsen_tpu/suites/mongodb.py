@@ -1,0 +1,164 @@
+"""MongoDB suites: document CAS over a replica set.
+
+Mirrors both reference variants:
+
+  * mongodb-smartos (mongodb-smartos/src/jepsen/mongodb_smartos/core.clj)
+    — pkgin install (40-47), mongod.conf deploy (49-53), svcadm
+    start/stop (55-70), data wipe (72-79), and replica-set join: the
+    primary initiates the set and awaits election, others just await
+    the config (262-300). ``MongoSmartOSDB``.
+  * mongodb-rocks (mongodb-rocks/src/jepsen/mongodb_rocks.clj) — .deb
+    download + dpkg install with a pluggable storage engine (29-46).
+    ``MongoRocksDB``.
+
+The reference drives replica-set admin through the Java driver
+(replica-set-initiate!, core.clj:128-146); here the same commands ride
+the node-side ``mongo --quiet --eval`` shell (the reference's own
+mongo! helper, core.clj:87-91), keeping the whole bootstrap on the
+command stream. The workload (document_cas.clj) is the CAS-register
+family, run against casd in local mode.
+"""
+from __future__ import annotations
+
+import json
+
+from ..control import core as c
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
+from ..os_impl import debian, smartos
+from ..runtime import primary, synchronize
+from .etcd import EtcdClient, workload as register_workload
+from .local_common import service_test
+
+REPLICA_SET = "jepsen"
+SMARTOS_CONF = "/opt/local/etc/mongod.conf"
+DEBIAN_CONF = "/etc/mongod.conf"
+LOG_FILE = "/var/log/mongodb/mongod.log"
+DATA_DIR = "/var/lib/mongodb"
+
+
+def mongod_conf(engine: str | None = None) -> str:
+    """The reference's resources/mongod.conf baseline: replica set name,
+    bind everywhere, journaled storage (+ optional engine override for
+    the rocks variant)."""
+    lines = [
+        "systemLog:",
+        "  destination: file",
+        f"  path: {LOG_FILE}",
+        "storage:",
+        f"  dbPath: {DATA_DIR}",
+        "  journal:",
+        "    enabled: true",
+    ]
+    if engine:
+        lines.append(f"  engine: {engine}")
+    lines += [
+        "replication:",
+        f"  replSetName: {REPLICA_SET}",
+        "net:",
+        "  bindIp: 0.0.0.0",
+    ]
+    return "\n".join(lines)
+
+
+def mongo_eval(cmd: str) -> str:
+    """Run a mongo-shell command on the node, JSON out (the reference's
+    mongo! helper, core.clj:87-91)."""
+    return c.exec_("mongo", "--quiet", "--eval", f"printjson({cmd})")
+
+
+def replica_set_config(test: dict) -> dict:
+    """Target replica-set config: one member per node, ids by position
+    (core.clj:240-247)."""
+    return {"_id": REPLICA_SET,
+            "members": [{"_id": i, "host": f"{n}:27017"}
+                        for i, n in enumerate(test.get("nodes") or [])]}
+
+
+def join_replica_set(test: dict, node) -> None:
+    """The primary initiates the set with the full member config and
+    polls until an election yields a primary (core.clj:262-300);
+    non-primaries have nothing to do — they learn the config over the
+    wire."""
+    if node != primary(test):
+        synchronize(test)
+        return
+    cfg = json.dumps(replica_set_config(test))
+    mongo_eval(f"rs.initiate({cfg})")
+    # await-primary (core.clj:228-232): poll ismaster until someone wins.
+    cu.await_cmd(
+        "mongo --quiet --eval 'rs.isMaster().ismaster' | grep -q true",
+        "mongodb-primary-election")
+    synchronize(test)
+
+
+class MongoSmartOSDB(DB):
+    """pkgin-installed mongod under SMF (core.clj:40-79 + 262-300)."""
+
+    def __init__(self, db_version: str = "3.2.0",
+                 tools_version: str = "3.2.0"):
+        self.db_version = db_version
+        self.tools_version = tools_version
+
+    def setup(self, test, node):
+        with c.su():
+            smartos.install({"mongodb": self.db_version,
+                             "mongo-tools": self.tools_version})
+            c.exec_("mkdir", "-p", DATA_DIR)
+            c.exec_("chown", "-R", "mongodb:mongodb", DATA_DIR)
+            c.exec_("echo", mongod_conf(), lit(">"), SMARTOS_CONF)
+            cu.meh(c.exec_, "svcadm", "clear", "mongodb")
+            c.exec_("svcadm", "enable", "-r", "mongodb")
+        join_replica_set(test, node)
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(c.exec_, "svcadm", "disable", "mongodb")
+            cu.meh(c.exec_, "pkill", "-9", "mongod")
+            c.exec_("rm", "-rf", lit("/var/log/mongodb/*"))
+            c.exec_("rm", "-rf", lit(f"{DATA_DIR}/*"))
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class MongoRocksDB(DB):
+    """.deb-installed mongod with a pluggable storage engine
+    (mongodb_rocks.clj:29-58)."""
+
+    def __init__(self, url: str, engine: str = "rocksdb"):
+        self.url = url
+        self.engine = engine
+
+    def setup(self, test, node):
+        with c.su():
+            with c.cd(cu.tmp_dir()):
+                f = cu.wget(self.url)
+                c.exec_("dpkg", "-i", "--force-confask",
+                        "--force-confnew", f)
+            c.exec_("mkdir", "-p", DATA_DIR)
+            c.exec_("echo", mongod_conf(self.engine), lit(">"),
+                    DEBIAN_CONF)
+            c.exec_("service", "mongod", "restart")
+        join_replica_set(test, node)
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(c.exec_, "service", "mongod", "stop")
+            cu.meh(c.exec_, "pkill", "-9", "mongod")
+            c.exec_("rm", "-rf", lit("/var/log/mongodb/*"))
+            c.exec_("rm", "-rf", lit(f"{DATA_DIR}/*"))
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+def mongodb_test(**opts) -> dict:
+    """The document-CAS register workload (document_cas.clj) in local
+    mode against casd."""
+    opts.setdefault("threads_per_key", 2)
+    return service_test(
+        "mongodb",
+        EtcdClient(opts.get("client_timeout", 0.5)),
+        register_workload(opts), **opts)

@@ -8,9 +8,11 @@ by BOTH the ordered-fold queue checker and total-queue multiset
 accounting (checker.clj:109-129, 214-271).
 
 Local mode drives casd's /queue endpoints; a state-wiping restart loses
-enqueued elements, which total-queue reports as ``lost``. Real-RabbitMQ
-automation (AMQP client + server install, rabbitmq.clj:24-66) slots
-behind the DB protocol as in the etcd suite.
+enqueued elements, which total-queue reports as ``lost``. ``RabbitDB``
+is the real-cluster automation (rabbitmq.clj:24-99: .deb install, the
+shared erlang cookie, rabbitmqctl cluster join onto the primary, and
+majority-mirroring policy), behind the DB protocol and command-stream
+tested like EtcdDB.
 """
 from __future__ import annotations
 
@@ -18,8 +20,74 @@ import urllib.error
 
 from .. import gen as g
 from ..checkers.core import compose
+from ..control import core as c
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
 from ..ops.folds import queue_checker_tpu, total_queue_checker_tpu
+from ..os_impl import debian
+from ..runtime import primary, synchronize
 from .local_common import ServiceClient, service_test
+
+COOKIE = "jepsen-rabbitmq"
+# The reference's resources/rabbitmq/rabbitmq.config: keep partitions
+# visible to the test instead of auto-healing them.
+RABBIT_CONFIG = ('[{rabbit, [{cluster_partition_handling, ignore}]}].')
+HA_POLICY = ('{"ha-mode": "exactly", "ha-params": 3, '
+             '"ha-sync-mode": "automatic"}')
+MNESIA_DIR = "/var/lib/rabbitmq/mnesia/"
+RABBIT_LOG = "/var/log/rabbitmq/rabbit.log"
+
+
+class RabbitDB(DB):
+    """.deb RabbitMQ cluster (rabbitmq.clj:24-99): install with
+    erlang-nox, share one erlang cookie across nodes, join every
+    non-primary via ``rabbitmqctl join_cluster rabbit@<primary>``, and
+    enable majority mirroring; teardown nukes the beam VM and the
+    mnesia dir."""
+
+    def __init__(self, version: str = "3.5.6"):
+        self.version = version
+
+    def setup(self, test, node):
+        deb = f"rabbitmq-server_{self.version}-1_all.deb"
+        with c.cd("/tmp"):
+            if not cu.exists(deb):
+                c.exec_("wget",
+                        "http://www.rabbitmq.com/releases/rabbitmq-server/"
+                        f"v{self.version}/{deb}")
+            with c.su():
+                if "rabbitmq-server" not in debian.installed(
+                        ["rabbitmq-server"]):
+                    c.exec_("apt-get", "install", "-y", "erlang-nox")
+                    c.exec_("dpkg", "-i", deb)
+                if c.exec_("cat", "/var/lib/rabbitmq/.erlang.cookie") \
+                        != COOKIE:
+                    c.exec_("service", "rabbitmq-server", "stop")
+                    c.exec_("echo", COOKIE, lit(">"),
+                            "/var/lib/rabbitmq/.erlang.cookie")
+                c.exec_("echo", RABBIT_CONFIG, lit(">"),
+                        "/etc/rabbitmq/rabbitmq.config")
+                c.exec_("service", "rabbitmq-server", "start")
+                if node != primary(test):
+                    c.exec_("rabbitmqctl", "stop_app")
+                synchronize(test)
+                if node != primary(test):
+                    c.exec_("rabbitmqctl", "join_cluster",
+                            f"rabbit@{primary(test)}")
+                    c.exec_("rabbitmqctl", "start_app")
+                synchronize(test)
+                c.exec_("rabbitmqctl", "set_policy", "ha-maj", "jepsen.",
+                        HA_POLICY)
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(c.exec_, "killall", "-9", "beam.smp", "epmd")
+            c.exec_("rm", "-rf", MNESIA_DIR)
+            c.exec_("service", "rabbitmq-server", "stop")
+
+    def log_files(self, test, node):
+        return [RABBIT_LOG]
 
 
 class QueueClient(ServiceClient):

@@ -1,0 +1,86 @@
+"""RethinkDB suite: document CAS register.
+
+Mirrors the reference suite (rethinkdb/src/jepsen/rethinkdb.clj):
+install from the vendor apt repo with a pinned version, optionally
+faketime-wrapping the binary (52-66); write the instance config with
+one ``join=<node>:29015`` line per node plus server-name/tag (68-88);
+``service rethinkdb start`` (89-95); teardown stops the service,
+kills stragglers, and wipes the data dir (db at 122-142). The workload
+(document_cas.clj) is the CAS-register family over a document table,
+run against casd in local mode with per-key independence.
+"""
+from __future__ import annotations
+
+from ..control import core as c
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
+from .. import faketime
+from ..os_impl import debian
+from .etcd import EtcdClient, workload as register_workload
+from .local_common import service_test
+
+REPO_LINE = "deb http://download.rethinkdb.com/apt jessie main"
+KEY_URL = "https://download.rethinkdb.com/apt/pubkey.gpg"
+CONF = "/etc/rethinkdb/instances.d/jepsen.conf"
+LOG_FILE = "/var/log/rethinkdb"
+DATA_DIR = "/var/lib/rethinkdb"
+
+# The reference's resources/jepsen.conf baseline: bind everywhere,
+# fixed ports, our log file.
+BASE_CONF = "\n".join([
+    "bind=all",
+    "driver-port=28015",
+    "cluster-port=29015",
+    f"log-file={LOG_FILE}",
+])
+
+
+def join_lines(test: dict) -> str:
+    """One join line per node (rethinkdb.clj:68-74)."""
+    return "\n".join(f"join={n}:29015" for n in (test.get("nodes") or []))
+
+
+class RethinkDB(DB):
+    """Apt-repo RethinkDB cluster (rethinkdb.clj:52-142). ``rate``
+    applies the suite's faketime clock-rate skew to the server binary
+    (rethinkdb.clj:62: faketime-wrapper!)."""
+
+    def __init__(self, version: str = "2.3.4~0jessie",
+                 rate: float | None = None):
+        self.version = version
+        self.rate = rate
+
+    def setup(self, test, node):
+        with c.su():
+            debian.add_repo("rethinkdb", REPO_LINE)
+            c.exec_star(f"wget -qO - {KEY_URL} | apt-key add -")
+            debian.install([f"rethinkdb={self.version}"])
+            if self.rate is not None:
+                faketime.wrap("/usr/bin/rethinkdb", self.rate)
+            c.exec_("touch", LOG_FILE)
+            c.exec_("chown", "rethinkdb:rethinkdb", LOG_FILE)
+            c.exec_("echo",
+                    BASE_CONF + "\n\n" + join_lines(test) + "\n\n"
+                    + f"server-name={node}\nserver-tag={node}\n",
+                    lit(">"), CONF)
+            c.exec_("service", "rethinkdb", "start")
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(c.exec_, "service", "rethinkdb", "stop")
+            cu.grepkill("rethinkdb")
+            c.exec_("rm", "-rf", lit(f"{DATA_DIR}/*"), LOG_FILE)
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+def rethinkdb_test(**opts) -> dict:
+    """The document-CAS register workload (document_cas.clj) in local
+    mode against casd."""
+    opts.setdefault("threads_per_key", 2)
+    return service_test(
+        "rethinkdb",
+        EtcdClient(opts.get("client_timeout", 0.5)),
+        register_workload(opts), **opts)

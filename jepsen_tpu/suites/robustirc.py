@@ -1,0 +1,106 @@
+"""RobustIRC suite: set semantics on a Raft-replicated IRC network.
+
+Mirrors the reference suite (robustirc/src/jepsen/robustirc.clj): build
+via the Go toolchain (go get, 26-38), upload the shared TLS cert/key
+(40-44), start the primary with ``-singlenode`` to found the network,
+then every other node joins it with ``-join=<primary>:13001`` (46-79);
+teardown is killall + network-dir wipe (81-84). Messages posted to a
+channel and read back form the set workload (102-170) — shared with
+the elasticsearch module here — run against casd's set endpoints in
+local mode.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+from ..control import core as c
+from ..control import util as cu
+from ..db import DB
+from ..os_impl import debian
+from ..runtime import primary, synchronize
+from .elasticsearch import SetClient, set_workload
+from .local_common import service_test
+
+# Explicit absolute paths — '~' would be shell-quoted by the command
+# escaper and never tilde-expand on the node.
+GOPATH = "/root/gocode"
+BINARY = f"{GOPATH}/bin/robustirc"
+DATA_DIR = "/var/lib/robustirc"
+PORT = 13001
+NETWORK = "jepsen"
+PASSWORD = "secret"
+
+
+def _common_flags(node) -> list:
+    return [f"-listen={node}:{PORT}",
+            f"-network_password={PASSWORD}",
+            f"-network_name={NETWORK}",
+            "-tls_cert_path=/tmp/cert.pem",
+            "-tls_ca_file=/tmp/cert.pem",
+            "-tls_key_path=/tmp/key.pem"]
+
+
+class RobustIrcDB(DB):
+    """Go-built RobustIRC network (robustirc.clj:23-84): primary founds
+    the network single-node, the rest join it."""
+
+    def __init__(self, cert: str | None = None, key: str | None = None):
+        # Local paths of a pre-generated TLS pair (the reference ships
+        # resources/cert.pem + key.pem from gencert.go). With none
+        # given, a self-signed pair is generated on the node instead —
+        # silently starting daemons that would die on missing cert
+        # files is not an option.
+        self.cert = cert
+        self.key = key
+
+    def setup(self, test, node):
+        with c.su():
+            cu.meh(c.exec_, "killall", "robustirc")
+            debian.install(["golang-go", "mercurial"])
+            c.exec_("env", f"GOPATH={GOPATH}", "go", "get", "-u",
+                    "github.com/robustirc/robustirc")
+            if self.cert is not None:
+                if not Path(self.cert).exists():
+                    raise FileNotFoundError(
+                        f"TLS pair {self.cert} not found locally")
+                c.upload(self.cert, "/tmp/cert.pem")
+                c.upload(self.key, "/tmp/key.pem")
+            else:
+                c.exec_("openssl", "req", "-x509", "-newkey", "rsa:2048",
+                        "-keyout", "/tmp/key.pem", "-out", "/tmp/cert.pem",
+                        "-days", "365", "-nodes", "-subj", f"/CN={node}")
+            c.exec_("rm", "-rf", DATA_DIR)
+            c.exec_("mkdir", "-p", DATA_DIR)
+            synchronize(test)
+            if node == primary(test):
+                cu.start_daemon(
+                    {"logfile": f"{DATA_DIR}/robustirc.log",
+                     "pidfile": f"{DATA_DIR}/robustirc.pid",
+                     "chdir": DATA_DIR},
+                    BINARY, *_common_flags(node), "-singlenode")
+            synchronize(test)
+            if node != primary(test):
+                cu.start_daemon(
+                    {"logfile": f"{DATA_DIR}/robustirc.log",
+                     "pidfile": f"{DATA_DIR}/robustirc.pid",
+                     "chdir": DATA_DIR},
+                    BINARY, *_common_flags(node),
+                    f"-join={primary(test)}:{PORT}")
+            synchronize(test)
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(c.exec_, "killall", "robustirc")
+            c.exec_("rm", "-rf", DATA_DIR)
+
+    def log_files(self, test, node):
+        return [f"{DATA_DIR}/robustirc.log"]
+
+
+def robustirc_test(**opts) -> dict:
+    """The set workload (robustirc.clj:102-170: post messages, read the
+    channel back) in local mode against casd's set endpoints."""
+    return service_test(
+        "robustirc",
+        SetClient(opts.get("client_timeout", 0.5)),
+        set_workload(opts), **opts)

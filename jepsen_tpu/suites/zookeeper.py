@@ -1,0 +1,85 @@
+"""ZooKeeper suite: CAS register over a ZK ensemble.
+
+Mirrors the reference suite (zookeeper/src/jepsen/zookeeper.clj):
+DB automation at 41-73 — apt-install the distro zookeeper packages,
+write each node's ``myid`` from its position in the node list, append
+the ``server.<id>=<node>:2888:3888`` ensemble lines to zoo.cfg, and
+restart the service; teardown stops the service and wipes the version
+directories and logs. The workload (zookeeper.clj:107-131) is the
+CAS-register family shared with etcd — the avout zk-atom client there
+maps here onto the same independent-keys register workload, run against
+casd in local mode so the family's end-to-end detection is exercised
+without a JVM.
+"""
+from __future__ import annotations
+
+from ..control import core as c
+from ..control.core import lit
+from ..db import DB
+from ..os_impl import debian
+from .etcd import EtcdClient, workload as register_workload
+from .local_common import service_test
+
+ZK_VERSION = "3.4.5+dfsg-2"
+CONF_DIR = "/etc/zookeeper/conf"
+LOG_FILE = "/var/log/zookeeper/zookeeper.log"
+
+# The distro zoo.cfg baseline the reference ships as a resource
+# (zookeeper/resources/zoo.cfg): data dir, client port, quorum timing.
+ZOO_CFG = "\n".join([
+    "tickTime=2000",
+    "initLimit=10",
+    "syncLimit=5",
+    "dataDir=/var/lib/zookeeper",
+    "clientPort=2181",
+])
+
+
+def node_ids(test: dict) -> dict:
+    """node -> ensemble id, by position (zookeeper.clj:19-30)."""
+    return {node: i for i, node in enumerate(test.get("nodes") or [])}
+
+
+def zoo_cfg_servers(test: dict) -> str:
+    """The ensemble's server lines (zookeeper.clj:32-38)."""
+    return "\n".join(f"server.{i}={node}:2888:3888"
+                     for node, i in node_ids(test).items())
+
+
+class ZookeeperDB(DB):
+    """Distro-package ZooKeeper ensemble (zookeeper.clj:41-73): install
+    the pinned zookeeper/zookeeperd packages, write myid + zoo.cfg, and
+    bounce the service."""
+
+    def __init__(self, version: str = ZK_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        with c.su():
+            debian.install([f"{p}={self.version}" for p in
+                            ("zookeeper", "zookeeper-bin", "zookeeperd")])
+            c.exec_("echo", str(node_ids(test)[node]),
+                    lit(">"), f"{CONF_DIR}/myid")
+            c.exec_("echo", ZOO_CFG + "\n" + zoo_cfg_servers(test),
+                    lit(">"), f"{CONF_DIR}/zoo.cfg")
+            c.exec_("service", "zookeeper", "restart")
+
+    def teardown(self, test, node):
+        with c.su():
+            c.exec_("service", "zookeeper", "stop")
+            c.exec_("rm", "-rf", lit("/var/lib/zookeeper/version-*"),
+                    lit("/var/log/zookeeper/*"))
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+def zookeeper_test(**opts) -> dict:
+    """The register workload (zookeeper.clj:107-131) in local mode:
+    independent-keys CAS against casd, ZookeeperDB slotting in for real
+    ensembles."""
+    opts.setdefault("threads_per_key", 2)
+    return service_test(
+        "zookeeper",
+        EtcdClient(opts.get("client_timeout", 0.5)),
+        register_workload(opts), **opts)

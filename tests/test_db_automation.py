@@ -1,0 +1,364 @@
+"""Command-stream tests for every real-server DB automation.
+
+The reference's suites are primarily *database automation* — install,
+configure, bootstrap/join, wipe. Each DB class here runs its full
+setup/teardown over a DummyTransport responder and the exact command
+stream is asserted, the same seam the reference pins with
+core_test.clj:30-84 (ssh-test) and the EtcdDB/ConsulDB tests in
+test_real_cluster.py.
+"""
+import re
+
+from jepsen_tpu.control.core import session, with_session
+
+
+IPS = {f"n{i}": f"10.0.0.{i}" for i in range(1, 6)}
+
+
+def responder(archive_root="pkg-1.0"):
+    """Generic node-side responder: nothing installed, nothing on disk,
+    hostnames resolve, archives have one root dir."""
+    def respond(host, cmd):
+        if re.search(r"\bstat\b", cmd):
+            return "", "No such file or directory", 1
+        m = re.search(r"getent ahosts ([\w.-]+)", cmd)
+        if m:
+            node = m.group(1)
+            return f"{IPS.get(node, '10.0.0.9')} STREAM {node}\n", "", 0
+        if "dirname" in cmd:
+            return "/opt\n", "", 0
+        if "ls -A" in cmd:
+            return f"{archive_root}\n", "", 0
+        if "cluster meet" in cmd:
+            return "OK\n", "", 0
+        if re.search(r"echo ok\b", cmd):     # faketime.wrap's probe
+            return "ok\n", "", 0
+        return "", "", 0
+    return respond
+
+
+def stream(db, test, node, resp=None, teardown=True):
+    """Run setup (+teardown) over a dummy session; return the command
+    list."""
+    s = session(node, {"dummy": True}, resp or responder())
+    with with_session(node, s):
+        db.setup(test, node)
+        if teardown:
+            db.teardown(test, node)
+    return s.transport.commands
+
+
+def first(cmds, substr):
+    for i, cmd in enumerate(cmds):
+        if substr in cmd:
+            return i
+    raise AssertionError(
+        f"no command containing {substr!r} in:\n" + "\n".join(cmds))
+
+
+# ------------------------------------------------------------ zookeeper
+
+def test_zookeeper_db_command_stream():
+    """apt packages, myid by node position, ensemble zoo.cfg, service
+    bounce (zookeeper.clj:41-73)."""
+    from jepsen_tpu.suites.zookeeper import ZookeeperDB
+
+    test = {"nodes": ["n1", "n2", "n3"]}
+    cmds = stream(ZookeeperDB(), test, "n2")
+    i_install = first(cmds, "apt-get install -y")
+    assert "zookeeperd" in cmds[i_install]
+    i_myid = first(cmds, "/etc/zookeeper/conf/myid")
+    assert re.search(r"echo 1 .*myid", cmds[i_myid]), cmds[i_myid]
+    i_cfg = first(cmds, "/etc/zookeeper/conf/zoo.cfg")
+    for line in ("server.0=n1:2888:3888", "server.1=n2:2888:3888",
+                 "server.2=n3:2888:3888", "clientPort=2181"):
+        assert line in cmds[i_cfg], cmds[i_cfg]
+    i_restart = first(cmds, "service zookeeper restart")
+    assert i_install < i_myid < i_restart
+    assert any("service zookeeper stop" in x for x in cmds)
+    assert any("rm -rf /var/lib/zookeeper/version-*" in x for x in cmds)
+    assert ZookeeperDB().log_files(test, "n2") == \
+        ["/var/log/zookeeper/zookeeper.log"]
+
+
+# ------------------------------------------------------------- logcabin
+
+def test_logcabin_db_primary_bootstraps_and_reconfigures():
+    """Primary: clone+scons build, config, --bootstrap, daemonized
+    start, then Reconfigure to the full member set
+    (logcabin.clj:23-150)."""
+    from jepsen_tpu.suites.logcabin import LogCabinDB
+
+    test = {"nodes": ["n1", "n2", "n3"]}
+    cmds = stream(LogCabinDB(), test, "n1")
+    i_clone = first(cmds, "git clone --depth 1")
+    i_build = first(cmds, "cd /logcabin; scons")
+    i_conf = first(cmds, "serverId = 1")
+    assert "listenAddresses = n1:5254" in cmds[i_conf]
+    i_boot = first(cmds, "--bootstrap")
+    i_start = next(i for i, x in enumerate(cmds)
+                   if re.search(r"LogCabin -c .* -d -l", x))
+    i_reconf = first(cmds, "Reconfigure -c")
+    assert i_clone < i_build < i_boot < i_start < i_reconf
+    assert "set n1:5254 n2:5254 n3:5254" in cmds[i_reconf]
+    assert any("kill -9" in x and "LogCabin" in x for x in cmds)
+
+
+def test_logcabin_db_follower_neither_bootstraps_nor_reconfigures():
+    from jepsen_tpu.suites.logcabin import LogCabinDB
+
+    test = {"nodes": ["n1", "n2", "n3"]}
+    cmds = stream(LogCabinDB(), test, "n2", teardown=False)
+    assert not any("--bootstrap" in x for x in cmds)
+    assert not any("Reconfigure -c" in x for x in cmds)
+    assert any(re.search(r"LogCabin -c .* -d -l", x) for x in cmds)
+
+
+# ------------------------------------------------------------ rethinkdb
+
+def test_rethinkdb_db_command_stream():
+    """Vendor apt repo + key, pinned install, join-lines config, service
+    start (rethinkdb.clj:52-95)."""
+    from jepsen_tpu.suites.rethinkdb import RethinkDB
+
+    test = {"nodes": ["n1", "n2"]}
+    cmds = stream(RethinkDB(version="2.3.4~0jessie"), test, "n1")
+    first(cmds, "/etc/apt/sources.list.d/rethinkdb.list")
+    first(cmds, "apt-key add -")
+    i_install = first(cmds, "rethinkdb=2.3.4~0jessie")
+    i_conf = first(cmds, "/etc/rethinkdb/instances.d/jepsen.conf")
+    for frag in ("join=n1:29015", "join=n2:29015", "server-name=n1"):
+        assert frag in cmds[i_conf], cmds[i_conf]
+    i_start = first(cmds, "service rethinkdb start")
+    assert i_install < i_conf < i_start
+    assert any("rm -rf /var/lib/rethinkdb/*" in x for x in cmds)
+
+
+def test_rethinkdb_faketime_rate_wraps_binary():
+    from jepsen_tpu.suites.rethinkdb import RethinkDB
+
+    cmds = stream(RethinkDB(rate=1.5), {"nodes": ["n1"]}, "n1",
+                  teardown=False)
+    assert any("faketime" in x and "/usr/bin/rethinkdb" in x
+               for x in cmds), cmds
+
+
+# -------------------------------------------------------------- mongodb
+
+def test_mongo_smartos_db_primary_initiates_replica_set():
+    """pkgin install, mongod.conf, svcadm enable, rs.initiate with the
+    full member list + election wait on the primary only
+    (mongodb_smartos/core.clj:40-79, 262-300)."""
+    from jepsen_tpu.suites.mongodb import MongoSmartOSDB
+
+    test = {"nodes": ["n1", "n2"]}
+    cmds = stream(MongoSmartOSDB(), test, "n1")
+    i_pkg = first(cmds, "pkgin -y install mongodb-")
+    i_conf = first(cmds, "/opt/local/etc/mongod.conf")
+    assert "replSetName: jepsen" in cmds[i_conf]
+    i_enable = first(cmds, "svcadm enable -r mongodb")
+    i_init = first(cmds, "rs.initiate")
+    assert "n1:27017" in cmds[i_init]
+    assert "n2:27017" in cmds[i_init]
+    i_wait = first(cmds, "rs.isMaster().ismaster")
+    assert i_pkg < i_conf < i_enable < i_init < i_wait
+    assert any("svcadm disable mongodb" in x for x in cmds)
+    assert any("rm -rf /var/lib/mongodb/*" in x for x in cmds)
+
+    follower = stream(MongoSmartOSDB(), test, "n2", teardown=False)
+    assert not any("rs.initiate" in x for x in follower)
+
+
+def test_mongo_rocks_db_command_stream():
+    """.deb install with --force-conf*, engine-overridden config,
+    service restart (mongodb_rocks.clj:29-58)."""
+    from jepsen_tpu.suites.mongodb import MongoRocksDB
+
+    test = {"nodes": ["n1"]}
+    db = MongoRocksDB("http://example.com/mongodb-rocks.deb")
+    cmds = stream(db, test, "n1")
+    i_wget = first(cmds, "wget")
+    assert "mongodb-rocks.deb" in cmds[i_wget]
+    i_dpkg = first(cmds, "dpkg -i --force-confask --force-confnew")
+    i_conf = first(cmds, "/etc/mongod.conf")
+    assert "engine: rocksdb" in cmds[i_conf]
+    assert i_wget < i_dpkg < i_conf < first(cmds, "service mongod restart")
+
+
+# --------------------------------------------------------------- disque
+
+def test_disque_db_follower_meets_primary():
+    """Source build at a pinned rev, config, start-stop-daemon, cluster
+    meet to the primary's IP from followers only (disque.clj:40-119)."""
+    from jepsen_tpu.suites.disque import DisqueDB
+
+    test = {"nodes": ["n1", "n2"]}
+    cmds = stream(DisqueDB(version="8a9290c"), test, "n2")
+    i_clone = first(cmds, "git clone")
+    i_reset = first(cmds, "git reset --hard 8a9290c")
+    i_make = first(cmds, "make")
+    i_start = first(cmds, "start-stop-daemon --start")
+    assert "--exec /opt/disque/src/disque-server" in cmds[i_start]
+    i_meet = first(cmds, "cluster meet 10.0.0.1 7711")
+    assert i_clone < i_reset <= i_make < i_start < i_meet
+    assert any("kill" in x and "disque-server" in x for x in cmds)
+
+    prim = stream(DisqueDB(), test, "n1", teardown=False)
+    assert not any("cluster meet" in x for x in prim)
+
+
+# ------------------------------------------------------------ robustirc
+
+def test_robustirc_db_primary_singlenode_follower_joins():
+    """go get build; the primary founds the network with -singlenode,
+    followers -join it (robustirc.clj:23-84)."""
+    from jepsen_tpu.suites.robustirc import RobustIrcDB
+
+    test = {"nodes": ["n1", "n2"]}
+    prim = stream(RobustIrcDB(), test, "n1", teardown=False)
+    i_go = first(prim, "go get -u github.com/robustirc/robustirc")
+    i_start = first(prim, "-singlenode")
+    assert "-listen=n1:13001" in prim[i_start]
+    assert "-network_name=jepsen" in prim[i_start]
+    assert i_go < i_start
+    assert not any("-join=" in x for x in prim)
+
+    foll = stream(RobustIrcDB(), test, "n2")
+    i_join = first(foll, "-join=n1:13001")
+    assert "-singlenode" not in foll[i_join]
+    assert any("killall robustirc" in x for x in foll)
+    assert any("rm -rf /var/lib/robustirc" in x for x in foll)
+
+
+# ----------------------------------------------------------------- crate
+
+def test_crate_db_command_stream():
+    """Signing key + apt repo + pinned install, crate.yml with majority
+    quorum + unicast IPs, service start (crate.clj:167-229)."""
+    from jepsen_tpu.suites.crate import CrateDB
+
+    test = {"nodes": ["n1", "n2", "n3"]}
+    cmds = stream(CrateDB(), test, "n1")
+    first(cmds, "apt-key add DEB-GPG-KEY-crate")
+    first(cmds, "/etc/apt/sources.list.d/crate.list")
+    i_install = first(cmds, "crate=0.55.2-1~jessie")
+    i_yml = first(cmds, "/etc/crate/crate.yml")
+    assert "minimum_master_nodes: 2" in cmds[i_yml]
+    for ip in ("10.0.0.1", "10.0.0.2", "10.0.0.3"):
+        assert ip in cmds[i_yml], cmds[i_yml]
+    assert "node.name: n1" in cmds[i_yml]
+    i_start = first(cmds, "service crate start")
+    assert i_install < i_yml < i_start
+    assert any("rm -rf /var/lib/crate/*" in x for x in cmds)
+
+
+# -------------------------------------------------------- elasticsearch
+
+def test_es_db_command_stream():
+    """jdk + user + tarball install, templated elasticsearch.yml,
+    daemonized start under the es user, green-health wait
+    (elasticsearch core.clj:212-296)."""
+    from jepsen_tpu.suites.elasticsearch import EsDB
+
+    test = {"nodes": ["n1", "n2", "n3"]}
+    db = EsDB("https://example.com/elasticsearch-2.3.3.tar.gz")
+    cmds = stream(db, test, "n1",
+                  resp=responder(archive_root="elasticsearch-2.3.3"))
+    first(cmds, "adduser --disabled-password")
+    i_tar = first(cmds, "tar xf")
+    i_mv = first(cmds, "mv elasticsearch-2.3.3 /opt/elasticsearch")
+    i_yml = first(cmds, "/opt/elasticsearch/config/elasticsearch.yml")
+    assert "minimum_master_nodes: 2" in cmds[i_yml]
+    assert "cluster.name: jepsen" in cmds[i_yml]
+    first(cmds, "sysctl -w vm.max_map_count=262144")
+    i_start = first(cmds, "start-stop-daemon --start")
+    assert "sudo -S -u elasticsearch" in cmds[i_start]
+    i_wait = first(cmds, "wait_for_status=green")
+    assert i_tar < i_mv < i_yml < i_start < i_wait
+    assert any("rm -rf /opt/elasticsearch/data/*" in x for x in cmds)
+    assert db.log_files(test, "n1") == [
+        "/opt/elasticsearch/logs/stdout.log",
+        "/opt/elasticsearch/logs/jepsen.log"]
+
+
+# ------------------------------------------------------------ hazelcast
+
+def test_hazelcast_db_uploads_jar_and_lists_members():
+    """jdk install, server-jar upload, java -jar with peer IPs
+    (hazelcast.clj:63-112)."""
+    from jepsen_tpu.suites.hazelcast import HazelcastDB
+
+    test = {"nodes": ["n1", "n2", "n3"]}
+    db = HazelcastDB("/tmp/server.jar")
+    s = session("n2", {"dummy": True}, responder())
+    with with_session("n2", s):
+        db.setup(test, "n2")
+        db.teardown(test, "n2")
+    cmds = s.transport.commands
+    assert ("/tmp/server.jar", "/opt/hazelcast/server.jar") \
+        in s.transport.uploads
+    i_start = first(cmds, "start-stop-daemon --start")
+    assert "--exec /usr/bin/java" in cmds[i_start]
+    # Peers only — never this node's own IP.
+    assert "--members 10.0.0.1,10.0.0.3" in cmds[i_start]
+    assert db.log_files(test, "n2") == ["/opt/hazelcast/server.log"]
+
+
+# ------------------------------------------------------------ aerospike
+
+def test_aerospike_db_command_stream():
+    """Versioned .deb install, faketime wrapper over asd, mesh-seed
+    config pointing at the primary, service start + recovery policy
+    (aerospike core.clj:95-180)."""
+    from jepsen_tpu.suites.aerospike import AerospikeDB
+
+    test = {"nodes": ["n1", "n2"]}
+    cmds = stream(AerospikeDB(version="3.5.4"), test, "n2")
+    i_wget = first(cmds, "wget -O aerospike.tgz")
+    assert "3.5.4" in cmds[i_wget]
+    i_deb = first(cmds, "dpkg -i aerospike-server-community-*.deb")
+    i_wrap = first(cmds, "mv /usr/bin/asd /usr/local/bin/asd")
+    i_conf = first(cmds, "/etc/aerospike/aerospike.conf")
+    assert "mesh-seed-address-port 10.0.0.1 3002" in cmds[i_conf]
+    assert "address 10.0.0.2 port 3000" in cmds[i_conf]
+    i_start = first(cmds, "service aerospike start")
+    first(cmds, "paxos-recovery-policy=auto-dun-master")
+    assert i_wget < i_deb < i_wrap < i_conf < i_start
+    assert any("rm -rf /opt/aerospike/data/*" in x for x in cmds)
+
+
+# ------------------------------------------------------------- rabbitmq
+
+def test_rabbitmq_db_follower_joins_cluster():
+    """.deb install with erlang, shared cookie, join_cluster onto the
+    primary, ha-policy (rabbitmq.clj:24-99)."""
+    from jepsen_tpu.suites.rabbitmq import RabbitDB
+
+    test = {"nodes": ["n1", "n2"]}
+    cmds = stream(RabbitDB(version="3.5.6"), test, "n2")
+    i_wget = first(cmds, "wget")
+    assert "rabbitmq-server_3.5.6-1_all.deb" in cmds[i_wget]
+    first(cmds, "apt-get install -y erlang-nox")
+    i_cookie = first(cmds, "/var/lib/rabbitmq/.erlang.cookie")
+    i_stop_app = first(cmds, "rabbitmqctl stop_app")
+    i_join = first(cmds, "rabbitmqctl join_cluster rabbit@n1")
+    i_start_app = first(cmds, "rabbitmqctl start_app")
+    i_policy = first(cmds, "rabbitmqctl set_policy ha-maj")
+    assert i_cookie < i_stop_app < i_join < i_start_app < i_policy
+    assert any("rm -rf /var/lib/rabbitmq/mnesia/" in x for x in cmds)
+
+    prim = stream(RabbitDB(), test, "n1", teardown=False)
+    assert not any("join_cluster" in x for x in prim)
+    assert any("set_policy" in x for x in prim)
+
+
+# ------------------------------------------------- suites are registered
+
+def test_new_suites_registered_in_cli():
+    from jepsen_tpu.cli import SUITE_NAMES, suite_registry
+
+    reg = suite_registry()
+    for name in ("zookeeper", "logcabin", "rethinkdb", "mongodb",
+                 "crate", "disque", "robustirc"):
+        assert name in SUITE_NAMES
+        assert name in reg
