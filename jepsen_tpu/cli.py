@@ -192,7 +192,8 @@ SUITE_NAMES = ("etcd", "etcd-casd", "hazelcast", "hazelcast-lock",
                "hazelcast-ids", "hazelcast-queue", "rabbitmq", "aerospike",
                "elasticsearch", "consul", "cockroach", "bank", "monotonic",
                "zookeeper", "logcabin", "rethinkdb", "mongodb", "crate",
-               "disque", "robustirc")
+               "disque", "robustirc", "galera", "percona",
+               "mysql-cluster", "postgres-rds")
 
 # Suites whose builder dispatches on --workload (hazelcast.clj:278-343's
 # :workload flag; cockroach runner.clj:59-93's test-by-name routing).
@@ -211,9 +212,9 @@ def suite_registry() -> Dict[str, Callable]:
     per-project lein runners; one registry serves the same role here).
     The real-cluster etcd suite additionally consumes --nodes/--ssh."""
     from .suites import (aerospike, cockroachdb, consul, crate, disque,
-                         elasticsearch, etcd, hazelcast, logcabin,
-                         mongodb, rabbitmq, rethinkdb, robustirc,
-                         zookeeper)
+                         elasticsearch, etcd, galera, hazelcast, logcabin,
+                         mongodb, mysql_cluster, percona, postgres_rds,
+                         rabbitmq, rethinkdb, robustirc, zookeeper)
     return {
         "etcd": lambda kw: etcd.etcd_test(**kw),
         "etcd-casd": lambda kw: etcd.casd_test(**kw),
@@ -238,6 +239,10 @@ def suite_registry() -> Dict[str, Callable]:
         "crate": lambda kw: crate.crate_test(**kw),
         "disque": lambda kw: disque.disque_test(**kw),
         "robustirc": lambda kw: robustirc.robustirc_test(**kw),
+        "galera": lambda kw: galera.galera_test(**kw),
+        "percona": lambda kw: percona.percona_test(**kw),
+        "mysql-cluster": lambda kw: mysql_cluster.mysql_cluster_test(**kw),
+        "postgres-rds": lambda kw: postgres_rds.postgres_rds_test(**kw),
     }
 
 

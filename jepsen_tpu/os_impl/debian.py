@@ -57,8 +57,14 @@ def installed(packages: Sequence[str]) -> set:
 
 
 def installed_version(package: str) -> Optional[str]:
-    """Installed version of a package (debian.clj:70-78)."""
-    out = exec_("dpkg-query", "-W", "-f", lit("'${Version}'"), package)
+    """Installed version of a package, or None when it isn't installed
+    (debian.clj:70-78). dpkg-query exits nonzero for unknown packages —
+    exactly the case version guards probe — so that's None, not an
+    error."""
+    try:
+        out = exec_("dpkg-query", "-W", "-f", lit("'${Version}'"), package)
+    except RemoteError:
+        return None
     return out or None
 
 

@@ -21,6 +21,49 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..checkers.core import Checker, merge_valid
+from ..control import core as c
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
+from ..os_impl import debian
+
+JOB_DIR = "/tmp/chronos-test/"
+
+
+class ChronosDB(DB):
+    """Chronos on a Mesos cluster (chronos.clj:56-83): the composed
+    MesosDB (mesosphere.py) brings up zookeeper + mesos, then the
+    pinned chronos package is installed, the schedule horizon is
+    lowered so frequent tasks aren't forgotten (chronos.clj:40-45),
+    the run-artifact job dir is created, and the service started."""
+
+    def __init__(self, mesos_version: str = "0.23.0-1.0.debian81",
+                 chronos_version: str = "2.3.4-1.0.81.debian77",
+                 mesos: DB | None = None):
+        from .mesosphere import MesosDB
+        self.chronos_version = chronos_version
+        self.mesos = mesos or MesosDB(mesos_version)
+
+    def setup(self, test, node):
+        self.mesos.setup(test, node)
+        with c.su():
+            debian.install([f"chronos={self.chronos_version}"])
+            c.exec_("echo", "1", lit(">"),
+                    "/etc/chronos/conf/schedule_horizon")
+            c.exec_("mkdir", "-p", JOB_DIR)
+            c.exec_("service", "chronos", "start")
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(c.exec_, "service", "chronos", "stop")
+            cu.meh(cu.grepkill, "/usr/bin/chronos")
+        self.mesos.teardown(test, node)
+        with c.su():
+            c.exec_("rm", "-rf", JOB_DIR)
+            c.exec_("truncate", "--size", "0", "/var/log/messages")
+
+    def log_files(self, test, node):
+        return self.mesos.log_files(test, node) + ["/var/log/messages"]
 
 # The reference lets the scheduler miss deadlines by a few extra
 # seconds (checker.clj epsilon-forgiveness).

@@ -20,9 +20,11 @@ Local mode drives casd's /bank endpoints. The daemon's transfers are
 atomic by default; the ``--bank-split-ms N`` flag releases the store
 lock between debit and credit for N ms — a REAL isolation bug
 (mid-transfer state observable), which is the seeded violation the
-checker must catch. Real-CockroachDB automation (JDBC client +
-cluster install, cockroach.clj:136-164) slots behind the DB protocol
-as in the etcd suite.
+checker must catch. ``CockroachAuto`` is the real-cluster automation
+(cockroach/auto.clj:142-217: tarball install under a dedicated user +
+the on-node bumptime clock tool, start-stop-daemon with the linearizable
+/ max-offset env and a --join list on non-primaries, kill + store
+wipe), behind the DB protocol and command-stream tested like EtcdDB.
 """
 from __future__ import annotations
 
@@ -34,7 +36,78 @@ import urllib.error
 from .. import gen as g
 from .. import independent
 from ..checkers.core import Checker, merge_valid
+from ..control import core as c
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
+from ..nemesis import time as nt
+from ..os_impl import debian
+from ..runtime import primary
 from .local_common import ServiceClient, service_test
+
+CR_USER = "cockroach"
+CR_PATH = "/opt/cockroach"
+CR_BIN = f"{CR_PATH}/cockroach"
+CR_STORE = f"{CR_PATH}/cockroach-data"
+CR_PIDFILE = f"{CR_PATH}/pid"
+CR_LOGS = f"{CR_PATH}/logs"
+CR_ERRLOG = f"{CR_LOGS}/cockroach.stderr"
+CR_VERLOG = f"{CR_LOGS}/version.txt"
+
+
+class CockroachAuto(DB):
+    """Real-cluster CockroachDB automation (cockroach/auto.clj).
+
+    setup = install (142-155: deps, dedicated user, tarball, log dir,
+    chown, on-node bumptime build per install-bumptime! at 122-140 via
+    the shared clock-tool path) + version log (179-183) + start
+    (192-206): start-stop-daemon --chuid cockroach with
+    COCKROACH_LINEARIZABLE/COCKROACH_MAX_OFFSET env, ``start
+    --insecure``, and ``--join=<other nodes>`` on every non-primary.
+    teardown = kill + store wipe (auto.clj:207-213; cockroach.clj's
+    wipe)."""
+
+    def __init__(self, tarball: str | None = None,
+                 insecure: bool = True):
+        self.tarball = tarball
+        self.insecure = insecure
+
+    def setup(self, test, node):
+        with c.su():
+            debian.install(["tcpdump", "ntpdate"])
+            cu.ensure_user(CR_USER)
+            cu.install_archive(test.get("tarball", self.tarball), CR_PATH)
+            c.exec_("mkdir", "-p", CR_PATH, CR_LOGS)
+            c.exec_("chown", "-R", f"{CR_USER}:{CR_USER}", CR_PATH)
+        nt.install()                     # bumptime/strobe clock tools
+        with c.sudo(CR_USER):
+            c.exec_star(f"{CR_BIN} version > {CR_VERLOG} 2>&1")
+            flags = ["start"]
+            if self.insecure:
+                flags.append("--insecure")
+            if node != primary(test):
+                others = ",".join(str(n) for n in test["nodes"]
+                                  if n != node)
+                flags.append(f"--join={others}")
+            linearizable = "true" if test.get("linearizable") else "false"
+            c.exec_("env",
+                    f"COCKROACH_LINEARIZABLE={linearizable}",
+                    "COCKROACH_MAX_OFFSET=250ms",
+                    "start-stop-daemon", "--start", "--background",
+                    "--make-pidfile", "--remove-pidfile",
+                    "--pidfile", CR_PIDFILE, "--no-close",
+                    "--chuid", CR_USER, "--chdir", CR_PATH,
+                    "--exec", CR_BIN, "--",
+                    *flags, "--logtostderr",
+                    lit(">>"), CR_ERRLOG, lit("2>&1"))
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(c.exec_, "killall", "-9", "cockroach")
+            c.exec_("rm", "-rf", CR_STORE, CR_PIDFILE)
+
+    def log_files(self, test, node):
+        return [CR_ERRLOG, CR_VERLOG]
 
 
 class BankClient(ServiceClient):

@@ -1,0 +1,126 @@
+"""Galera suite: bank serializability on MariaDB Galera.
+
+Mirrors the reference suite (galera/src/jepsen/galera.clj): install from
+the mariadb apt repo with debconf-preseeded root password and a
+squirreled copy of the stock data dir (34-57), write the wsrep cluster
+address into jepsen.cnf (64-73), bootstrap the primary with
+``service mysql start --wsrep-new-cluster`` while the rest plain-start
+and join (102-122), create the jepsen database + grant (96-100), and
+teardown by killing mysqld, truncating logs, and restoring the stock
+data dir (123-131). The workload (galera.clj:240-339) is the bank
+family — shared with the cockroach module here — run against casd's
+bank endpoints in local mode.
+"""
+from __future__ import annotations
+
+from ..control import core as c
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
+from ..os_impl import debian
+from ..runtime import primary, synchronize
+from .cockroachdb import BankClient, bank_workload
+from .local_common import service_test
+
+REPO_LINE = ("deb http://sfo1.mirrors.digitalocean.com/mariadb/repo/10.0/"
+             "debian jessie main")
+KEYSERVER = "keyserver.ubuntu.com"
+KEY = "0xcbcb082a1bb943db"
+LOG_FILES = ["/var/log/syslog", "/var/log/mysql.log", "/var/log/mysql.err"]
+DIR = "/var/lib/mysql"
+STOCK_DIR = "/var/lib/mysql-stock"
+
+DEBCONF = [
+    "mariadb-galera-server-10.0 mysql-server/root_password password jepsen",
+    "mariadb-galera-server-10.0 mysql-server/root_password_again password "
+    "jepsen",
+    "mariadb-galera-server-10.0 mysql-server-5.1/start_on_boot boolean "
+    "false",
+]
+
+
+def cluster_address(test: dict) -> str:
+    """gcomm:// over every node (galera.clj:59-62)."""
+    return "gcomm://" + ",".join(str(n) for n in test.get("nodes") or [])
+
+
+def jepsen_cnf(test: dict) -> str:
+    """The reference's resources/jepsen.cnf with %CLUSTER_ADDRESS%
+    substituted (galera.clj:64-73)."""
+    return "\n".join([
+        "[mysqld]",
+        "wsrep_provider=/usr/lib/galera/libgalera_smm.so",
+        f"wsrep_cluster_address={cluster_address(test)}",
+        "wsrep_cluster_name=jepsen",
+        "binlog_format=ROW",
+        "default_storage_engine=InnoDB",
+        "innodb_autoinc_lock_mode=2",
+    ])
+
+
+def sql(statement: str) -> str:
+    """Eval a SQL string via the CLI (galera.clj:81-84)."""
+    return c.exec_("mysql", "-u", "root", "--password=jepsen", "-e",
+                   statement)
+
+
+def setup_db() -> None:
+    """Create the jepsen database + grant (galera.clj:96-100)."""
+    sql("create database if not exists jepsen;")
+    sql("GRANT ALL PRIVILEGES ON jepsen.* "
+        "TO 'jepsen'@'%' IDENTIFIED BY 'jepsen';")
+
+
+class GaleraDB(DB):
+    """MariaDB Galera cluster (galera.clj:34-131)."""
+
+    def setup(self, test, node):
+        with c.su():
+            debian.add_repo("galera", REPO_LINE, KEYSERVER, KEY)
+            for line in DEBCONF:
+                c.exec_star(f"echo {c.escape(line)} | "
+                            f"debconf-set-selections")
+            debian.install(["rsync"])
+            if "mariadb-galera-server" not in debian.installed(
+                    ["mariadb-galera-server"]):
+                debian.install(["mariadb-galera-server"])
+                c.exec_("service", "mysql", "stop")
+                # Squirrel away a stock copy so teardown can restore a
+                # pristine data dir (galera.clj:55-57).
+                c.exec_("rm", "-rf", STOCK_DIR)
+                c.exec_("cp", "-rp", DIR, STOCK_DIR)
+            c.exec_("echo", jepsen_cnf(test), lit(">"),
+                    "/etc/mysql/conf.d/jepsen.cnf")
+            if node == primary(test):
+                c.exec_("service", "mysql", "start",
+                        "--wsrep-new-cluster")
+            synchronize(test)
+            if node != primary(test):
+                c.exec_("service", "mysql", "start")
+            synchronize(test)
+        setup_db()
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(cu.grepkill, "mysqld")
+            for f in LOG_FILES:
+                cu.meh(c.exec_, "truncate", "-c", "--size", "0", f)
+            # The stock copy only exists after a prior setup — and the
+            # harness cycles teardown FIRST (db.cycle), so a fresh node
+            # must pass through here unharmed.
+            if cu.exists(STOCK_DIR):
+                c.exec_("rm", "-rf", DIR)
+                c.exec_("cp", "-rp", STOCK_DIR, DIR)
+
+    def log_files(self, test, node):
+        return LOG_FILES
+
+
+def galera_test(**opts) -> dict:
+    """The bank workload (galera.clj:240-339) in local mode against
+    casd's bank endpoints."""
+    return service_test(
+        "galera",
+        BankClient(opts.get("client_timeout", 0.5),
+                   opts.get("accounts", 5), opts.get("balance", 10)),
+        bank_workload(opts), **opts)

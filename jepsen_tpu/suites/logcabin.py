@@ -33,9 +33,11 @@ TREEOPS = "/root/TreeOps"
 PORT = 5254
 
 
-def server_id(node) -> str:
-    """Node name minus the 'n' prefix (logcabin.clj:48-50)."""
-    return str(node).lstrip("n") or "1"
+def server_id(test: dict, node) -> str:
+    """1-based position in the node list (the reference derives ids
+    from node names, logcabin.clj:48-50; positions are unique and
+    numeric for ANY hostnames)."""
+    return str(1 + list(test.get("nodes") or [node]).index(node))
 
 
 def server_addr(node) -> str:
@@ -63,7 +65,7 @@ class LogCabinDB(DB):
                                 ("build/Examples/TreeOps", TREEOPS)):
                 c.exec_("cp", "-f", f"/logcabin/{built}", dest)
             c.exec_("echo",
-                    f"serverId = {server_id(node)}\n"
+                    f"serverId = {server_id(test, node)}\n"
                     f"listenAddresses = {server_addr(node)}",
                     lit(">"), CONFIG_FILE)
             if node == primary(test):

@@ -1,0 +1,105 @@
+"""Mesos cluster automation for the chronos suite.
+
+Mirrors chronos/src/jepsen/mesosphere.clj: the mesosphere apt repo +
+pinned mesos install (26-36), a ZooKeeper ensemble underneath (db at
+133-150 composes zk/db), /etc/mesos/zk + quorum config (49-58), and
+role-split daemon startup — the first ``MASTER_COUNT`` sorted nodes run
+mesos-master with the zk URI and majority quorum, the rest run
+mesos-slave pointed at the same URI (60-118); teardown kills both and
+wipes work/log dirs (121-131, 144-148).
+"""
+from __future__ import annotations
+
+from ..control import core as c
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
+from ..os_impl import debian
+from ..utils.core import majority
+from .zookeeper import ZookeeperDB
+
+MASTER_COUNT = 3
+MASTER_PIDFILE = "/var/run/mesos/master.pid"
+SLAVE_PIDFILE = "/var/run/mesos/slave.pid"
+MASTER_DIR = "/var/lib/mesos/master"
+SLAVE_DIR = "/var/lib/mesos/slave"
+LOG_DIR = "/var/log/mesos"
+MASTER_BIN = "/usr/sbin/mesos-master"
+SLAVE_BIN = "/usr/sbin/mesos-slave"
+REPO_LINE = "deb http://repos.mesosphere.io/debian wheezy main"
+KEYSERVER = "keyserver.ubuntu.com"
+KEY = "E56151BF"
+
+
+def masters(test: dict) -> list:
+    """The first MASTER_COUNT sorted nodes run masters
+    (mesosphere.clj:68, 101)."""
+    return sorted(str(n) for n in test.get("nodes") or [])[:MASTER_COUNT]
+
+
+def zk_uri(test: dict) -> str:
+    """zk://n1:2181,...,nN:2181/mesos (mesosphere.clj:38-47)."""
+    hosts = ",".join(f"{n}:2181" for n in test.get("nodes") or [])
+    return f"zk://{hosts}/mesos"
+
+
+class MesosDB(DB):
+    """Mesos over a ZooKeeper ensemble (mesosphere.clj:26-150)."""
+
+    def __init__(self, version: str = "0.23.0-1.0.debian81",
+                 zk: DB | None = None):
+        self.version = version
+        self.zk = zk or ZookeeperDB()
+
+    def setup(self, test, node):
+        self.zk.setup(test, node)
+        # Quorum must come from the masters that actually exist —
+        # clusters smaller than MASTER_COUNT would otherwise demand an
+        # unreachable majority and the registrar could never commit.
+        quorum = majority(len(masters(test)))
+        with c.su():
+            debian.add_repo("mesosphere", REPO_LINE, KEYSERVER, KEY)
+            debian.install([f"mesos={self.version}"])
+            for d in ("/var/run/mesos", MASTER_DIR, SLAVE_DIR, LOG_DIR):
+                c.exec_("mkdir", "-p", d)
+            c.exec_("echo", zk_uri(test), lit(">"), "/etc/mesos/zk")
+            c.exec_("echo", str(quorum), lit(">"),
+                    "/etc/mesos-master/quorum")
+            if str(node) in masters(test):
+                cu.start_daemon(
+                    {"logfile": f"{LOG_DIR}/master.stdout",
+                     "pidfile": MASTER_PIDFILE, "chdir": MASTER_DIR,
+                     "match_executable": False},
+                    "/usr/bin/env", "GLOG_v=1", MASTER_BIN,
+                    f"--hostname={node}",
+                    f"--log_dir={LOG_DIR}",
+                    f"--quorum={quorum}",
+                    "--registry_fetch_timeout=120secs",
+                    "--registry_store_timeout=5secs",
+                    f"--work_dir={MASTER_DIR}",
+                    "--offer_timeout=30secs",
+                    f"--zk={zk_uri(test)}")
+            else:
+                cu.start_daemon(
+                    {"logfile": f"{LOG_DIR}/slave.stdout",
+                     "pidfile": SLAVE_PIDFILE, "chdir": SLAVE_DIR},
+                    SLAVE_BIN,
+                    f"--hostname={node}",
+                    f"--log_dir={LOG_DIR}",
+                    "--recovery_timeout=30secs",
+                    f"--work_dir={SLAVE_DIR}",
+                    f"--master={zk_uri(test)}")
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(c.exec_, "killall", "-9", "mesos-slave")
+            cu.meh(c.exec_, "rm", "-rf", SLAVE_PIDFILE)
+            cu.meh(c.exec_, "killall", "-9", "mesos-master")
+            cu.meh(c.exec_, "rm", "-rf", MASTER_PIDFILE)
+            c.exec_("rm", "-rf", lit(f"{MASTER_DIR}/*"),
+                    lit(f"{SLAVE_DIR}/*"), lit(f"{LOG_DIR}/*"))
+        self.zk.teardown(test, node)
+
+    def log_files(self, test, node):
+        return (self.zk.log_files(test, node)
+                + [f"{LOG_DIR}/master.stdout", f"{LOG_DIR}/slave.stdout"])

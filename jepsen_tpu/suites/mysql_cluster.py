@@ -1,0 +1,155 @@
+"""MySQL Cluster (NDB) suite: bank serializability.
+
+Mirrors the reference suite
+(mysql-cluster/src/jepsen/mysql_cluster.clj): the one .deb install with
+version guard (32-51), three node roles with disjoint NodeId ranges —
+management (mgmd, ids 1+), storage (ndbd, ids 11+, first four nodes),
+sql (mysqld, ids 21+) (53-75) — a shared config.ini listing every
+role on every node plus a per-node my.cnf with the ndb connect string
+(77-146), staged startup mgmd -> ndbd -> mysqld with barriers between
+stages (187-203), and grepkill + data-wipe teardown (169-185, 205-214).
+Workload: the bank family against casd in local mode.
+"""
+from __future__ import annotations
+
+from ..control import core as c
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
+from ..os_impl import debian
+from ..runtime import synchronize
+from .cockroachdb import BankClient, bank_workload
+from .local_common import service_test
+
+USER = "mysql"
+MGMD_DIR = "/var/lib/mysql/cluster"
+NDBD_DIR = "/var/lib/mysql/data"
+MYSQLD_DIR = "/var/lib/mysql/mysql"
+BIN = "/opt/mysql/server-5.6/bin"
+MGMD_ID_OFFSET = 1
+NDBD_ID_OFFSET = 11
+MYSQLD_ID_OFFSET = 21
+
+
+def _idx(test: dict, node) -> int:
+    return list(test.get("nodes") or []).index(node)
+
+
+def mgmd_id(test, node) -> int:
+    return MGMD_ID_OFFSET + _idx(test, node)
+
+
+def ndbd_id(test, node) -> int:
+    return NDBD_ID_OFFSET + _idx(test, node)
+
+
+def mysqld_id(test, node) -> int:
+    return MYSQLD_ID_OFFSET + _idx(test, node)
+
+
+def ndbd_nodes(test: dict) -> list:
+    """Storage role runs on the first four nodes, sorted
+    (mysql_cluster.clj:97-101)."""
+    return sorted(test.get("nodes") or [])[:4]
+
+
+def nodes_conf(test: dict) -> str:
+    """Role sections for every node (mysql_cluster.clj:103-114)."""
+    parts = []
+    for n in test["nodes"]:
+        parts.append(f"[ndb_mgmd]\nNodeId={mgmd_id(test, n)}\n"
+                     f"hostname={n}\ndatadir={MGMD_DIR}\n")
+    for n in ndbd_nodes(test):
+        parts.append(f"[ndbd]\nNodeId={ndbd_id(test, n)}\n"
+                     f"hostname={n}\ndatadir={NDBD_DIR}\n")
+    for n in test["nodes"]:
+        parts.append(f"[mysqld]\nNodeId={mysqld_id(test, n)}\n"
+                     f"hostname={n}\n")
+    return "\n".join(parts)
+
+
+def connect_string(test: dict) -> str:
+    return ",".join(str(n) for n in test.get("nodes") or [])
+
+
+def my_cnf(test: dict, node) -> str:
+    """resources/my.cnf with %NODE_ID%/%DATA_DIR%/%NDB_CONNECT_STRING%
+    substituted (mysql_cluster.clj:120-131)."""
+    return "\n".join([
+        "[mysqld]",
+        f"ndb-nodeid={mysqld_id(test, node)}",
+        "ndbcluster",
+        f"datadir={MYSQLD_DIR}",
+        f"ndb-connectstring={connect_string(test)}",
+        "[mysql_cluster]",
+        f"ndb-connectstring={connect_string(test)}",
+    ])
+
+
+class MySQLClusterDB(DB):
+    """MySQL Cluster with staged mgmd/ndbd/mysqld startup
+    (mysql_cluster.clj:32-214)."""
+
+    def __init__(self, version: str = "7.4.6"):
+        self.version = version
+
+    def setup(self, test, node):
+        deb = f"mysql-cluster-gpl-{self.version}-debian7-x86_64.deb"
+        with c.su():
+            debian.install(["libaio1"])
+            with c.cd("/tmp"):
+                f = cu.wget("https://dev.mysql.com/get/Downloads/"
+                            f"MySQL-Cluster-7.4/{deb}")
+                pkg = c.exec_("dpkg-deb", "-f", f, "Package")
+                if c.exec_("dpkg-deb", "-f", f, "Version") != \
+                        debian.installed_version(pkg):
+                    c.exec_("dpkg", "-i", "--force-confask",
+                            "--force-confnew", f)
+            cu.meh(c.exec_, "adduser", "--disabled-password",
+                   "--gecos", lit("''"), USER)
+            c.exec_("echo", my_cnf(test, node), lit(">"), "/etc/my.cnf")
+            c.exec_("mkdir", "-p", MGMD_DIR)
+            c.exec_("echo", nodes_conf(test), lit(">"),
+                    "/etc/my.config.ini")
+            # Staged bring-up with cluster-wide barriers
+            # (mysql_cluster.clj:187-203).
+            c.exec_(f"{BIN}/ndb_mgmd",
+                    f"--ndb-nodeid={mgmd_id(test, node)}",
+                    "-f", "/etc/my.config.ini")
+            synchronize(test)
+            if node in ndbd_nodes(test):
+                c.exec_("mkdir", "-p", NDBD_DIR)
+                c.exec_(f"{BIN}/ndbd",
+                        f"--ndb-nodeid={ndbd_id(test, node)}")
+            synchronize(test)
+            c.exec_("mkdir", "-p", MYSQLD_DIR)
+            c.exec_("chown", "-R", f"{USER}:{USER}", MYSQLD_DIR)
+        with c.sudo(USER):
+            # mysqld_safe supervises mysqld in the foreground and never
+            # exits — it must be daemonized or setup hangs until the
+            # transport timeout.
+            cu.start_daemon(
+                {"logfile": f"{MYSQLD_DIR}/mysqld_safe.log",
+                 "pidfile": f"{MYSQLD_DIR}/mysqld_safe.pid",
+                 "chdir": MYSQLD_DIR},
+                f"{BIN}/mysqld_safe", "--defaults-file=/etc/my.cnf")
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(cu.grepkill, "mysqld")
+            cu.meh(cu.grepkill, "ndbd")
+            cu.meh(cu.grepkill, "ndb_mgmd")
+            c.exec_("rm", "-rf", lit(f"{MGMD_DIR}/*"),
+                    lit(f"{NDBD_DIR}/*"), lit(f"{MYSQLD_DIR}/*"))
+
+    def log_files(self, test, node):
+        return [f"{MYSQLD_DIR}/error.log"]
+
+
+def mysql_cluster_test(**opts) -> dict:
+    """The bank workload in local mode against casd's bank endpoints."""
+    return service_test(
+        "mysql-cluster",
+        BankClient(opts.get("client_timeout", 0.5),
+                   opts.get("accounts", 5), opts.get("balance", 10)),
+        bank_workload(opts), **opts)

@@ -1,0 +1,126 @@
+"""Percona XtraDB Cluster suite: bank serializability.
+
+Mirrors the reference suite (percona/src/jepsen/percona.clj): percona
+apt repo + pin-preferences + debconf-preseeded root passwords, pinned
+install with a squirreled stock data dir (34-71), wsrep jepsen.cnf —
+the primary bootstraps with an EMPTY ``gcomm://`` while joiners list
+every node (73-89), ``service mysql start bootstrap-pxc`` on the
+primary vs plain start (118-138), jepsen db + grant (113-117), and the
+same truncate-logs + restore-stock-dir teardown as galera (139-147).
+Workload: the bank family against casd in local mode.
+"""
+from __future__ import annotations
+
+from ..control import core as c
+from ..control import util as cu
+from ..control.core import lit
+from ..db import DB
+from ..os_impl import debian
+from ..runtime import primary, synchronize
+from .cockroachdb import BankClient, bank_workload
+from .galera import DIR, STOCK_DIR, setup_db
+from .local_common import service_test
+
+REPO_LINE = "deb http://repo.percona.com/apt jessie main"
+KEYSERVER = "keys.gnupg.net"
+KEY = "1C4CBDCDCD2EFD2A"
+PACKAGE = "percona-xtradb-cluster-56"
+LOG_FILES = ["/var/log/syslog", "/var/log/mysql.log", "/var/log/mysql.err",
+             "/var/lib/mysql/queries.log"]
+
+# Pin percona's repo above the distro's (resources/apt-prefs).
+APT_PREFS = "\n".join(["Package: *",
+                       "Pin: release o=Percona Development Team",
+                       "Pin-Priority: 1001"])
+
+DEBCONF = [
+    f"{PACKAGE} mysql-server/root_password password jepsen",
+    f"{PACKAGE} mysql-server/root_password_again password jepsen",
+    f"{PACKAGE} mysql-server-5.1/start_on_boot boolean false",
+    "percona-xtradb-cluster-server-5.6 "
+    "percona-xtradb-cluster-server/root_password_again password jepsen",
+    "percona-xtradb-cluster-server-5.6 "
+    "percona-xtradb-cluster-server/root_password password jepsen",
+]
+
+
+def cluster_address(test: dict, node) -> str:
+    """The primary bootstraps a NEW cluster (empty gcomm), joiners list
+    everyone (percona.clj:73-79)."""
+    if node == primary(test):
+        return "gcomm://"
+    return "gcomm://" + ",".join(str(n) for n in test.get("nodes") or [])
+
+
+def jepsen_cnf(test: dict, node) -> str:
+    """resources/jepsen.cnf with %CLUSTER_ADDRESS% substituted
+    (percona.clj:80-89)."""
+    return "\n".join([
+        "[mysqld]",
+        "wsrep_provider=/usr/lib/libgalera_smm.so",
+        f"wsrep_cluster_address={cluster_address(test, node)}",
+        "wsrep_cluster_name=jepsen",
+        "wsrep_sst_method=rsync",
+        "binlog_format=ROW",
+        "default_storage_engine=InnoDB",
+        "innodb_autoinc_lock_mode=2",
+    ])
+
+
+class PerconaDB(DB):
+    """Percona XtraDB cluster (percona.clj:34-147)."""
+
+    def __init__(self, version: str = "5.6.25-25.12-1.jessie"):
+        self.version = version
+
+    def setup(self, test, node):
+        with c.su():
+            debian.add_repo("percona", REPO_LINE, KEYSERVER, KEY)
+            c.exec_("echo", APT_PREFS, lit(">"),
+                    "/etc/apt/preferences.d/00percona.pref")
+            debian.install(["rsync"])
+            if debian.installed_version(PACKAGE) != self.version:
+                for line in DEBCONF:
+                    c.exec_star(f"echo {c.escape(line)} | "
+                                f"debconf-set-selections")
+                # Keep our config away from the package's first start
+                # and start from a clean data dir (percona.clj:60-65).
+                c.exec_("rm", "-rf", "/etc/mysql/conf.d/jepsen.cnf")
+                c.exec_("rm", "-rf", DIR)
+                debian.install([f"{PACKAGE}={self.version}"])
+                c.exec_("service", "mysql", "stop")
+                c.exec_("rm", "-rf", STOCK_DIR)
+                c.exec_("cp", "-rp", DIR, STOCK_DIR)
+            c.exec_("echo", jepsen_cnf(test, node), lit(">"),
+                    "/etc/mysql/conf.d/jepsen.cnf")
+            if node == primary(test):
+                c.exec_("service", "mysql", "start", "bootstrap-pxc")
+            synchronize(test)
+            if node != primary(test):
+                c.exec_("service", "mysql", "start")
+            synchronize(test)
+        setup_db()
+
+    def teardown(self, test, node):
+        with c.su():
+            cu.meh(cu.grepkill, "mysqld")
+            for f in LOG_FILES:
+                cu.meh(c.exec_, "truncate", "-c", "--size", "0", f)
+            # Stock copy exists only after a prior setup; teardown runs
+            # first on a fresh node (db.cycle).
+            if cu.exists(STOCK_DIR):
+                c.exec_("rm", "-rf", DIR)
+                c.exec_("cp", "-rp", STOCK_DIR, DIR)
+
+    def log_files(self, test, node):
+        return LOG_FILES
+
+
+def percona_test(**opts) -> dict:
+    """The bank workload (percona.clj:233-331) in local mode against
+    casd's bank endpoints."""
+    return service_test(
+        "percona",
+        BankClient(opts.get("client_timeout", 0.5),
+                   opts.get("accounts", 5), opts.get("balance", 10)),
+        bank_workload(opts), **opts)

@@ -1,0 +1,48 @@
+"""Postgres-RDS suite: single-endpoint bank comparison test.
+
+Mirrors the reference suite
+(postgres-rds/src/jepsen/postgres_rds.clj): there is deliberately NO
+node automation — the database is a managed RDS endpoint, so the test
+map has an empty node list (basic-test, 262-267) and the client carries
+the endpoint address. The bank client reads all balances and moves
+amounts between two accounts with an optional row-lock mode and
+in-place updates (BankClient, 136-201); the checker is the balance-sum
+invariant (bank-checker, 235-259). Here the same workload drives casd's
+bank endpoints: ``postgres_rds_test`` is the single-node comparison
+run (no nemesis by default — faults on a managed single instance are
+outside the harness's reach, exactly the reference's framing), with
+``endpoint`` standing in for the RDS address when given.
+"""
+from __future__ import annotations
+
+from ..testing import noop_test
+from .cockroachdb import BankClient, bank_workload
+from .local_common import service_test
+
+
+def endpoint_test(endpoint: str, **opts) -> dict:
+    """A test map aimed at a managed endpoint: empty node list, no
+    OS/DB automation (postgres_rds.clj:262-267's basic-test), client
+    routed at the endpoint."""
+    client = BankClient(opts.get("client_timeout", 1.0),
+                        opts.get("accounts", 5), opts.get("balance", 10))
+    test = noop_test(
+        name="postgres-rds",
+        nodes=[],
+        concurrency=opts.get("concurrency", 4),
+        client=client,
+        client_urls={None: endpoint},
+        **bank_workload(opts))
+    test.update(opts)
+    return test
+
+
+def postgres_rds_test(**opts) -> dict:
+    """The local comparison run: the bank workload against one casd
+    instance, single node, no nemesis (the managed-service framing)."""
+    opts.setdefault("n_nodes", 1)
+    return service_test(
+        "postgres-rds",
+        BankClient(opts.get("client_timeout", 0.5),
+                   opts.get("accounts", 5), opts.get("balance", 10)),
+        bank_workload(opts), **opts)

@@ -84,7 +84,8 @@ class RabbitDB(DB):
         with c.su():
             cu.meh(c.exec_, "killall", "-9", "beam.smp", "epmd")
             c.exec_("rm", "-rf", MNESIA_DIR)
-            c.exec_("service", "rabbitmq-server", "stop")
+            # No service on a fresh node (teardown runs first).
+            cu.meh(c.exec_, "service", "rabbitmq-server", "stop")
 
     def log_files(self, test, node):
         return [RABBIT_LOG]

@@ -11,6 +11,7 @@ local mode.
 """
 from __future__ import annotations
 
+import tempfile
 from pathlib import Path
 
 from ..control import core as c
@@ -66,9 +67,24 @@ class RobustIrcDB(DB):
                 c.upload(self.cert, "/tmp/cert.pem")
                 c.upload(self.key, "/tmp/key.pem")
             else:
-                c.exec_("openssl", "req", "-x509", "-newkey", "rsa:2048",
-                        "-keyout", "/tmp/key.pem", "-out", "/tmp/cert.pem",
-                        "-days", "365", "-nodes", "-subj", f"/CN={node}")
+                # One shared pair for the whole network (every node's
+                # -tls_ca_file must validate every other node): the
+                # primary generates it, the control host relays it to
+                # the rest. Per-node certs would break raft joins.
+                pair = test.setdefault("_robustirc_tls", {})
+                if node == primary(test):
+                    c.exec_("openssl", "req", "-x509", "-newkey",
+                            "rsa:2048", "-keyout", "/tmp/key.pem",
+                            "-out", "/tmp/cert.pem", "-days", "365",
+                            "-nodes", "-subj", f"/CN={NETWORK}")
+                    tmp = tempfile.mkdtemp(prefix="jepsen-robustirc-")
+                    for f in ("cert.pem", "key.pem"):
+                        c.download(f"/tmp/{f}", f"{tmp}/{f}")
+                        pair[f] = f"{tmp}/{f}"
+                synchronize(test)
+                if node != primary(test):
+                    for f in ("cert.pem", "key.pem"):
+                        c.upload(pair[f], f"/tmp/{f}")
             c.exec_("rm", "-rf", DATA_DIR)
             c.exec_("mkdir", "-p", DATA_DIR)
             synchronize(test)

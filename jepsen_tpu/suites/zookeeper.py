@@ -65,10 +65,14 @@ class ZookeeperDB(DB):
             c.exec_("service", "zookeeper", "restart")
 
     def teardown(self, test, node):
+        from ..control import util as cu
         with c.su():
-            c.exec_("service", "zookeeper", "stop")
-            c.exec_("rm", "-rf", lit("/var/lib/zookeeper/version-*"),
-                    lit("/var/log/zookeeper/*"))
+            # Fresh nodes pass through teardown first (db.cycle): no
+            # service to stop is routine, not an error.
+            cu.meh(c.exec_, "service", "zookeeper", "stop")
+            cu.meh(c.exec_, "rm", "-rf",
+                   lit("/var/lib/zookeeper/version-*"),
+                   lit("/var/log/zookeeper/*"))
 
     def log_files(self, test, node):
         return [LOG_FILE]

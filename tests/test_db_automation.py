@@ -352,6 +352,156 @@ def test_rabbitmq_db_follower_joins_cluster():
     assert any("set_policy" in x for x in prim)
 
 
+# ---------------------------------------------------------------- galera
+
+def test_galera_db_primary_bootstraps_new_cluster():
+    """debconf preseed, install + stock-dir squirrel, wsrep config over
+    all nodes, --wsrep-new-cluster on the primary only, jepsen db +
+    grant (galera.clj:34-131)."""
+    from jepsen_tpu.suites.galera import GaleraDB
+
+    test = {"nodes": ["n1", "n2"]}
+    cmds = stream(GaleraDB(), test, "n1")
+    first(cmds, "debconf-set-selections")
+    i_install = first(cmds, "apt-get install -y mariadb-galera-server")
+    i_stock = first(cmds, "cp -rp /var/lib/mysql /var/lib/mysql-stock")
+    i_cnf = first(cmds, "/etc/mysql/conf.d/jepsen.cnf")
+    assert "wsrep_cluster_address=gcomm://n1,n2" in cmds[i_cnf]
+    i_boot = first(cmds, "service mysql start --wsrep-new-cluster")
+    i_grant = first(cmds, "GRANT ALL PRIVILEGES ON jepsen.*")
+    assert i_install < i_stock < i_cnf < i_boot < i_grant
+    # Teardown probes for the stock copy before restoring; on this
+    # "fresh" node (stat fails) it must skip the restore rather than
+    # die — db.cycle runs teardown first.
+    assert any("stat /var/lib/mysql-stock" in x
+               for x in cmds[i_grant:])
+    assert not any("cp -rp /var/lib/mysql-stock /var/lib/mysql" in x
+                   for x in cmds[i_grant:])
+
+    foll = stream(GaleraDB(), test, "n2", teardown=False)
+    assert not any("--wsrep-new-cluster" in x for x in foll)
+    assert any(x.rstrip('"').endswith("service mysql start")
+               for x in foll), foll
+
+
+# --------------------------------------------------------------- percona
+
+def test_percona_db_gcomm_address_split():
+    """The primary bootstraps an EMPTY gcomm:// while joiners list all
+    nodes; bootstrap-pxc vs plain start (percona.clj:73-138)."""
+    from jepsen_tpu.suites.percona import PerconaDB
+
+    test = {"nodes": ["n1", "n2"]}
+    prim = stream(PerconaDB(), test, "n1", teardown=False)
+    first(prim, "/etc/apt/preferences.d/00percona.pref")
+    i_cnf = first(prim, "wsrep_cluster_address")
+    assert "wsrep_cluster_address=gcomm://" in prim[i_cnf]
+    assert "gcomm://n1,n2" not in prim[i_cnf]
+    first(prim, "service mysql start bootstrap-pxc")
+    first(prim, "percona-xtradb-cluster-56=5.6.25-25.12-1.jessie")
+
+    foll = stream(PerconaDB(), test, "n2", teardown=False)
+    i_cnf = first(foll, "wsrep_cluster_address")
+    assert "gcomm://n1,n2" in foll[i_cnf]
+    assert not any("bootstrap-pxc" in x for x in foll)
+
+
+# --------------------------------------------------------- mysql-cluster
+
+def test_mysql_cluster_db_roles_and_staged_startup():
+    """Role node-ids by offset, shared config.ini with every role,
+    mgmd -> ndbd -> mysqld startup order (mysql_cluster.clj:53-203)."""
+    from jepsen_tpu.suites.mysql_cluster import MySQLClusterDB
+
+    test = {"nodes": ["n1", "n2"]}
+    cmds = stream(MySQLClusterDB(version="7.4.6"), test, "n2")
+    i_cnf = first(cmds, "/etc/my.cnf")
+    assert "ndb-nodeid=22" in cmds[i_cnf]           # 21 + index 1
+    assert "ndb-connectstring=n1,n2" in cmds[i_cnf]
+    i_ini = first(cmds, "/etc/my.config.ini")
+    for frag in ("NodeId=1", "NodeId=11", "NodeId=21",
+                 "NodeId=2", "NodeId=12", "NodeId=22"):
+        assert frag in cmds[i_ini], cmds[i_ini]
+    i_mgmd = first(cmds, "ndb_mgmd --ndb-nodeid=2")
+    i_ndbd = first(cmds, "ndbd --ndb-nodeid=12")
+    i_sql = first(cmds, "mysqld_safe --defaults-file=/etc/my.cnf")
+    assert i_mgmd < i_ndbd < i_sql
+    assert "sudo -S -u mysql" in cmds[i_sql]
+    assert any("rm -rf /var/lib/mysql/cluster/*" in x for x in cmds)
+
+
+# ------------------------------------------------------- mesos + chronos
+
+def test_mesos_db_master_slave_roles():
+    """First MASTER_COUNT sorted nodes run mesos-master with the zk URI
+    + majority quorum; the rest run mesos-slave; zookeeper underneath
+    (mesosphere.clj:26-150)."""
+    from jepsen_tpu.suites.mesosphere import MesosDB
+
+    test = {"nodes": ["n1", "n2", "n3", "n4"]}
+    master = stream(MesosDB(), test, "n1", teardown=False)
+    first(master, "apt-get install -y mesos=0.23.0-1.0.debian81")
+    i_zk = first(master, "/etc/mesos/zk")
+    assert "zk://n1:2181,n2:2181,n3:2181,n4:2181/mesos" in master[i_zk]
+    i_start = first(master, "/usr/sbin/mesos-master")
+    assert "--quorum=2" in master[i_start]
+    assert any("zoo.cfg" in x for x in master)       # zk ensemble too
+
+    slave = stream(MesosDB(), test, "n4")
+    i_start = first(slave, "mesos-slave")
+    assert "--master=zk://" in slave[i_start]
+    assert not any("--quorum" in x for x in slave)
+    assert any("killall -9 mesos-slave" in x for x in slave)
+
+
+def test_chronos_db_composes_mesos():
+    """Chronos rides MesosDB: pinned install, schedule-horizon lowered,
+    job dir, service start (chronos.clj:40-83)."""
+    from jepsen_tpu.suites.chronos import ChronosDB
+
+    test = {"nodes": ["n1"]}
+    cmds = stream(ChronosDB(), test, "n1")
+    i_mesos = first(cmds, "mesos=")
+    i_chronos = first(cmds, "chronos=2.3.4-1.0.81.debian77")
+    i_horizon = first(cmds, "/etc/chronos/conf/schedule_horizon")
+    i_start = first(cmds, "service chronos start")
+    assert i_mesos < i_chronos < i_horizon < i_start
+    assert any("service chronos stop" in x for x in cmds)
+    assert any("rm -rf /tmp/chronos-test/" in x for x in cmds)
+
+
+# ------------------------------------------------------- cockroach auto
+
+def test_cockroach_auto_command_stream():
+    """Tarball install under the cockroach user, bumptime build, env-
+    wrapped start-stop-daemon with --insecure, --join on non-primaries
+    only (cockroach/auto.clj:142-217)."""
+    from jepsen_tpu.suites.cockroachdb import CockroachAuto
+
+    test = {"nodes": ["n1", "n2"],
+            "tarball": "https://example.com/cockroach.tgz",
+            "linearizable": True}
+    prim = stream(CockroachAuto(), test, "n1", teardown=False,
+                  resp=responder(archive_root="cockroach-latest"))
+    first(prim, "adduser --disabled-password")
+    first(prim, "mv cockroach-latest /opt/cockroach")
+    assert any("gcc" in x and "bump-time" in x for x in prim), \
+        "clock tools not installed"
+    i_start = first(prim, "start-stop-daemon --start")
+    assert "env COCKROACH_LINEARIZABLE=true" in prim[i_start]
+    assert "COCKROACH_MAX_OFFSET=250ms" in prim[i_start]
+    assert "--chuid cockroach" in prim[i_start]
+    assert "start --insecure" in prim[i_start]
+    assert "--join=" not in prim[i_start]
+
+    foll = stream(CockroachAuto(), test, "n2",
+                  resp=responder(archive_root="cockroach-latest"))
+    i_start = first(foll, "start-stop-daemon --start")
+    assert "--join=n1" in foll[i_start]
+    assert any("killall -9 cockroach" in x for x in foll)
+    assert any("rm -rf /opt/cockroach/cockroach-data" in x for x in foll)
+
+
 # ------------------------------------------------- suites are registered
 
 def test_new_suites_registered_in_cli():
@@ -359,6 +509,18 @@ def test_new_suites_registered_in_cli():
 
     reg = suite_registry()
     for name in ("zookeeper", "logcabin", "rethinkdb", "mongodb",
-                 "crate", "disque", "robustirc"):
+                 "crate", "disque", "robustirc", "galera", "percona",
+                 "mysql-cluster", "postgres-rds"):
         assert name in SUITE_NAMES
         assert name in reg
+
+
+def test_postgres_rds_endpoint_test_has_no_nodes():
+    """The RDS suite deliberately automates nothing: empty node list,
+    client aimed at the endpoint (postgres_rds.clj:262-267)."""
+    from jepsen_tpu.suites.postgres_rds import endpoint_test
+
+    t = endpoint_test("http://db.example.com:5432")
+    assert t["nodes"] == []
+    assert t["client_urls"] == {None: "http://db.example.com:5432"}
+    assert t["checker"] is not None
