@@ -8,13 +8,21 @@ histories/sec with Knossos-parity verdicts. This bench measures the
 of that workload shape on whatever accelerator is attached (one chip
 here; the batch axis scales linearly over a mesh — jepsen_tpu.parallel).
 
+Parity is FULL, not sampled: every row's valid? verdict and every
+invalid row's first-bad-op index are compared against the native C++
+engine, and every invalid device row with W <= 16 gets a config-set
+comparison against the exact host oracle (BASELINE.md:
+"valid?/counterexample parity").
+
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
 Env knobs: JT_BENCH_B (histories, default 10000), JT_BENCH_OPS (op pairs
 per history, default 500 → 1k history lines), JT_BENCH_REPEATS,
 JT_BENCH_MIN_DEVICE_BATCH (smaller cost-class buckets go to the native
-CPU engine instead of paying an XLA compile).
+CPU engine instead of paying an XLA compile), JT_BENCH_STORE_B (runs in
+the store→recheck figure), JT_BENCH_FULL_PARITY=0 (fall back to sampled
+parity for quick local runs).
 """
 import json
 import os
@@ -26,6 +34,7 @@ def main():
     n_ops = int(os.environ.get("JT_BENCH_OPS", "500"))
     repeats = int(os.environ.get("JT_BENCH_REPEATS", "3"))
     min_dev = int(os.environ.get("JT_BENCH_MIN_DEVICE_BATCH", "32"))
+    full_parity = os.environ.get("JT_BENCH_FULL_PARITY", "1") != "0"
     baseline_rate = 10_000 / 60.0  # north-star target, histories/sec
 
     import jax
@@ -37,7 +46,9 @@ def main():
     from jepsen_tpu.history.columnar import columnar_to_ops
     from jepsen_tpu.models.core import cas_register
     from jepsen_tpu.ops.encode import encode_columnar
-    from jepsen_tpu.ops.linearize import run_buckets_threaded
+    from jepsen_tpu.ops.linearize import (DATA_MAX_SLOTS,
+                                          device_frontier_capacity,
+                                          run_buckets_threaded)
     from jepsen_tpu.ops.statespace import enumerate_statespace
     from jepsen_tpu.workloads.synth import synth_cas_columnar
 
@@ -48,9 +59,15 @@ def main():
                               n_values=5, corrupt=0.1, p_info=0.01)
     t_synth = time.time() - t0
 
+    # Window headroom: the device wide path (data1wide / frontier mesh)
+    # covers W up to 16 + capacity, so those rows never pay the
+    # pure-Python fallback (the -Xmx32g analog, linearize.py:335-388).
+    eff_slots = DATA_MAX_SLOTS + device_frontier_capacity()
+
     def encode():
         space = enumerate_statespace(model, cols.kinds, 64)
-        buckets, failures = encode_columnar(space, cols, max_slots=16)
+        buckets, failures = encode_columnar(space, cols,
+                                            max_slots=eff_slots)
         return buckets, failures
 
     t0 = time.time()
@@ -66,11 +83,17 @@ def main():
     def route(bkts, fails):
         """Tail cost classes below the threshold go to the native CPU
         engine (a handful of info-heavy rows isn't worth an XLA
-        compile), as do encoder-overflow rows."""
+        compile) — EXCEPT wide windows (W > 16), which are exactly the
+        rows a CPU engine handles worst and the device wide path
+        exists for. Encoder-overflow rows (beyond even the wide path)
+        go to the CPU engines."""
         if check_batch_native is None:
             return bkts, [i for i, _ in fails]
-        dev = [b for b in bkts if b.batch >= min_dev]
-        cpu = [i for b in bkts if b.batch < min_dev for i in b.indices]
+        dev = [b for b in bkts
+               if b.batch >= min_dev or b.W > DATA_MAX_SLOTS]
+        cpu = [i for b in bkts
+               if b.batch < min_dev and b.W <= DATA_MAX_SLOTS
+               for i in b.indices]
         return dev, cpu + [i for i, _ in fails]
 
     dev_buckets, cpu_rows = route(buckets, failures)
@@ -114,84 +137,97 @@ def main():
     t_e2e = t_encode + t_dev
     rate = n_checked / t_e2e
 
-    # Verdict-parity spot check vs the exact host engine.
-    sample = list(range(0, B, max(1, B // 24)))[:24]
-    host = {r: wgl_check(model, columnar_to_ops(cols, r))["valid"] is True
-            for r in sample}
+    # Device verdicts/bad-indices by row (parity + converted compare).
     dev_valid = np.ones(B, bool)
-    for b, (v, _, _) in zip(dev_buckets, outs):
-        dev_valid[np.asarray(b.indices)] = v
-    # cpu-routed rows are covered by the native engine's own oracle tests
-    skip = set(cpu_rows)
-    parity_ok = all(dev_valid[r] == host[r] for r in sample if r not in skip)
+    dev_bad = np.full(B, -1, np.int64)
+    for b, (v, bd, _) in zip(dev_buckets, outs):
+        idx = np.asarray(b.indices)
+        dev_valid[idx] = v
+        iv = idx[~np.asarray(v)]
+        dev_bad[iv] = b.ev_opidx[np.nonzero(~np.asarray(v))[0],
+                                 np.asarray(bd)[~np.asarray(v)]]
+    skip = set(cpu_rows)                     # rows the device never saw
+    row_w = np.zeros(B, np.int32)
+    for b in dev_buckets:
+        row_w[np.asarray(b.indices)] = b.W
 
-    # Native-CPU comparison point + first-bad-op-index parity vs the
-    # native engine on >= 500 rows (BASELINE.md: counterexample parity,
-    # not just valid?).
+    # All-rows Op-list reconstruction — shared setup for parity, the
+    # converted figure, and the store figure (stands in for histories
+    # the runtime recorded).
+    conv_hists = [columnar_to_ops(cols, r) for r in range(B)]
+
+    # ------------------------------------------------- parity (FULL)
+    # Every row vs the native engine (valid? + first-bad-op index);
+    # every invalid device row with W <= DATA_MAX_SLOTS vs the exact
+    # host oracle's config set at the counterexample.
     native_rate = None
-    parity_bad_index = None
-    if check_batch_native is not None:
-        n_par = min(int(os.environ.get("JT_BENCH_PARITY_ROWS", "500")), B)
-        rows = [r for r in range(0, B, max(1, B // n_par))][:n_par]
-        sub = [columnar_to_ops(cols, r) for r in rows]
-        check_batch_native(model, sub[:4])     # warm caches
+    parity_valid = parity_bad_index = parity_configs = None
+    n_config_rows = 0
+    if check_batch_native is not None and full_parity:
         t0 = time.time()
-        nrs = check_batch_native(model, sub)
-        native_rate = round(len(sub) / (time.time() - t0), 2)
-        dev_bad = np.full(B, -1, np.int64)
-        for b, (v, bd, _) in zip(dev_buckets, outs):
-            iv = np.asarray(b.indices)[~v]
-            dev_bad[iv] = b.ev_opidx[np.nonzero(~v)[0], bd[~v]]
+        nrs = check_batch_native(model, conv_hists)
+        native_rate = round(B / (time.time() - t0), 2)
+        dev_rows = [r for r in range(B) if r not in skip]
+        parity_valid = all(
+            (nrs[r]["valid"] is True) == bool(dev_valid[r])
+            for r in dev_rows)
         parity_bad_index = all(
-            (nr["valid"] is True and r not in skip and dev_valid[r]) or
-            (nr["valid"] is False and not dev_valid[r]
-             and nr["op"]["index"] == dev_bad[r]) or r in skip
-            for r, nr in zip(rows, nrs))
+            nrs[r]["valid"] is False
+            and nrs[r]["op"]["index"] == dev_bad[r]
+            for r in dev_rows if not dev_valid[r])
 
-    # Config-sample parity vs the exact host engine on invalid rows.
-    # Smallest windows first: the host oracle's closure cost is 2^W.
-    inv_rows = [i for b, (v, _, _) in sorted(zip(dev_buckets, outs),
-                                             key=lambda t: t[0].W)
-                if b.W <= 7
-                for i in np.asarray(b.indices)[~v].tolist()][:50]
-    parity_configs = None
-    if inv_rows:
         from jepsen_tpu.ops.linearize import check_batch_columnar
-        inv_hists = [columnar_to_ops(cols, r) for r in inv_rows]
-        drs = check_batch_columnar(model, inv_hists)
-        parity_configs = all(
-            dr["valid"] is False and hr["valid"] is False
-            and dr["op"]["index"] == hr["op"]["index"]
-            and dr["configs"] == hr["configs"]
-            for dr, hr in zip(drs, (wgl_check(model, h)
-                                    for h in inv_hists)))
+        inv_rows = [r for r in dev_rows
+                    if not dev_valid[r] and row_w[r] <= DATA_MAX_SLOTS]
+        n_config_rows = len(inv_rows)
+        if inv_rows:
+            drs = check_batch_columnar(model,
+                                       [conv_hists[r] for r in inv_rows])
+            parity_configs = all(
+                dr["valid"] is False and hr["valid"] is False
+                and dr["op"]["index"] == hr["op"]["index"]
+                and dr["configs"] == hr["configs"]
+                for dr, hr in zip(drs, (wgl_check(model, conv_hists[r])
+                                        for r in inv_rows)))
+    elif check_batch_native is not None:
+        # Quick mode: sampled valid? parity only.
+        sample = list(range(0, B, max(1, B // 24)))[:24]
+        nrs = check_batch_native(model, [conv_hists[r] for r in sample])
+        parity_valid = all(
+            (nr["valid"] is True) == bool(dev_valid[r])
+            for r, nr in zip(sample, nrs) if r not in skip)
 
     # Converted-history extra: recorded Op-list histories ride the fast
-    # path end-to-end (native ingest walk + vectorized encode + device).
-    # Reconstruction to Op lists is setup (they stand in for histories
-    # the runtime recorded); conversion onward is the timed path.
+    # path end-to-end (native ingest walk + vectorized encode + device,
+    # CPU tail overlapped with device work exactly like the main run).
     from jepsen_tpu.history.columnar import ops_to_columnar
-    # Full-batch default: the converted batch re-encodes to the exact
-    # bucket shapes the headline run compiled, so no extra XLA compiles.
     C = min(int(os.environ.get("JT_BENCH_CONVERTED", str(B))), B)
-    conv_hists = [columnar_to_ops(cols, r) for r in range(C)]
     ops_to_columnar(model, conv_hists[:2])       # warm the native build
 
     def run_converted():
-        ccols = ops_to_columnar(model, conv_hists)
+        from concurrent.futures import ThreadPoolExecutor
+
+        ccols = ops_to_columnar(model, conv_hists[:C])
         space_c = enumerate_statespace(model, ccols.kinds, 64)
-        cbuckets, cfails = encode_columnar(space_c, ccols, max_slots=16)
+        cbuckets, cfails = encode_columnar(space_c, ccols,
+                                           max_slots=eff_slots)
         cdev, ccpu = route(cbuckets, cfails)
         cvalid = np.ones(C, bool)
-        for b, out in run_buckets_threaded(cdev):
-            v, _, _ = out
-            cvalid[np.asarray(b.indices)] = v
-        if ccpu:
-            rs = (check_batch_native(model,
-                                     [conv_hists[i] for i in ccpu])
-                  if check_batch_native is not None else
-                  [wgl_check(model, conv_hists[i]) for i in ccpu])
-            for i, r in zip(ccpu, rs):
+
+        def cpu_part():
+            if not ccpu:
+                return []
+            hs = [conv_hists[i] for i in ccpu]
+            return (check_batch_native(model, hs)
+                    if check_batch_native is not None
+                    else [wgl_check(model, h) for h in hs])
+
+        with ThreadPoolExecutor(1) as ex:
+            tail = ex.submit(cpu_part)
+            for b, out in run_buckets_threaded(cdev):
+                v, _, _ = out
+                cvalid[np.asarray(b.indices)] = v
+            for i, r in zip(ccpu, tail.result()):
                 cvalid[i] = r["valid"] is True
         return cvalid
 
@@ -207,6 +243,31 @@ def main():
     cmp_rows = np.array([r for r in range(C) if r not in skip], int)
     converted_match = bool(
         (cvalid[cmp_rows] == dev_valid[cmp_rows]).all())
+
+    # Store→recheck extra: the actual replay product scenario — save
+    # runs to disk, load them back, re-check the batch on device
+    # (store.clj:165-171's seam; Store.recheck).
+    import tempfile
+
+    from jepsen_tpu.store import Store
+    SB = min(int(os.environ.get("JT_BENCH_STORE_B", "500")), B)
+    store_rate = None
+    if SB:
+        with tempfile.TemporaryDirectory() as td:
+            store = Store(base=td)
+            for i in range(SB):
+                h = store.create("bench-recheck", ts=f"r{i:05d}")
+                h.save_history(conv_hists[i])
+            store.recheck("bench-recheck", model)    # warm compiles
+            t0 = time.time()
+            rr = store.recheck("bench-recheck", model)
+            t_store = time.time() - t0
+            store_rate = round(SB / t_store, 2)
+            want = [bool(dev_valid[i]) for i in range(SB)
+                    if i not in skip]
+            got = [rr["runs"][f"r{i:05d}"]["valid"] is True
+                   for i in range(SB) if i not in skip]
+            assert got == want, "store recheck verdict mismatch"
 
     # O(n) fold-checker extra: batch total-queue accounting on device
     # (jepsen_tpu.ops.folds) — the reference's single-pass reducers
@@ -246,17 +307,23 @@ def main():
         "histories": n_checked,
         "ops_per_history": n_ops * 2,
         "invalid_found": n_invalid,
-        "parity_sample_ok": parity_ok,
-        "parity": {"valid": parity_ok, "bad_index": parity_bad_index,
+        "parity": {"full": bool(full_parity and check_batch_native),
+                   "rows": B if full_parity else 24,
+                   "valid": parity_valid,
+                   "bad_index": parity_bad_index,
                    "configs": parity_configs,
-                   "config_rows": len(inv_rows)},
+                   "config_rows": n_config_rows},
+        "parity_sample_ok": parity_valid,        # legacy field name
         "host_fallbacks": len(failures),
+        "cpu_routed_rows": len(cpu_rows),
         "buckets": [[b.V, b.W, b.batch] for b in buckets],
         "device": str(jax.devices()[0]),
         "native_cpu_rate": native_rate,
         "converted_e2e_rate": round(converted_rate, 2),
         "converted_histories": C,
         "converted_verdict_match": converted_match,
+        "store_recheck_rate": store_rate,
+        "store_recheck_runs": SB,
         "fold_total_queue_rate": round(fold_rate, 2),
         "fold_histories": FB,
         "fold_invalid": fold_invalid,

@@ -161,17 +161,60 @@ def _sample_configs(configs, n: int = 10):
 class LinearizableChecker(Checker):
     """Validates linearizability. ``backend`` picks the engine; "tpu"
     checks on device when the history fits the kernel's static bounds
-    and falls back to the host engine otherwise."""
+    and falls back to the host engine otherwise; "competition" races
+    the native CPU engine against the device path and returns whichever
+    finishes first — the knossos :competition analog (the reference
+    exposes competition/linear/wgl at checker.clj:90-94; here every
+    engine runs the same WGL algorithm, so the race is across
+    hardware, not algorithms)."""
 
     def __init__(self, backend: str = "host", **kw):
-        assert backend in ("host", "native", "tpu")
+        assert backend in ("host", "native", "tpu", "competition")
         # Fail fast at construction if the backend isn't available.
-        if backend == "native":
+        if backend in ("native", "competition"):
             from ..native import wgl_check_native  # noqa: F401
-        elif backend == "tpu":
+        if backend in ("tpu", "competition"):
             from ..ops.linearize import check_one_tpu  # noqa: F401
         self.backend = backend
         self.kw = kw
+
+    def _compete(self, model, history) -> dict:
+        """First engine to finish wins (knossos.competition semantics).
+        The loser's thread is left to run out — neither engine can be
+        interrupted mid-search, and both are daemon-safe. Each racer
+        only receives the kwargs its engine understands — the two
+        signatures are disjoint, and a TypeError would silently knock
+        one racer out of every race."""
+        import concurrent.futures as cf
+        import inspect
+
+        from ..native import wgl_check_native
+        from ..ops.linearize import check_one_tpu
+
+        def accepted(fn):
+            params = inspect.signature(fn).parameters
+            return {k: v for k, v in self.kw.items() if k in params}
+
+        ex = cf.ThreadPoolExecutor(2)
+        futs = [ex.submit(wgl_check_native, model, list(history),
+                          **accepted(wgl_check_native)),
+                ex.submit(check_one_tpu, model, list(history),
+                          **accepted(check_one_tpu))]
+        try:
+            done, _ = cf.wait(futs, return_when=cf.FIRST_COMPLETED)
+            errs = []
+            for f in done:
+                if f.exception() is None:
+                    return f.result()
+                errs.append(f.exception())
+            # The first finisher crashed: fall through to the other.
+            done, _ = cf.wait(futs)
+            for f in done:
+                if f.exception() is None:
+                    return f.result()
+            raise errs[0]
+        finally:
+            ex.shutdown(wait=False)
 
     def check(self, test, model, history, opts=None) -> dict:
         if self.backend == "host":
@@ -182,6 +225,8 @@ class LinearizableChecker(Checker):
         if self.backend == "tpu":
             from ..ops.linearize import check_one_tpu
             return check_one_tpu(model, history, **self.kw)
+        if self.backend == "competition":
+            return self._compete(model, history)
         raise AssertionError
 
 

@@ -1,0 +1,108 @@
+/* strobe-time-experiment: measure how fast and how precisely this
+ * node's wall clock can actually be strobed.
+ *
+ * The production tool (strobe-time.c) flips the wall clock between
+ * normal and +delta offsets on a fixed cadence and trusts the kernel
+ * to keep up. This experimental variant (the analog of the reference's
+ * jepsen/resources/strobe-time-experiment.c, 205 LoC) instruments the
+ * same loop: it records, per flip, how far the achieved flip time
+ * drifted from the ideal cadence, and reports flip count plus
+ * min/mean/max inter-flip latency in nanoseconds. Use it to calibrate
+ * a believable --period for strobe-time on a given box before leaning
+ * on sub-millisecond skew schedules.
+ *
+ * Like the sibling tools this is a genuine rewrite on clock_gettime /
+ * clock_settime (the reference pair uses gettimeofday math): flips are
+ * anchored to CLOCK_MONOTONIC so wall-clock jumps the tool itself
+ * makes never distort its own schedule.
+ *
+ * usage: strobe-time-experiment <delta-ms> <period-ms> <duration-s>
+ * output: "<flips> <min-ns> <mean-ns> <max-ns>"
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+static const int64_t NS = 1000000000LL;
+
+static int64_t now_ns(clockid_t clk) {
+  struct timespec t;
+  if (clock_gettime(clk, &t) != 0) {
+    perror("clock_gettime");
+    exit(2);
+  }
+  return (int64_t)t.tv_sec * NS + t.tv_nsec;
+}
+
+static void set_wall_ns(int64_t ns) {
+  struct timespec t;
+  t.tv_sec = ns / NS;
+  t.tv_nsec = ns % NS;
+  if (t.tv_nsec < 0) {               /* keep tv_nsec in [0, NS) */
+    t.tv_nsec += NS;
+    t.tv_sec -= 1;
+  }
+  if (clock_settime(CLOCK_REALTIME, &t) != 0) {
+    perror("clock_settime");
+    exit(3);
+  }
+}
+
+static void sleep_until_mono(int64_t target) {
+  struct timespec t;
+  t.tv_sec = target / NS;
+  t.tv_nsec = target % NS;
+  while (clock_nanosleep(CLOCK_MONOTONIC, TIMER_ABSTIME, &t, NULL) != 0)
+    ;                                /* retry on EINTR */
+}
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr,
+            "usage: %s <delta-ms> <period-ms> <duration-s>\n"
+            "Strobes the wall clock like strobe-time, but reports the\n"
+            "achieved flip count and min/mean/max inter-flip latency\n"
+            "(ns) instead of trusting the requested cadence.\n",
+            argv[0]);
+    return 2;
+  }
+  int64_t delta_ns = (int64_t)(atof(argv[1]) * 1e6);
+  int64_t period_ns = (int64_t)(atof(argv[2]) * 1e6);
+  int64_t duration_ns = (int64_t)(atof(argv[3]) * 1e9);
+  if (period_ns <= 0 || duration_ns <= 0) {
+    fprintf(stderr, "period and duration must be positive\n");
+    return 2;
+  }
+
+  /* Wall = mono + offset; flip the offset, never the measured base. */
+  int64_t normal_off = now_ns(CLOCK_REALTIME) - now_ns(CLOCK_MONOTONIC);
+  int64_t start = now_ns(CLOCK_MONOTONIC);
+  int64_t end = start + duration_ns;
+
+  int64_t flips = 0, weird = 0;
+  int64_t lat_min = INT64_MAX, lat_max = 0, lat_sum = 0, last = start;
+
+  for (int64_t next = start; next < end; next += period_ns) {
+    sleep_until_mono(next);
+    int64_t mono = now_ns(CLOCK_MONOTONIC);
+    weird = !weird;
+    set_wall_ns(mono + normal_off + (weird ? delta_ns : 0));
+    if (flips > 0) {
+      int64_t lat = mono - last;
+      if (lat < lat_min) lat_min = lat;
+      if (lat > lat_max) lat_max = lat;
+      lat_sum += lat;
+    }
+    last = mono;
+    flips++;
+  }
+
+  /* Restore the normal offset before reporting. */
+  set_wall_ns(now_ns(CLOCK_MONOTONIC) + normal_off);
+  printf("%lld %lld %lld %lld\n", (long long)flips,
+         (long long)(flips > 1 ? lat_min : 0),
+         (long long)(flips > 1 ? lat_sum / (flips - 1) : 0),
+         (long long)lat_max);
+  return 0;
+}

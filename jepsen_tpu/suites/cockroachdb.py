@@ -222,15 +222,22 @@ def bank_workload(opts: dict) -> dict:
     }
 
 
+def bank_service_test(name: str, daemon_args=(), **opts) -> dict:
+    """A local-mode bank-family test (shared by the galera / percona /
+    mysql-cluster / postgres-rds suites, which all run this workload
+    family against their own DB automation)."""
+    return service_test(
+        name,
+        BankClient(opts.get("client_timeout", 0.5),
+                   opts.get("accounts", 5), opts.get("balance", 10)),
+        bank_workload(opts), daemon_args=daemon_args, **opts)
+
+
 def bank_test(split_ms: int = 0, **opts) -> dict:
     """The local bank test; ``split_ms > 0`` seeds the non-atomic
     transfer race the checker must catch."""
     daemon_args = (["--bank-split-ms", str(split_ms)] if split_ms else [])
-    return service_test(
-        "cockroach-bank",
-        BankClient(opts.get("client_timeout", 0.5),
-                   opts.get("accounts", 5), opts.get("balance", 10)),
-        bank_workload(opts), daemon_args=daemon_args, **opts)
+    return bank_service_test("cockroach-bank", daemon_args, **opts)
 
 
 class TimestampClient(ServiceClient):

@@ -19,8 +19,6 @@ from ..control.core import lit
 from ..db import DB
 from ..os_impl import debian
 from ..runtime import primary, synchronize
-from .cockroachdb import BankClient, bank_workload
-from .local_common import service_test
 
 REPO_LINE = ("deb http://sfo1.mirrors.digitalocean.com/mariadb/repo/10.0/"
              "debian jessie main")
@@ -119,8 +117,5 @@ class GaleraDB(DB):
 def galera_test(**opts) -> dict:
     """The bank workload (galera.clj:240-339) in local mode against
     casd's bank endpoints."""
-    return service_test(
-        "galera",
-        BankClient(opts.get("client_timeout", 0.5),
-                   opts.get("accounts", 5), opts.get("balance", 10)),
-        bank_workload(opts), **opts)
+    from .cockroachdb import bank_service_test
+    return bank_service_test("galera", **opts)

@@ -18,8 +18,6 @@ from ..control.core import lit
 from ..db import DB
 from ..os_impl import debian
 from ..runtime import synchronize
-from .cockroachdb import BankClient, bank_workload
-from .local_common import service_test
 
 USER = "mysql"
 MGMD_DIR = "/var/lib/mysql/cluster"
@@ -148,8 +146,5 @@ class MySQLClusterDB(DB):
 
 def mysql_cluster_test(**opts) -> dict:
     """The bank workload in local mode against casd's bank endpoints."""
-    return service_test(
-        "mysql-cluster",
-        BankClient(opts.get("client_timeout", 0.5),
-                   opts.get("accounts", 5), opts.get("balance", 10)),
-        bank_workload(opts), **opts)
+    from .cockroachdb import bank_service_test
+    return bank_service_test("mysql-cluster", **opts)

@@ -17,9 +17,7 @@ from ..control.core import lit
 from ..db import DB
 from ..os_impl import debian
 from ..runtime import primary, synchronize
-from .cockroachdb import BankClient, bank_workload
 from .galera import DIR, STOCK_DIR, setup_db
-from .local_common import service_test
 
 REPO_LINE = "deb http://repo.percona.com/apt jessie main"
 KEYSERVER = "keys.gnupg.net"
@@ -119,8 +117,5 @@ class PerconaDB(DB):
 def percona_test(**opts) -> dict:
     """The bank workload (percona.clj:233-331) in local mode against
     casd's bank endpoints."""
-    return service_test(
-        "percona",
-        BankClient(opts.get("client_timeout", 0.5),
-                   opts.get("accounts", 5), opts.get("balance", 10)),
-        bank_workload(opts), **opts)
+    from .cockroachdb import bank_service_test
+    return bank_service_test("percona", **opts)

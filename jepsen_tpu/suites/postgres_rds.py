@@ -17,7 +17,6 @@ from __future__ import annotations
 
 from ..testing import noop_test
 from .cockroachdb import BankClient, bank_workload
-from .local_common import service_test
 
 
 def endpoint_test(endpoint: str, **opts) -> dict:
@@ -40,9 +39,6 @@ def endpoint_test(endpoint: str, **opts) -> dict:
 def postgres_rds_test(**opts) -> dict:
     """The local comparison run: the bank workload against one casd
     instance, single node, no nemesis (the managed-service framing)."""
+    from .cockroachdb import bank_service_test
     opts.setdefault("n_nodes", 1)
-    return service_test(
-        "postgres-rds",
-        BankClient(opts.get("client_timeout", 0.5),
-                   opts.get("accounts", 5), opts.get("balance", 10)),
-        bank_workload(opts), **opts)
+    return bank_service_test("postgres-rds", **opts)

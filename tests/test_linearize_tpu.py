@@ -253,3 +253,20 @@ def test_random_parity_sweep():
     # make sure the sweep exercises both verdicts
     verdicts = {r["valid"] for r in host}
     assert verdicts == {True, False}
+
+
+def test_competition_backend_matches_host():
+    """The knossos :competition analog (checker.clj:90-94): race the
+    native CPU engine against the device path; whichever wins must
+    agree with the host oracle, valid and invalid alike."""
+    from jepsen_tpu.checkers.linearizable import linearizable, wgl_check
+    from jepsen_tpu.workloads.synth import synth_cas_batch
+
+    chk = linearizable(backend="competition")
+    for h in synth_cas_batch(6, seed0=11, n_procs=3, n_ops=30,
+                             n_values=3, corrupt=0.4):
+        want = wgl_check(cas_register(), h)
+        got = chk.check({}, cas_register(), h)
+        assert got["valid"] is want["valid"]
+        if want["valid"] is False:
+            assert got["op"]["index"] == want["op"]["index"]

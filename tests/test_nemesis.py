@@ -186,7 +186,8 @@ def test_clock_nemesis_command_stream():
 def test_c_resources_compile(tmp_path):
     """The shipped C sources must compile cleanly with the local gcc."""
     res = Path("jepsen_tpu/resources")
-    for src in ["bump-time.c", "strobe-time.c"]:
+    for src in ["bump-time.c", "strobe-time.c",
+                "strobe-time-experiment.c"]:
         out = tmp_path / src.replace(".c", "")
         r = subprocess.run(["gcc", "-O2", "-Wall", "-o", str(out),
                             str(res / src)],
