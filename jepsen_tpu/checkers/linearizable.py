@@ -193,6 +193,9 @@ class LinearizableChecker(Checker):
 
         def accepted(fn):
             params = inspect.signature(fn).parameters
+            if any(p.kind is inspect.Parameter.VAR_KEYWORD
+                   for p in params.values()):
+                return dict(self.kw)     # **kw: everything passes through
             return {k: v for k, v in self.kw.items() if k in params}
 
         ex = cf.ThreadPoolExecutor(2)

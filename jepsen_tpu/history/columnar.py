@@ -167,6 +167,109 @@ def _walk_py(histories: Sequence[Sequence[Op]], vocab: dict,
             np.asarray(rowlen, np.int64))
 
 
+def _pack_walk(model, bufs_or_arrays, all_kinds: List[Tuple],
+               max_states: int) -> ColumnarOps:
+    """Shared post-pass over a walk's flat buffers: identity-drop and
+    padding into a ColumnarOps (the second half of ops_to_columnar)."""
+    from ..ops.statespace import enumerate_statespace
+
+    code, proc, kind, oidx, okflag, link, rowlen = bufs_or_arrays
+    space = enumerate_statespace(model, all_kinds, max_states)
+    identity = space.identity_kinds
+
+    drop = code == PAD
+    if identity:
+        # Never-ok total-identity invocations and their info lines.
+        ident_mask = np.zeros(len(all_kinds) + 1, bool)
+        ident_mask[list(identity)] = True
+        inv_ident = (code == C_INVOKE) & ident_mask[kind] & (okflag == 0)
+        drop |= inv_ident
+        linked = link >= 0
+        drop |= linked & inv_ident[np.where(linked, link, 0)]
+    keep = ~drop
+
+    B = len(rowlen)
+    rid = np.repeat(np.arange(B), rowlen)[keep]
+    counts = np.bincount(rid, minlength=B)
+    N = int(counts.max()) if B else 0
+    starts = np.zeros(B, np.int64)
+    np.cumsum(counts[:-1], out=starts[1:])
+    posin = np.arange(rid.size, dtype=np.int64) - starts[rid]
+
+    typ = np.full((B, max(N, 1)), PAD, np.int8)
+    procs = np.zeros((B, max(N, 1)), np.int16)
+    kinds_arr = np.full((B, max(N, 1)), -1, np.int32)
+    index = np.full((B, max(N, 1)), -1, np.int32)
+    typ[rid, posin] = code[keep]
+    procs[rid, posin] = proc[keep].astype(np.int16)
+    kinds_arr[rid, posin] = kind[keep]
+    index[rid, posin] = oidx[keep]
+    return ColumnarOps(type=typ, process=procs, kind=kinds_arr,
+                       kinds=all_kinds, index=index)
+
+
+def _from_bufs(bufs):
+    """Native walk byte buffers -> typed arrays (Py_BuildValue("y#")
+    yields None for an empty vector's nullptr)."""
+    return (np.frombuffer(bufs[0] or b"", np.int8),
+            np.frombuffer(bufs[1] or b"", np.int32),
+            np.frombuffer(bufs[2] or b"", np.int32).copy(),
+            np.frombuffer(bufs[3] or b"", np.int32),
+            np.frombuffer(bufs[4] or b"", np.int8),
+            np.frombuffer(bufs[5] or b"", np.int32),
+            np.frombuffer(bufs[6] or b"", np.int64))
+
+
+def _seed_vocab(kinds: Optional[List[Tuple]]):
+    vocab: dict = {}
+    all_kinds: List[Tuple] = []
+    for k in (kinds or []):
+        if k not in vocab:
+            vocab[k] = len(all_kinds)
+            all_kinds.append(k)
+    return vocab, all_kinds
+
+
+def jsonl_to_columnar(model, texts: Sequence, *,
+                      kinds: Optional[List[Tuple]] = None,
+                      max_states: int = 64,
+                      native: bool = True) -> ColumnarOps:
+    """Serialized histories (one history.jsonl content per entry,
+    str or bytes) straight onto the columnar fast path — the native
+    replay loader (store.clj:165-171 is the seam; the reference reads
+    its machine form through JVM-native fressian). The C scanner
+    (native/ingest.cpp walk_jsonl) runs the pairing walk off the raw
+    bytes with no per-op Python objects; any line it can't place makes
+    the whole batch fall back to codec parsing + the Op walk."""
+    import json as _json
+
+    from .codec import loads_op, _revive
+
+    ext = None
+    if native:
+        from ..native import ingest
+        ext = ingest()
+    if ext is not None:
+        vocab, all_kinds = _seed_vocab(kinds)
+
+        def parse(text):
+            return _revive(_json.loads(text))
+
+        bufs = ext.walk_jsonl(list(texts), vocab, all_kinds, parse)
+        if bufs is not None:
+            return _pack_walk(model, _from_bufs(bufs), all_kinds,
+                              max_states)
+    # Fallback: parse to Op lists, then the ordinary ingest walk (from
+    # the ORIGINAL seed — the scanner may have partially extended its
+    # own vocab before bailing).
+    hists = [[loads_op(line) for line in
+              (t.decode() if isinstance(t, bytes) else t).splitlines()
+              if line.strip()]
+             for t in texts]
+    return ops_to_columnar(model, hists, kinds=kinds,
+                           max_states=max_states, native=native)
+
+
 def ops_to_columnar(model, histories: Sequence[Sequence[Op]], *,
                     kinds: Optional[List[Tuple]] = None,
                     max_states: int = 64,
@@ -202,14 +305,7 @@ def ops_to_columnar(model, histories: Sequence[Sequence[Op]], *,
     pure-Python twin); the identity-drop + padding pass is vectorized
     numpy either way.
     """
-    from ..ops.statespace import enumerate_statespace
-
-    vocab: dict = {}
-    all_kinds: List[Tuple] = []
-    for k in (kinds or []):
-        if k not in vocab:
-            vocab[k] = len(all_kinds)
-            all_kinds.append(k)
+    vocab, all_kinds = _seed_vocab(kinds)
 
     ext = None
     if native:
@@ -218,51 +314,10 @@ def ops_to_columnar(model, histories: Sequence[Sequence[Op]], *,
     if ext is not None:
         histories = [h if isinstance(h, (list, tuple)) else list(h)
                      for h in histories]
-        bufs = ext.walk(histories, vocab, all_kinds)
-        # Py_BuildValue("y#") yields None for an empty vector's nullptr.
-        code = np.frombuffer(bufs[0] or b"", np.int8)
-        proc = np.frombuffer(bufs[1] or b"", np.int32)
-        kind = np.frombuffer(bufs[2] or b"", np.int32)
-        oidx = np.frombuffer(bufs[3] or b"", np.int32)
-        okflag = np.frombuffer(bufs[4] or b"", np.int8)
-        link = np.frombuffer(bufs[5] or b"", np.int32)
-        rowlen = np.frombuffer(bufs[6] or b"", np.int64)
+        arrays = _from_bufs(ext.walk(histories, vocab, all_kinds))
     else:
-        code, proc, kind, oidx, okflag, link, rowlen = _walk_py(
-            histories, vocab, all_kinds)
-
-    space = enumerate_statespace(model, all_kinds, max_states)
-    identity = space.identity_kinds
-
-    drop = code == PAD
-    if identity:
-        # Never-ok total-identity invocations and their info lines.
-        ident_mask = np.zeros(len(all_kinds) + 1, bool)
-        ident_mask[list(identity)] = True
-        inv_ident = (code == C_INVOKE) & ident_mask[kind] & (okflag == 0)
-        drop |= inv_ident
-        linked = link >= 0
-        drop |= linked & inv_ident[np.where(linked, link, 0)]
-    keep = ~drop
-
-    B = len(rowlen)
-    rid = np.repeat(np.arange(B), rowlen)[keep]
-    counts = np.bincount(rid, minlength=B)
-    N = int(counts.max()) if B else 0
-    starts = np.zeros(B, np.int64)
-    np.cumsum(counts[:-1], out=starts[1:])
-    posin = np.arange(rid.size, dtype=np.int64) - starts[rid]
-
-    typ = np.full((B, max(N, 1)), PAD, np.int8)
-    procs = np.zeros((B, max(N, 1)), np.int16)
-    kinds_arr = np.full((B, max(N, 1)), -1, np.int32)
-    index = np.full((B, max(N, 1)), -1, np.int32)
-    typ[rid, posin] = code[keep]
-    procs[rid, posin] = proc[keep].astype(np.int16)
-    kinds_arr[rid, posin] = kind[keep]
-    index[rid, posin] = oidx[keep]
-    return ColumnarOps(type=typ, process=procs, kind=kinds_arr,
-                       kinds=all_kinds, index=index)
+        arrays = _walk_py(histories, vocab, all_kinds)
+    return _pack_walk(model, arrays, all_kinds, max_states)
 
 
 def columnar_to_ops(cols: ColumnarOps, row: int) -> List[Op]:

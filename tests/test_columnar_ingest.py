@@ -252,3 +252,107 @@ def test_store_recheck_independent(tmp_path, model):
     assert run["results"]["k1"]["valid"] is True
     assert run["results"]["k2"]["valid"] is False   # read 9, never written
     assert out["valid"] is False
+
+
+# ---------------------------------------------- native jsonl loader
+
+def _texts(hs):
+    from jepsen_tpu.history.codec import dumps_op
+    return ["\n".join(dumps_op(op) for op in h) + "\n" for h in hs]
+
+
+def test_jsonl_loader_matches_op_walk(model, hists):
+    """walk_jsonl runs the pairing walk off raw bytes; its ColumnarOps
+    must be indistinguishable from the Op-object walk's."""
+    from jepsen_tpu.history.columnar import jsonl_to_columnar
+
+    a = ops_to_columnar(model, hists)
+    b = jsonl_to_columnar(model, _texts(hists))
+    assert a.kinds == b.kinds
+    for f in ("type", "process", "kind", "index"):
+        assert np.array_equal(getattr(a, f), getattr(b, f)), f
+
+
+def test_jsonl_loader_handles_codec_edge_cases(model):
+    """Nemesis (string-process) lines, list-valued cas ops, error
+    fields, crashed invokes, and bytes input all round-trip."""
+    from jepsen_tpu.history.columnar import jsonl_to_columnar
+
+    h = index_history([
+        invoke_op("nemesis", "start", None),
+        info_op("nemesis", "start", "partitioned"),
+        invoke_op(0, "write", 7), ok_op(0, "write", 7),
+        invoke_op(1, "cas", [1, 2]),
+        info_op(1, "cas", [1, 2], error="timeout"),
+        invoke_op(2, "read", None), ok_op(2, "read", 7),
+        invoke_op(0, "write", 3),        # crashed: no completion
+    ])
+    a = ops_to_columnar(model, [h])
+    b = jsonl_to_columnar(model, [_texts([h])[0].encode()])
+    assert a.kinds == b.kinds
+    for f in ("type", "process", "kind", "index"):
+        assert np.array_equal(getattr(a, f), getattr(b, f)), f
+
+
+def test_jsonl_loader_falls_back_on_unscannable_lines(model, hists):
+    """A line the C scanner can't place must not corrupt the batch —
+    the whole conversion falls back to codec parsing, same result."""
+    from jepsen_tpu.history.columnar import jsonl_to_columnar
+
+    texts = _texts(hists[:5])
+    texts[2] = "not json at all\n" + texts[2]
+    with pytest.raises(Exception):
+        jsonl_to_columnar(model, texts)
+
+    # Jagged-but-valid whitespace still scans (or falls back) cleanly.
+    texts = _texts(hists[:5])
+    texts[1] = texts[1].replace("\n", "\r\n")
+    a = ops_to_columnar(model, hists[:5])
+    b = jsonl_to_columnar(model, texts)
+    assert np.array_equal(a.kind, b.kind)
+
+
+def test_jsonl_loader_verdict_parity(model, hists):
+    """End to end: serialized -> native loader -> device verdicts match
+    the host oracle on the original histories."""
+    from jepsen_tpu.history.columnar import jsonl_to_columnar
+
+    cols = jsonl_to_columnar(model, _texts(hists))
+    valid, bad = check_columnar(model, cols)
+    for i, h in enumerate(hists):
+        want = wgl_check(model, h)
+        assert bool(valid[i]) == (want["valid"] is True), i
+        if want["valid"] is False:
+            assert int(bad[i]) == want["op"]["index"], i
+
+
+def test_store_recheck_rides_native_loader(tmp_path, model, hists):
+    """Store.recheck's non-independent path loads serialized bytes
+    through the native loader and must agree with checking the loaded
+    Op lists."""
+    from jepsen_tpu.store import Store
+
+    store = Store(base=tmp_path)
+    for i, h in enumerate(hists[:10]):
+        hd = store.create("fastload", ts=f"r{i}")
+        hd.save_history(h)
+    rr = store.recheck("fastload", model)
+    for i, h in enumerate(hists[:10]):
+        want = wgl_check(model, h)["valid"]
+        got = rr["runs"][f"r{i}"]["valid"]
+        assert got is want, (i, got, want)
+
+
+def test_store_recheck_survives_statespace_explosion(tmp_path, model):
+    """A stored history whose vocabulary exceeds the packed table must
+    degrade to the Op-list engines, not crash the fast loader path."""
+    from jepsen_tpu.store import Store
+
+    h = index_history(sum([[invoke_op(0, "write", v),
+                            ok_op(0, "write", v)]
+                           for v in range(200)], []))
+    store = Store(base=tmp_path)
+    hd = store.create("boom", ts="r0")
+    hd.save_history(h)
+    rr = store.recheck("boom", model)
+    assert rr["valid"] is True, rr
