@@ -200,7 +200,9 @@ SUITE_NAMES = ("etcd", "etcd-casd", "hazelcast", "hazelcast-lock",
 WORKLOAD_SUITES = {"hazelcast": ("lock", "ids", "queue"),
                    "cockroach": ("bank", "multibank", "register", "sets",
                                  "sequential", "comments", "g2",
-                                 "monotonic")}
+                                 "monotonic"),
+                   "galera": ("bank", "dirty"),
+                   "elasticsearch": ("set", "dirty")}
 
 # Mirrors suites.local_common.SKEWS (kept literal here so parser build
 # stays import-light; test_cli_suites pins the two in sync).
@@ -226,7 +228,8 @@ def suite_registry() -> Dict[str, Callable]:
                                                                **kw),
         "rabbitmq": lambda kw: rabbitmq.rabbitmq_test(**kw),
         "aerospike": lambda kw: aerospike.aerospike_test(**kw),
-        "elasticsearch": lambda kw: elasticsearch.elasticsearch_test(**kw),
+        "elasticsearch": lambda kw: elasticsearch.elasticsearch_test(
+            kw.pop("workload", None) or "set", **kw),
         "consul": lambda kw: consul.consul_test(**kw),
         "cockroach": lambda kw: cockroachdb.cockroach_test(
             kw.pop("workload", None) or "bank", **kw),
@@ -239,7 +242,8 @@ def suite_registry() -> Dict[str, Callable]:
         "crate": lambda kw: crate.crate_test(**kw),
         "disque": lambda kw: disque.disque_test(**kw),
         "robustirc": lambda kw: robustirc.robustirc_test(**kw),
-        "galera": lambda kw: galera.galera_test(**kw),
+        "galera": lambda kw: galera.galera_test(
+            kw.pop("workload", None) or "bank", **kw),
         "percona": lambda kw: percona.percona_test(**kw),
         "mysql-cluster": lambda kw: mysql_cluster.mysql_cluster_test(**kw),
         "postgres-rds": lambda kw: postgres_rds.postgres_rds_test(**kw),

@@ -79,6 +79,10 @@ long g_kv_counter = 0;
 // bank checker must catch (the violation cockroach's bank test hunts,
 // cockroachdb/src/jepsen/cockroach/bank.clj:112-143).
 int g_bank_split_ms = 0;
+std::map<std::string, std::vector<long>> g_dirty;  // name -> row values
+// >0: dirty-table writes release the lock between rows (see
+// handle_dirty — the seeded dirty-read/inconsistent-read bug).
+int g_dirty_split_ms = 0;
 long g_index = 0;
 std::string g_persist_path;
 int g_delay_ms = 0;
@@ -566,6 +570,73 @@ void handle_bank(int fd, Request& req, const std::string& name) {
   }
 }
 
+// Dirty-reads table (galera/src/jepsen/galera/dirty_reads.clj): writers
+// set EVERY row to one unique value; readers read all rows. Atomic mode
+// (default) applies a write all-or-nothing under the lock, so an
+// aborted write (form abort=1 -> 409) leaves nothing behind.
+// --dirty-split-ms N is the seeded isolation bug: the lock is released
+// between rows, so readers observe half-written states (inconsistent
+// reads) and an aborted write leaves its first half applied — a FAILED
+// transaction's value visible to readers, the dirty read the checker
+// must catch.
+void handle_dirty(int fd, Request& req, const std::string& name) {
+  if (req.method == "GET") {
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto it = g_dirty.find(name);
+    if (it == g_dirty.end()) {
+      respond(fd, 404, "{\"error\":\"no such table\"}");
+      return;
+    }
+    std::ostringstream os;
+    os << "{\"xs\":[";
+    for (size_t i = 0; i < it->second.size(); ++i)
+      os << (i ? "," : "") << it->second[i];
+    os << "]}";
+    respond(fd, 200, os.str());
+    return;
+  }
+  const std::string& op = req.form["op"];
+  if (op == "init") {
+    long n = atol(req.form["rows"].c_str());
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto& t = g_dirty[name];
+    if (t.empty()) t.assign((size_t)n, -1);
+    respond(fd, 200, "{\"ok\":true}");
+  } else if (op == "write") {
+    long x = atol(req.form["x"].c_str());
+    bool abort = req.form["abort"] == "1";
+    std::unique_lock<std::mutex> lock(g_mu);
+    auto it = g_dirty.find(name);
+    if (it == g_dirty.end()) {
+      respond(fd, 404, "{\"error\":\"no such table\"}");
+      return;
+    }
+    size_t n = it->second.size();
+    if (g_dirty_split_ms <= 0) {
+      // Atomic: aborted transactions apply nothing.
+      if (!abort)
+        for (size_t i = 0; i < n; ++i) it->second[i] = x;
+    } else {
+      // Row at a time with the lock dropped in between; an abort stops
+      // after the first half, leaving its rows visible (the bug).
+      size_t upto = abort ? n / 2 : n;
+      for (size_t i = 0; i < upto; ++i) {
+        g_dirty[name][i] = x;
+        lock.unlock();
+        std::this_thread::sleep_for(
+            std::chrono::milliseconds(g_dirty_split_ms));
+        lock.lock();
+      }
+    }
+    if (abort)
+      respond(fd, 409, "{\"error\":\"aborted\"}");
+    else
+      respond(fd, 200, "{\"ok\":true}");
+  } else {
+    respond(fd, 400, "{\"error\":\"bad op\"}");
+  }
+}
+
 bool is_service_path(const std::string& p) {
   return p == "/ids/next" || p == "/ts/next" || p == "/ctl/clock" ||
          p.rfind("/v1/kv/", 0) == 0 || p.rfind("/lock/", 0) == 0 ||
@@ -584,6 +655,8 @@ void handle(int fd) {
       respond(fd, 200, "{\"health\":\"true\"}");
     } else if (starts_with(req.path, "/bank/", &bank_name)) {
       handle_bank(fd, req, bank_name);   // manages g_mu itself
+    } else if (starts_with(req.path, "/dirty/", &bank_name)) {
+      handle_dirty(fd, req, bank_name);  // manages g_mu itself
     } else if (is_service_path(req.path)) {
       std::lock_guard<std::mutex> lock(g_mu);
       handle_service(fd, req);
@@ -643,6 +716,8 @@ int main(int argc, char** argv) {
     if (!strcmp(argv[i], "--delay-ms")) g_delay_ms = atoi(argv[i + 1]);
     if (!strcmp(argv[i], "--bank-split-ms"))
       g_bank_split_ms = atoi(argv[i + 1]);
+    if (!strcmp(argv[i], "--dirty-split-ms"))
+      g_dirty_split_ms = atoi(argv[i + 1]);
   }
   replay();
   signal(SIGPIPE, SIG_IGN);

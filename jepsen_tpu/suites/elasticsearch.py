@@ -136,7 +136,123 @@ def set_workload(opts: dict) -> dict:
     }
 
 
-def elasticsearch_test(**opts) -> dict:
+# ------------------------------------------------------- dirty read
+# elasticsearch/src/jepsen/elasticsearch/dirty_read.clj: writers insert
+# consecutive values while readers chase the most recent in-flight
+# write; a final strong-read phase reads the whole set from every
+# worker. The checker's set algebra (dirty_read.clj:106-156): a read
+# observing a value missing from every strong read is a DIRTY read
+# (saw uncommitted state); an acked write missing from the strong
+# union is LOST; strong readers disagreeing means divergent replicas.
+
+
+class DirtyReadClient(ServiceClient):
+    """write v / read v (did a specific recent write become visible?) /
+    strong-read (full set) over /set (dirty_read.clj:32-84)."""
+
+    def invoke(self, test, op):
+        f = op["f"]
+
+        def body():
+            if f == "write":
+                self._req("POST", "/set/jepsen",
+                          {"op": "add", "v": op["value"]})
+                return {**op, "type": "ok"}
+            r = self._req("GET", "/set/jepsen")
+            vs = [int(v) for v in r["vs"]]
+            if f == "strong-read":
+                return {**op, "type": "ok", "value": vs}
+            if f == "read":
+                # Observed iff the chased value is present.
+                if op["value"] in vs:
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "not-found"}
+            raise ValueError(f"unknown op {f}")
+
+        return self.guarded(op, body, mutating=f == "write")
+
+
+class DirtyReadChecker:
+    """dirty = ok reads whose value is in NO strong read; lost = ok
+    writes missing from the strong union; strong readers must agree
+    (dirty_read.clj:106-156)."""
+
+    def check(self, test, model, history, opts=None) -> dict:
+        writes, reads, strong = set(), set(), []
+        for op in history:
+            if op.type != "ok":
+                continue
+            if op.f == "write":
+                writes.add(op.value)
+            elif op.f == "read":
+                reads.add(op.value)
+            elif op.f == "strong-read" and isinstance(op.value, list):
+                strong.append(set(op.value))
+        if not strong:
+            return {"valid": "unknown",
+                    "error": "no strong reads completed"}
+        on_all = set.intersection(*strong)
+        on_some = set.union(*strong)
+        dirty = reads - on_some
+        lost = writes - on_some
+        agree = on_all == on_some
+        return {"valid": bool(agree and not dirty and not lost),
+                "nodes-agree": agree,
+                "read-count": len(reads),
+                "on-some-count": len(on_some),
+                "dirty": sorted(dirty)[:10], "dirty-count": len(dirty),
+                "lost": sorted(lost)[:10], "lost-count": len(lost),
+                "some-lost-count": len(writes - on_all)}
+
+
+class _RWGen(g.Generator):
+    """First ``writers`` threads write consecutive values; the rest
+    chase the most recent write (dirty_read.clj:160-189's rw-gen)."""
+
+    def __init__(self, writers: int):
+        self.writers = writers
+        self._last = 0
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def op(self, test, process, ctx):
+        if ctx.thread_of(process) < self.writers:
+            with self._lock:
+                v = self._next
+                self._next += 1
+                self._last = v
+            return {"type": "invoke", "f": "write", "value": v}
+        with self._lock:
+            v = self._last
+        return {"type": "invoke", "f": "read", "value": v}
+
+
+def dirty_read_workload(opts: dict) -> dict:
+    n_ops = opts.get("n_ops", 200)
+    writers = opts.get("writers", 2)
+    main = g.limit(n_ops, g.stagger(1 / 100, _RWGen(writers)))
+    # One strong read per worker (the reference expects exactly
+    # :concurrency of them, dirty_read.clj:135-140).
+    final = g.each(lambda: g.once({"type": "invoke", "f": "strong-read",
+                                   "value": None}))
+    return {
+        "generator": g.phases(main, final),
+        "checker": DirtyReadChecker(),
+        "model": None,
+    }
+
+
+def dirty_read_test(**opts) -> dict:
+    return service_test("elasticsearch-dirty",
+                        DirtyReadClient(opts.get("client_timeout", 0.5)),
+                        dirty_read_workload(opts), **opts)
+
+
+def elasticsearch_test(workload: str = "set", **opts) -> dict:
+    """Workload dispatch (set — system/elasticsearch.clj:204-253; dirty
+    — elasticsearch/dirty_read.clj)."""
+    if workload == "dirty":
+        return dirty_read_test(**opts)
     return service_test("elasticsearch-set",
                         SetClient(opts.get("client_timeout", 0.5)),
                         set_workload(opts), **opts)

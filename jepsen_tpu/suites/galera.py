@@ -19,6 +19,7 @@ from ..control.core import lit
 from ..db import DB
 from ..os_impl import debian
 from ..runtime import primary, synchronize
+from .local_common import ServiceClient
 
 REPO_LINE = ("deb http://sfo1.mirrors.digitalocean.com/mariadb/repo/10.0/"
              "debian jessie main")
@@ -114,8 +115,130 @@ class GaleraDB(DB):
         return LOG_FILES
 
 
-def galera_test(**opts) -> dict:
-    """The bank workload (galera.clj:240-339) in local mode against
-    casd's bank endpoints."""
+# ------------------------------------------------------- dirty reads
+# galera/src/jepsen/galera/dirty_reads.clj (shared by the percona
+# suite): writers compete to set EVERY row of a table to one unique
+# value inside a transaction; readers read all rows. The checker hunts
+# two anomalies: a FAILED transaction's value visible to any reader
+# (dirty read), and reads whose rows disagree (inconsistent read —
+# reported, not validity-bearing, matching the reference).
+
+
+class DirtyReadsClient(ServiceClient):
+    """write x to all rows / read all rows over /dirty/<name>
+    (dirty_reads.clj:29-67). ``abort`` ops request a server-side
+    rollback — the definite :fail whose value must never be seen."""
+
+    def __init__(self, timeout: float = 0.5, rows: int = 4):
+        super().__init__(timeout)
+        self.rows = rows
+
+    def setup(self, test, node):
+        cl = super().setup(test, node)
+        cl.rows = self.rows
+        cl._req("POST", "/dirty/jepsen", {"op": "init", "rows": cl.rows})
+        return cl
+
+    def invoke(self, test, op):
+        import urllib.error
+        f = op["f"]
+
+        def body():
+            if f == "read":
+                r = self._req("GET", "/dirty/jepsen")
+                return {**op, "type": "ok",
+                        "value": [int(x) for x in r["xs"]]}
+            if f == "write":
+                form = {"op": "write", "x": op["value"]}
+                if op.get("abort"):
+                    form["abort"] = "1"
+                try:
+                    self._req("POST", "/dirty/jepsen", form)
+                    return {**op, "type": "ok"}
+                except urllib.error.HTTPError as e:
+                    if e.code == 409:
+                        return {**op, "type": "fail", "error": "aborted"}
+                    raise
+            raise ValueError(f"unknown op {f}")
+
+        return self.guarded(op, body, mutating=f == "write")
+
+
+class DirtyReadsChecker:
+    """A failed transaction's value visible to any reader is a dirty
+    read (dirty_reads.clj:72-95); reads whose rows disagree are
+    reported as inconsistent."""
+
+    def check(self, test, model, history, opts=None) -> dict:
+        failed = {op.value for op in history
+                  if op.type == "fail" and op.f == "write"}
+        reads = [op.value for op in history
+                 if op.type == "ok" and op.f == "read"
+                 and isinstance(op.value, list)]
+        inconsistent = [r for r in reads if len(set(r)) > 1]
+        filthy = [r for r in reads if any(x in failed for x in r)]
+        return {"valid": not filthy,
+                "reads": len(reads),
+                "inconsistent-reads": inconsistent[:10],
+                "inconsistent-count": len(inconsistent),
+                "dirty-reads": filthy[:10],
+                "dirty-count": len(filthy)}
+
+
+def _dirty_gen(abort_every: int):
+    """Reads vs unique-value writes; every ``abort_every``-th write
+    requests a rollback (the reference's aborts come from deadlock
+    retries; here they're explicit so the seeded run aborts reliably)."""
+    import itertools
+    import threading
+    counter = itertools.count(1)
+    lock = threading.Lock()
+
+    def gen(test, process, ctx):
+        if ctx.rng.random() < 0.5:
+            return {"type": "invoke", "f": "read", "value": None}
+        with lock:
+            x = next(counter)
+        op = {"type": "invoke", "f": "write", "value": x}
+        if abort_every and x % abort_every == 0:
+            op["abort"] = True
+        return op
+
+    return gen
+
+
+def dirty_reads_workload(opts: dict) -> dict:
+    from .. import gen as g
+    n_ops = opts.get("n_ops", 200)
+    return {
+        "generator": g.limit(n_ops, g.stagger(
+            1 / 100, _dirty_gen(opts.get("abort_every", 4)))),
+        "checker": DirtyReadsChecker(),
+        "model": None,
+    }
+
+
+def dirty_reads_test(split_ms: int = 0, **opts) -> dict:
+    """The dirty-reads test; ``split_ms > 0`` seeds the row-at-a-time
+    isolation bug (failed transactions leave visible rows)."""
+    from .local_common import service_test
+    daemon_args = (["--dirty-split-ms", str(split_ms)] if split_ms
+                   else [])
+    return service_test(
+        "galera-dirty",
+        DirtyReadsClient(opts.get("client_timeout", 0.5),
+                         opts.get("rows", 4)),
+        dirty_reads_workload(opts), daemon_args=daemon_args, **opts)
+
+
+def galera_test(workload: str = "bank", split_ms: int = 0,
+                **opts) -> dict:
+    """Workload dispatch (the reference splits these across
+    galera.clj:240-339 and galera/dirty_reads.clj). ``split_ms`` seeds
+    the matching fault either way: the split-transfer race for bank,
+    the row-at-a-time visibility bug for dirty."""
+    if workload == "dirty":
+        return dirty_reads_test(split_ms=split_ms, **opts)
     from .cockroachdb import bank_service_test
-    return bank_service_test("galera", **opts)
+    daemon_args = (["--bank-split-ms", str(split_ms)] if split_ms else [])
+    return bank_service_test("galera", daemon_args, **opts)

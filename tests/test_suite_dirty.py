@@ -1,0 +1,135 @@
+"""Dirty-read suites end-to-end against real casd processes.
+
+Two distinct reference families:
+
+  * galera/percona dirty reads (galera/dirty_reads.clj): a FAILED
+    transaction's value visible to readers. Seeded by --dirty-split-ms
+    (row-at-a-time writes; aborts leave half the rows behind).
+  * elasticsearch/crate dirty read (elasticsearch/dirty_read.clj):
+    set-algebra over reads / acked writes / final strong reads. Seeded
+    by a state-wiping restart (observed and acked values vanish from
+    the strong reads).
+"""
+import shutil
+import subprocess
+
+import pytest
+
+from jepsen_tpu.runtime import run
+from jepsen_tpu.suites.elasticsearch import dirty_read_test
+from jepsen_tpu.suites.galera import (DirtyReadsChecker, dirty_reads_test)
+
+
+def _cleanup():
+    subprocess.run(["bash", "-c", "pkill -9 -f '[c]asd --port' || true"],
+                   capture_output=True)
+    for d in ("/tmp/jepsen/galera-dirty", "/tmp/jepsen/elasticsearch-dirty"):
+        shutil.rmtree(d, ignore_errors=True)
+
+
+@pytest.fixture(autouse=True)
+def clean_casd():
+    _cleanup()
+    yield
+    _cleanup()
+
+
+def _opts(tmp_path, port, **kw):
+    opts = dict(client_timeout=0.5, casd_dir=str(tmp_path / "casd"),
+                base_port=port, time_limit=12)
+    opts.update(kw)
+    return opts
+
+
+# ------------------------------------------------------------- checker
+
+def test_dirty_reads_checker_truth_table():
+    from jepsen_tpu.history.core import index as index_history
+    from jepsen_tpu.history.ops import fail_op, invoke_op, ok_op
+
+    chk = DirtyReadsChecker()
+    # clean: reads only ever see committed values
+    h = index_history([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "read", None), ok_op(1, "read", [1, 1, 1]),
+        invoke_op(0, "write", 2), fail_op(0, "write", 2),
+        invoke_op(1, "read", None), ok_op(1, "read", [1, 1, 1]),
+    ])
+    r = chk.check({}, None, h)
+    assert r["valid"] is True and r["dirty-count"] == 0
+
+    # filthy: the failed write's value is visible
+    h = index_history([
+        invoke_op(0, "write", 2), fail_op(0, "write", 2),
+        invoke_op(1, "read", None), ok_op(1, "read", [2, -1, -1]),
+    ])
+    r = chk.check({}, None, h)
+    assert r["valid"] is False
+    assert r["dirty-count"] == 1
+    assert r["inconsistent-count"] == 1     # rows disagree too
+
+
+# ------------------------------------------------- galera-style e2e
+
+def test_galera_dirty_atomic_valid(tmp_path):
+    """Atomic writes: aborted transactions leave nothing behind, so
+    every run is clean — and aborts really happened (fail ops)."""
+    test = dirty_reads_test(**_opts(tmp_path, 26200, n_ops=120,
+                                    abort_every=3))
+    r = run(test)
+    assert r["results"]["valid"] is True, r["results"]
+    aborted = sum(1 for op in r["history"]
+                  if op.type == "fail" and op.f == "write")
+    assert aborted >= 3
+    reads = sum(1 for op in r["history"]
+                if op.type == "ok" and op.f == "read")
+    assert reads >= 10
+
+
+def test_galera_dirty_split_detected_invalid(tmp_path):
+    """--dirty-split-ms releases the lock between rows: an aborted
+    write's half-applied rows become visible to readers — the checker
+    must flag the failed value."""
+    last = None
+    for attempt in range(3):
+        test = dirty_reads_test(
+            split_ms=5,
+            **_opts(tmp_path, 26210 + attempt, n_ops=200,
+                    abort_every=2, concurrency=6,
+                    time_limit=12 + 4 * attempt))
+        last = run(test)
+        if last["results"]["valid"] is False:
+            break
+        _cleanup()
+    assert last["results"]["valid"] is False, last["results"]
+    assert last["results"]["dirty-count"] >= 1
+
+
+# ------------------------------------------- elasticsearch-style e2e
+
+def test_es_dirty_read_healthy_valid(tmp_path):
+    test = dirty_read_test(**_opts(tmp_path, 26220, n_ops=150))
+    r = run(test)
+    assert r["results"]["valid"] is True, r["results"]
+    assert r["results"]["nodes-agree"] is True
+    assert r["results"]["read-count"] >= 5
+
+
+def test_es_dirty_read_restart_detected_invalid(tmp_path):
+    """A state-wiping restart: values that were observed (reads) and
+    acked (writes) vanish from the final strong reads — dirty + lost."""
+    last = None
+    for attempt in range(3):
+        # The restart must land INSIDE the main phase: ~700 staggered
+        # ops last a couple of seconds, the first kill fires at 0.3s.
+        test = dirty_read_test(
+            nemesis_mode="restart", persist=False,
+            **_opts(tmp_path, 26230 + attempt, n_ops=700,
+                    nemesis_cadence=0.3, time_limit=12 + 4 * attempt))
+        last = run(test)
+        if last["results"]["valid"] is False:
+            break
+        _cleanup()
+    assert last["results"]["valid"] is False, last["results"]
+    assert (last["results"]["dirty-count"] >= 1
+            or last["results"]["lost-count"] >= 1)
