@@ -202,7 +202,10 @@ WORKLOAD_SUITES = {"hazelcast": ("lock", "ids", "queue"),
                                  "sequential", "comments", "g2",
                                  "monotonic"),
                    "galera": ("bank", "dirty"),
-                   "elasticsearch": ("set", "dirty")}
+                   "percona": ("bank", "dirty"),
+                   "elasticsearch": ("set", "dirty"),
+                   "crate": ("register", "lost-updates", "dirty"),
+                   "mongodb": ("register", "transfer")}
 
 # Mirrors suites.local_common.SKEWS (kept literal here so parser build
 # stays import-light; test_cli_suites pins the two in sync).
@@ -238,13 +241,16 @@ def suite_registry() -> Dict[str, Callable]:
         "zookeeper": lambda kw: zookeeper.zookeeper_test(**kw),
         "logcabin": lambda kw: logcabin.logcabin_test(**kw),
         "rethinkdb": lambda kw: rethinkdb.rethinkdb_test(**kw),
-        "mongodb": lambda kw: mongodb.mongodb_test(**kw),
-        "crate": lambda kw: crate.crate_test(**kw),
+        "mongodb": lambda kw: mongodb.mongodb_test(
+            kw.pop("workload", None) or "register", **kw),
+        "crate": lambda kw: crate.crate_test(
+            kw.pop("workload", None) or "register", **kw),
         "disque": lambda kw: disque.disque_test(**kw),
         "robustirc": lambda kw: robustirc.robustirc_test(**kw),
         "galera": lambda kw: galera.galera_test(
             kw.pop("workload", None) or "bank", **kw),
-        "percona": lambda kw: percona.percona_test(**kw),
+        "percona": lambda kw: percona.percona_test(
+            kw.pop("workload", None) or "bank", **kw),
         "mysql-cluster": lambda kw: mysql_cluster.mysql_cluster_test(**kw),
         "postgres-rds": lambda kw: postgres_rds.postgres_rds_test(**kw),
     }

@@ -197,6 +197,13 @@ void replay() {
       long amount = atol(value.c_str() + c1 + 1);
       g_banks[key][0] -= amount;
       g_banks[tob][0] += amount;
+    } else if (op == "Y") {            // dirty-table init, rows
+      auto& t = g_dirty[key];
+      if (t.empty()) t.assign((size_t)atol(value.c_str()), -1);
+    } else if (op == "W") {            // dirty-table completed write
+      auto it = g_dirty.find(key);
+      if (it != g_dirty.end())
+        for (auto& row : it->second) row = atol(value.c_str());
     }
     ++g_index;
   }
@@ -600,7 +607,10 @@ void handle_dirty(int fd, Request& req, const std::string& name) {
     long n = atol(req.form["rows"].c_str());
     std::lock_guard<std::mutex> lock(g_mu);
     auto& t = g_dirty[name];
-    if (t.empty()) t.assign((size_t)n, -1);
+    if (t.empty()) {
+      t.assign((size_t)n, -1);
+      plog('Y', name, std::to_string(n));
+    }
     respond(fd, 200, "{\"ok\":true}");
   } else if (op == "write") {
     long x = atol(req.form["x"].c_str());
@@ -628,10 +638,15 @@ void handle_dirty(int fd, Request& req, const std::string& name) {
         lock.lock();
       }
     }
-    if (abort)
+    if (abort) {
+      // Rolled back (or, in split mode, half-applied then dropped):
+      // never journaled — replay restores the last COMPLETED write,
+      // the committed state.
       respond(fd, 409, "{\"error\":\"aborted\"}");
-    else
+    } else {
+      plog('W', name, std::to_string(x));
       respond(fd, 200, "{\"ok\":true}");
+    }
   } else {
     respond(fd, 400, "{\"error\":\"bad op\"}");
   }

@@ -22,7 +22,7 @@ from ..db import DB
 from ..os_impl import debian
 from ..utils.core import majority
 from .etcd import EtcdClient, workload as register_workload
-from .local_common import service_test
+from .local_common import ServiceClient, service_test
 
 KEY_URL = "https://cdn.crate.io/downloads/apt/DEB-GPG-KEY-crate"
 REPO_LINE = "deb https://cdn.crate.io/downloads/apt/stable/ jessie main"
@@ -77,9 +77,99 @@ class CrateDB(DB):
         return [LOG_FILE]
 
 
-def crate_test(**opts) -> dict:
-    """The version-read register workload (crate.clj:232-320) in local
-    mode against casd."""
+# ---------------------------------------------------------- lost updates
+# crate/src/jepsen/crate/lost_updates.clj: per-key sets grown by
+# version-CAS'd read-modify-write adds, checked by the set checker
+# lifted over independent keys (independent/checker checker/set,
+# lost_updates.clj:110-112). A lost update = an acked add missing from
+# the key's final read.
+
+
+class PerKeySetClient(ServiceClient):
+    """add v / read over /set/jepsen-<k> — the per-key set the
+    reference grows via _version-guarded updates
+    (lost_updates.clj:36-89)."""
+
+    def invoke(self, test, op):
+        from .. import independent
+        k, v = op["value"]
+        f = op["f"]
+
+        def body():
+            if f == "add":
+                self._req("POST", f"/set/jepsen-{k}",
+                          {"op": "add", "v": v})
+                return {**op, "type": "ok"}
+            if f == "read":
+                r = self._req("GET", f"/set/jepsen-{k}")
+                return {**op, "type": "ok",
+                        "value": independent.tuple_(
+                            k, [int(x) for x in r["vs"]])}
+            raise ValueError(f"unknown op {f}")
+
+        return self.guarded(op, body, mutating=f == "add")
+
+
+def lost_updates_workload(opts: dict) -> dict:
+    import itertools
+    import threading
+
+    from .. import gen as g
+    from .. import independent
+    from ..checkers.simple import set_checker
+
+    per_key = opts.get("ops_per_key", 40)
+    threads = opts.get("threads_per_key", 2)
+    # Finite key space: a time-limit cutoff mid-key leaves that key's
+    # set unread (valid: unknown); bounding the keys lets healthy runs
+    # terminate cleanly instead of always truncating the last key.
+    n_keys = opts.get("keys", 6)
+
+    def key_gen(k):
+        counter = itertools.count()
+        lock = threading.Lock()
+
+        def add(test, process, ctx):
+            with lock:
+                return {"type": "invoke", "f": "add",
+                        "value": next(counter)}
+
+        # A quiescent gap before the final read lets straggling adds
+        # complete — an add acked after the last read would read as
+        # "lost" (the checker keys on the final read,
+        # checker.clj:131-178; the reference gets the same effect from
+        # its 20s quiescence window, lost_updates.clj:101-104).
+        return g.concat(g.limit(per_key, g.stagger(1 / 100, add)),
+                        g.sleep(0.7),
+                        g.once({"type": "invoke", "f": "read",
+                                "value": None}))
+
+    return {
+        "generator": independent.concurrent_generator(
+            threads, iter(range(n_keys)), key_gen),
+        "checker": independent.checker(set_checker()),
+        "model": None,
+    }
+
+
+def lost_updates_test(**opts) -> dict:
+    # service_test derives/validates concurrency from threads_per_key.
+    opts.setdefault("threads_per_key", 2)
+    return service_test(
+        "crate-lost-updates",
+        PerKeySetClient(opts.get("client_timeout", 0.5)),
+        lost_updates_workload(opts), **opts)
+
+
+def crate_test(workload: str = "register", **opts) -> dict:
+    """Workload dispatch (register — crate.clj:232-320; lost-updates —
+    crate/lost_updates.clj; dirty — crate/dirty_read.clj, the
+    strong-read family shared with elasticsearch)."""
+    if workload == "lost-updates":
+        return lost_updates_test(**opts)
+    if workload == "dirty":
+        from .elasticsearch import dirty_read_test
+        return dirty_read_test(name="crate-dirty", **opts)
     opts.setdefault("threads_per_key", 2)
     return service_test(
         "crate",

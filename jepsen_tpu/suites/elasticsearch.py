@@ -242,8 +242,8 @@ def dirty_read_workload(opts: dict) -> dict:
     }
 
 
-def dirty_read_test(**opts) -> dict:
-    return service_test("elasticsearch-dirty",
+def dirty_read_test(name: str = "elasticsearch-dirty", **opts) -> dict:
+    return service_test(name,
                         DirtyReadClient(opts.get("client_timeout", 0.5)),
                         dirty_read_workload(opts), **opts)
 

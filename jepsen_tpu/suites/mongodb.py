@@ -154,9 +154,17 @@ class MongoRocksDB(DB):
         return [LOG_FILE]
 
 
-def mongodb_test(**opts) -> dict:
-    """The document-CAS register workload (document_cas.clj) in local
-    mode against casd."""
+def mongodb_test(workload: str = "register", split_ms: int = 0,
+                 **opts) -> dict:
+    """Workload dispatch: register (document_cas.clj — per-key document
+    CAS) or transfer (transfer.clj — the bank family as document
+    transactions), in local mode against casd. ``split_ms`` seeds the
+    split-transfer race for the transfer workload."""
+    if workload == "transfer":
+        from .cockroachdb import bank_service_test
+        daemon_args = (["--bank-split-ms", str(split_ms)] if split_ms
+                       else [])
+        return bank_service_test("mongodb-transfer", daemon_args, **opts)
     opts.setdefault("threads_per_key", 2)
     return service_test(
         "mongodb",

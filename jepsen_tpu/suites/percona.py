@@ -114,8 +114,14 @@ class PerconaDB(DB):
         return LOG_FILES
 
 
-def percona_test(**opts) -> dict:
-    """The bank workload (percona.clj:233-331) in local mode against
-    casd's bank endpoints."""
+def percona_test(workload: str = "bank", split_ms: int = 0,
+                 **opts) -> dict:
+    """Workload dispatch (percona.clj:233-331 bank;
+    percona/dirty_reads.clj — the dirty-reads family shared with
+    galera)."""
+    if workload == "dirty":
+        from .galera import dirty_reads_test
+        return dirty_reads_test(split_ms=split_ms, **opts)
     from .cockroachdb import bank_service_test
-    return bank_service_test("percona", **opts)
+    daemon_args = (["--bank-split-ms", str(split_ms)] if split_ms else [])
+    return bank_service_test("percona", daemon_args, **opts)

@@ -133,3 +133,50 @@ def test_es_dirty_read_restart_detected_invalid(tmp_path):
     assert last["results"]["valid"] is False, last["results"]
     assert (last["results"]["dirty-count"] >= 1
             or last["results"]["lost-count"] >= 1)
+
+
+# ---------------------------------------------- crate lost-updates
+
+def test_crate_lost_updates_healthy_valid(tmp_path):
+    """Per-key sets, adds + final read per key, independent set checker
+    (crate/lost_updates.clj:110-112)."""
+    from jepsen_tpu.suites.crate import crate_test
+
+    shutil.rmtree("/tmp/jepsen/crate-lost-updates", ignore_errors=True)
+    test = crate_test(workload="lost-updates",
+                      **_opts(tmp_path, 26300, ops_per_key=25,
+                              time_limit=15))
+    r = run(test)
+    assert r["results"]["valid"] is True, r["results"]
+    assert len(r["results"]["results"]) >= 2     # several keys checked
+
+
+def test_crate_lost_updates_restart_detects_lost(tmp_path):
+    """A state-wiping restart loses acked adds — the per-key set
+    checker must report them lost."""
+    from jepsen_tpu.suites.crate import crate_test
+
+    last = None
+    for attempt in range(3):
+        shutil.rmtree("/tmp/jepsen/crate-lost-updates",
+                      ignore_errors=True)
+        test = crate_test(workload="lost-updates",
+                          nemesis_mode="restart", persist=False,
+                          **_opts(tmp_path, 26310 + attempt,
+                                  ops_per_key=60, nemesis_cadence=0.5,
+                                  time_limit=15 + 4 * attempt))
+        last = run(test)
+        if last["results"]["valid"] is False:
+            break
+        _cleanup()
+    assert last["results"]["valid"] is False, last["results"]
+
+
+def test_mongodb_transfer_dispatch():
+    """mongodb --workload transfer routes to the bank family."""
+    from jepsen_tpu.suites.mongodb import mongodb_test
+
+    t = mongodb_test(workload="transfer", n_ops=10, time_limit=2)
+    assert t["name"] == "mongodb-transfer"
+    from jepsen_tpu.suites.cockroachdb import BankChecker
+    assert isinstance(t["checker"], BankChecker)
