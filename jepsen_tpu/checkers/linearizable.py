@@ -221,16 +221,29 @@ class LinearizableChecker(Checker):
 
     def check(self, test, model, history, opts=None) -> dict:
         if self.backend == "host":
-            return wgl_check(model, history, **self.kw)
-        if self.backend == "native":
+            r = wgl_check(model, history, **self.kw)
+        elif self.backend == "native":
             from ..native import wgl_check_native
-            return wgl_check_native(model, history, **self.kw)
-        if self.backend == "tpu":
+            r = wgl_check_native(model, history, **self.kw)
+        elif self.backend == "tpu":
             from ..ops.linearize import check_one_tpu
-            return check_one_tpu(model, history, **self.kw)
-        if self.backend == "competition":
-            return self._compete(model, history)
-        raise AssertionError
+            r = check_one_tpu(model, history, **self.kw)
+        elif self.backend == "competition":
+            r = self._compete(model, history)
+        else:
+            raise AssertionError
+        # Invalid analyses render to linear.svg in the run dir when a
+        # store is attached (checker.clj:98-103's knossos render). A
+        # render failure must never alter the verdict — check_safe
+        # would otherwise downgrade a found violation to "unknown".
+        try:
+            from .linear_report import write_analysis
+            write_analysis(test, model, history, r, opts)
+        except Exception:
+            import logging
+            logging.getLogger("jepsen.checker").warning(
+                "linear.svg render failed", exc_info=True)
+        return r
 
 
 def linearizable(backend: str = "host", **kw) -> Checker:

@@ -131,7 +131,8 @@ std::string b64_decode(const std::string& in) {
 // Append one replayable record. Codes: S/D kv set/delete, L/U lock
 // acquire/release, I id grant, Z timestamp grant, K/X consul-kv
 // set(b64)/delete, C counter add, Q/R queue enq/deq, E set add,
-// B bank init, T in-bank transfer, M cross-bank transfer.
+// B bank init, T in-bank transfer, M cross-bank transfer,
+// Y dirty-table init, W completed dirty-table write.
 void plog(char code, const std::string& a, const std::string& b) {
   if (g_persist_path.empty()) return;
   std::ofstream f(g_persist_path, std::ios::app);

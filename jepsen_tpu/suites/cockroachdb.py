@@ -222,10 +222,15 @@ def bank_workload(opts: dict) -> dict:
     }
 
 
-def bank_service_test(name: str, daemon_args=(), **opts) -> dict:
+def bank_service_test(name: str, daemon_args=(), *, split_ms: int = 0,
+                      **opts) -> dict:
     """A local-mode bank-family test (shared by the galera / percona /
-    mysql-cluster / postgres-rds suites, which all run this workload
-    family against their own DB automation)."""
+    mysql-cluster / mongodb-transfer / postgres-rds suites, which all
+    run this workload family against their own DB automation).
+    ``split_ms > 0`` seeds the non-atomic transfer race."""
+    if split_ms:
+        daemon_args = list(daemon_args) + ["--bank-split-ms",
+                                           str(split_ms)]
     return service_test(
         name,
         BankClient(opts.get("client_timeout", 0.5),
@@ -236,8 +241,7 @@ def bank_service_test(name: str, daemon_args=(), **opts) -> dict:
 def bank_test(split_ms: int = 0, **opts) -> dict:
     """The local bank test; ``split_ms > 0`` seeds the non-atomic
     transfer race the checker must catch."""
-    daemon_args = (["--bank-split-ms", str(split_ms)] if split_ms else [])
-    return bank_service_test("cockroach-bank", daemon_args, **opts)
+    return bank_service_test("cockroach-bank", split_ms=split_ms, **opts)
 
 
 class TimestampClient(ServiceClient):

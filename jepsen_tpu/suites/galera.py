@@ -218,14 +218,15 @@ def dirty_reads_workload(opts: dict) -> dict:
     }
 
 
-def dirty_reads_test(split_ms: int = 0, **opts) -> dict:
+def dirty_reads_test(split_ms: int = 0, name: str = "galera-dirty",
+                     **opts) -> dict:
     """The dirty-reads test; ``split_ms > 0`` seeds the row-at-a-time
     isolation bug (failed transactions leave visible rows)."""
     from .local_common import service_test
     daemon_args = (["--dirty-split-ms", str(split_ms)] if split_ms
                    else [])
     return service_test(
-        "galera-dirty",
+        name,
         DirtyReadsClient(opts.get("client_timeout", 0.5),
                          opts.get("rows", 4)),
         dirty_reads_workload(opts), daemon_args=daemon_args, **opts)
@@ -240,5 +241,4 @@ def galera_test(workload: str = "bank", split_ms: int = 0,
     if workload == "dirty":
         return dirty_reads_test(split_ms=split_ms, **opts)
     from .cockroachdb import bank_service_test
-    daemon_args = (["--bank-split-ms", str(split_ms)] if split_ms else [])
-    return bank_service_test("galera", daemon_args, **opts)
+    return bank_service_test("galera", split_ms=split_ms, **opts)

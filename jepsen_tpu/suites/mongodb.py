@@ -162,9 +162,8 @@ def mongodb_test(workload: str = "register", split_ms: int = 0,
     split-transfer race for the transfer workload."""
     if workload == "transfer":
         from .cockroachdb import bank_service_test
-        daemon_args = (["--bank-split-ms", str(split_ms)] if split_ms
-                       else [])
-        return bank_service_test("mongodb-transfer", daemon_args, **opts)
+        return bank_service_test("mongodb-transfer", split_ms=split_ms,
+                                 **opts)
     opts.setdefault("threads_per_key", 2)
     return service_test(
         "mongodb",

@@ -121,7 +121,7 @@ def percona_test(workload: str = "bank", split_ms: int = 0,
     galera)."""
     if workload == "dirty":
         from .galera import dirty_reads_test
-        return dirty_reads_test(split_ms=split_ms, **opts)
+        return dirty_reads_test(split_ms=split_ms, name="percona-dirty",
+                                **opts)
     from .cockroachdb import bank_service_test
-    daemon_args = (["--bank-split-ms", str(split_ms)] if split_ms else [])
-    return bank_service_test("percona", daemon_args, **opts)
+    return bank_service_test("percona", split_ms=split_ms, **opts)

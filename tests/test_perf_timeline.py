@@ -94,3 +94,34 @@ def test_timeline_html(tmp_path):
     assert "process 0" in html and "process 2" in html
     assert html.count('class="op"') == 3
     assert "write" in html and "cas" in html
+
+
+def test_invalid_analysis_renders_linear_svg(tmp_path):
+    """An invalid linearizable result writes linear.svg into the run
+    dir with the culprit op and the surviving config sample
+    (checker.clj:98-103's knossos render)."""
+    from jepsen_tpu.checkers.linearizable import linearizable
+    from jepsen_tpu.history.core import index as index_history
+    from jepsen_tpu.history.ops import invoke_op, ok_op
+    from jepsen_tpu.models.core import cas_register
+    from jepsen_tpu.store import Store
+
+    h = index_history([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "read", None), ok_op(1, "read", 3),
+    ])
+    handle = Store(base=tmp_path).create("linear-svg", ts="r0")
+    r = linearizable().check({"store_handle": handle},
+                             cas_register(), h)
+    assert r["valid"] is False
+    svg = (handle.dir / "linear.svg").read_text()
+    assert "counterexample" in svg
+    assert f"op {r['op']['index']}" in svg
+    assert "read" in svg
+    # valid results render nothing
+    h2 = index_history([invoke_op(0, "write", 1), ok_op(0, "write", 1)])
+    handle2 = Store(base=tmp_path).create("linear-svg", ts="r1")
+    r2 = linearizable().check({"store_handle": handle2},
+                              cas_register(), h2)
+    assert r2["valid"] is True
+    assert not (handle2.dir / "linear.svg").exists()
