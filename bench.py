@@ -64,6 +64,12 @@ def main():
     # pure-Python fallback (the -Xmx32g analog, linearize.py:335-388).
     # Two-phase encode: the 16-slot table covers ~99.98% of rows at the
     # cheaper width; only overflow rows re-encode wide.
+    #
+    # Measured non-lever: consolidating cost classes by padding W up
+    # (fewer, fatter dispatches) LOSES at every granularity tried —
+    # {8,12,16} 5.8->23.2s, tail-only {13..16 -> 16} 5.8->15.5s,
+    # low-only {<=8 -> 8} neutral. The kernel is compute-bound in 2^W
+    # per row, so exact-W bucketing is already the optimal schedule.
     eff_slots = DATA_MAX_SLOTS + device_frontier_capacity()
 
     def encode():

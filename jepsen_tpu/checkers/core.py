@@ -49,6 +49,18 @@ class FnChecker(Checker):
         return self.fn(test, model, history, opts)
 
 
+def out_path(test, opts, name) -> Optional[str]:
+    """Resolve an artifact path in the run dir (store from opts or the
+    test map, honoring the independent checker's per-key subdirectory).
+    None when no store is attached — the shared seam every
+    artifact-writing checker (perf, timeline, linear.svg) uses."""
+    store = (opts or {}).get("store") or test.get("store_handle")
+    if store is None:
+        return None
+    sub = list((opts or {}).get("subdirectory", []))
+    return store.path(*sub, name)
+
+
 def check(checker, test, model, history, opts=None) -> dict:
     if callable(checker) and not isinstance(checker, Checker):
         checker = FnChecker(checker)

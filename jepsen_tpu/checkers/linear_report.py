@@ -14,10 +14,8 @@ import html
 from typing import List, Optional, Sequence
 
 from ..history.core import pairs
-from ..history.ops import Op, OK, FAIL, INFO
-
-TYPE_COLORS = {OK: "#6DB6FE", INFO: "#FFAA26", FAIL: "#FEB5DA",
-               None: "#eeeeee"}
+from ..history.ops import Op
+from .timeline import TYPE_COLORS   # one palette (doc/color.md)
 
 LANE_H = 28
 BAR_H = 20
@@ -118,11 +116,10 @@ def write_analysis(test: dict, model, history: Sequence[Op],
     returns the written path."""
     if result.get("valid") is not False:
         return None
-    store = (opts or {}).get("store") or test.get("store_handle")
-    if store is None:
+    from .core import out_path
+    path = out_path(test, opts, "linear.svg")
+    if path is None:
         return None
-    sub = list((opts or {}).get("subdirectory", []))
-    path = store.path(*sub, "linear.svg")
     with open(path, "w") as f:
         f.write(render_svg(model, list(history), result))
     return path

@@ -156,12 +156,7 @@ def rate_graph(history: Sequence[Op], path: str, dt: float = 10.0) -> str:
     return path
 
 
-def _out_path(test, opts, name):
-    store = (opts or {}).get("store") or test.get("store_handle")
-    if store is None:
-        return None
-    sub = list((opts or {}).get("subdirectory", []))
-    return store.path(*sub, name)
+from .core import out_path as _out_path  # shared artifact-path seam
 
 
 class LatencyGraph(Checker):

@@ -72,11 +72,10 @@ class Timeline(Checker):
     """Writes timeline.html into the run dir (timeline.clj:92-111)."""
 
     def check(self, test, model, history, opts=None) -> dict:
-        store = (opts or {}).get("store") or test.get("store_handle")
-        if store is None:
+        from .core import out_path
+        path = out_path(test, opts, "timeline.html")
+        if path is None:
             return {"valid": True, "skipped": "no store attached"}
-        sub = list((opts or {}).get("subdirectory", []))
-        path = store.path(*sub, "timeline.html")
         with open(path, "w") as f:
             f.write(render_html(test, list(history)))
         return {"valid": True}

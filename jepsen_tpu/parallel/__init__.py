@@ -15,5 +15,5 @@ The reference has no device parallelism at all — its analogs are JVM
 thread pools and pmap'd checkers (jepsen/src/jepsen/checker.clj:384-386,
 jepsen/src/jepsen/util.clj:44-50); the mesh design subsumes them.
 """
-from .mesh import checker_mesh, data_sharded_kernel
+from .mesh import checker_mesh, data_sharded_kernel, multihost_mesh
 from .frontier import frontier_sharded_kernel

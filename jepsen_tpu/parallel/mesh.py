@@ -33,16 +33,53 @@ def checker_mesh(n_data: Optional[int] = None, n_frontier: int = 1,
     return Mesh(use, axis_names=("data", "frontier"))
 
 
+def multihost_mesh(n_hosts: int, n_data: Optional[int] = None,
+                   n_frontier: int = 1,
+                   devices: Optional[Sequence] = None) -> Mesh:
+    """A ("dcn", "data", "frontier") mesh for multi-host scale-out: the
+    leading axis spans hosts (collectives across it ride DCN), the
+    inner two stay within a host's ICI domain. The batch shards over
+    ("dcn", "data") — histories are independent, so the ONLY cross-host
+    traffic is the final verdict psum (summarize_verdicts), exactly the
+    layout the scaling playbook prescribes: fat per-chip work, skinny
+    DCN reductions. On real hardware build this from
+    jax.devices() after multi-host init (one process per host sees the
+    global device list); under the virtual CPU mesh it validates the
+    same compiled program."""
+    devices = list(devices if devices is not None else jax.devices())
+    per_host = len(devices) // n_hosts
+    if n_data is None:
+        n_data = per_host // n_frontier
+    need = n_hosts * n_data * n_frontier
+    if n_data < 1 or need > len(devices):
+        # Fail at construction, not deep inside XLA sharding.
+        raise ValueError(
+            f"multihost_mesh({n_hosts=}, {n_data=}, {n_frontier=}) "
+            f"needs {max(need, n_hosts * n_frontier)} devices, "
+            f"have {len(devices)}")
+    use = np.array(devices[:need]).reshape(n_hosts, n_data, n_frontier)
+    return Mesh(use, axis_names=("dcn", "data", "frontier"))
+
+
+def _batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The mesh axes the history batch shards over: every axis except
+    the frontier (mask) axis — ("data",) on a flat mesh,
+    ("dcn", "data") on a multi-host one."""
+    return tuple(n for n in mesh.axis_names if n != "frontier")
+
+
 def data_sharded_kernel(V: int, W: int, mesh: Mesh,
                         shared_target: bool = False):
     """Compile the batched checker with the batch axis sharded over the
-    mesh's "data" axis. Returns check(ev_type [B,N], ev_slot [B,N],
+    mesh's batch axes (("data"), or ("dcn", "data") on a multi-host
+    mesh). Returns check(ev_type [B,N], ev_slot [B,N],
     ev_slots [B,N,W], target [B,K+1,V]) -> (valid [B], bad [B],
-    frontier [B, words(V), 2^W]); B must divide by the data-axis size.
+    frontier [B, words(V), 2^W]); B must divide by the batch-axis size.
     ``shared_target``: target is one replicated [K+1, V] table instead
     of a per-row batch (one transfer, not B)."""
-    batch_spec = NamedSharding(mesh, P("data"))
-    out_spec = NamedSharding(mesh, P("data"))
+    axes = _batch_axes(mesh)
+    batch_spec = NamedSharding(mesh, P(axes))
+    out_spec = NamedSharding(mesh, P(axes))
     tgt_spec = NamedSharding(mesh, P()) if shared_target else batch_spec
     kern = jax.vmap(make_kernel(V, W),
                     in_axes=(0, 0, 0, None if shared_target else 0))

@@ -19,7 +19,7 @@ from urllib.parse import quote, unquote, urlparse
 
 from .store import Store, DEFAULT
 
-TEXT_EXT = {".txt", ".json", ".jsonl", ".log", ".edn", ".html", ".svg", ".c"}
+TEXT_EXT = {".txt", ".json", ".jsonl", ".log", ".edn", ".html", ".c"}
 IMG_EXT = {".png", ".jpg", ".jpeg", ".gif"}
 
 STYLE = """
@@ -123,6 +123,9 @@ class Handler(BaseHTTPRequestHandler):
         ext = p.suffix.lower()
         if ext in IMG_EXT:
             return self._send(p.read_bytes(), ctype=f"image/{ext[1:]}")
+        if ext == ".svg":       # render (linear.svg counterexamples),
+            return self._send(p.read_bytes(),   # don't show source
+                              ctype="image/svg+xml")
         if ext in TEXT_EXT:
             body = p.read_text(errors="replace")
             return self._page(p.name, f"<pre>{html.escape(body)}</pre>")

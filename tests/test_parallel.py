@@ -176,3 +176,36 @@ def test_production_route_frontier_columnar_w18():
     assert valid.tolist() == [True, False]
     # bad maps to the original index of the impossible read completion
     assert int(bad[1]) == hs[1][-1].index
+
+
+def test_multihost_mesh_batch_shards_over_dcn_and_data():
+    """The ("dcn", "data", "frontier") mesh: batch sharded over host AND
+    per-host axes, one program, verdict reduction crossing both — the
+    multi-host replay scale-out layout (SURVEY §2.4: DCN for multi-host
+    batch fan-out)."""
+    import numpy as np
+
+    from jepsen_tpu.checkers.linearizable import prepare_history, wgl_check
+    from jepsen_tpu.models.core import cas_register
+    from jepsen_tpu.ops.encode import batch_encode
+    from jepsen_tpu.parallel.mesh import (data_sharded_kernel,
+                                          multihost_mesh,
+                                          summarize_verdicts)
+    from jepsen_tpu.workloads.synth import synth_cas_batch
+
+    mesh = multihost_mesh(n_hosts=2)          # 2 "hosts" x 4 devices
+    assert mesh.axis_names == ("dcn", "data", "frontier")
+    assert mesh.devices.shape == (2, 4, 1)
+
+    model = cas_register()
+    hists = synth_cas_batch(16, seed0=21, n_procs=3, n_ops=24,
+                            n_values=3, corrupt=0.3)
+    enc = batch_encode(model, [prepare_history(h) for h in hists])
+    assert not enc.failures
+    kern = data_sharded_kernel(enc.V, enc.W, mesh)
+    valid, bad, _ = kern(enc.ev_type, enc.ev_slot, enc.ev_slots,
+                         enc.target)
+    host = np.array([wgl_check(model, h)["valid"] is True for h in hists])
+    assert np.array_equal(np.asarray(valid), host)
+    s = summarize_verdicts(valid)
+    assert int(s["invalid"]) == int((~host).sum())
