@@ -29,8 +29,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from ..history.core import complete, without_failures, client_ops
-from ..history.ops import Op, INVOKE, OK, FAIL, INFO
+from ..history.core import complete, without_failures
+from ..history.ops import Op, INVOKE, OK, INFO
 from ..models.core import Model, is_inconsistent
 from .core import Checker
 

@@ -12,9 +12,9 @@ import math
 from collections import defaultdict
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from ..history.ops import Op, INVOKE, OK, FAIL, INFO
+from ..history.ops import Op, OK, FAIL, INFO
 from ..utils.core import nemesis_intervals
-from .core import Checker
+from .core import Checker, out_path as _out_path
 
 DEFAULT_QUANTILES = (0.5, 0.95, 0.99, 1.0)
 
@@ -154,9 +154,6 @@ def rate_graph(history: Sequence[Op], path: str, dt: float = 10.0) -> str:
     fig.savefig(path, dpi=110, bbox_inches="tight")
     plt.close(fig)
     return path
-
-
-from .core import out_path as _out_path  # shared artifact-path seam
 
 
 class LatencyGraph(Checker):

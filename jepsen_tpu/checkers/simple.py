@@ -11,7 +11,7 @@ from __future__ import annotations
 from collections import Counter
 from typing import List, Optional
 
-from ..history.ops import Op, INVOKE, OK, FAIL, INFO
+from ..history.ops import Op, INVOKE, OK
 from ..models.core import is_inconsistent
 from ..utils.core import fraction, integer_interval_set_str
 from .core import Checker

@@ -27,7 +27,6 @@ from __future__ import annotations
 import os
 import random
 import re
-import shlex
 import subprocess
 import threading
 import time

@@ -7,13 +7,12 @@ and daemon start/stop via start-stop-daemon + pidfiles.
 from __future__ import annotations
 
 import logging
-import os
 import posixpath
 import random
 import re
 from typing import List, Optional
 
-from .core import (RemoteError, cd, escape, exec_, exec_star, expand_path,
+from .core import (RemoteError, cd, exec_, exec_star, expand_path,
                    lit, su, _ctx)
 
 log = logging.getLogger("jepsen.control.util")

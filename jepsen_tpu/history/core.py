@@ -9,9 +9,9 @@ semantics used by knossos and jepsen.checker).
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
-from .ops import Op, INVOKE, OK, FAIL, INFO
+from .ops import Op, INVOKE, OK, FAIL
 
 
 class History:

@@ -13,7 +13,7 @@ to build and scan on the host.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from typing import Any, Optional
 
 INVOKE = "invoke"

@@ -11,7 +11,7 @@ Models are immutable; ``step`` never mutates.
 """
 from __future__ import annotations
 
-from collections import Counter, deque
+from collections import Counter
 from dataclasses import dataclass
 from typing import Any, Tuple
 

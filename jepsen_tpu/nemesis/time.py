@@ -16,7 +16,6 @@ from .. import gen as g
 from ..client import Client
 from ..control.core import cd, exec_, su, upload_bytes
 from ..control.util import meh
-from ..utils.core import majority
 
 log = logging.getLogger("jepsen.nemesis.time")
 

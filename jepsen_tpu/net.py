@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .control.core import RemoteError, exec_, lit, on_nodes, su
+from .control.core import RemoteError, exec_, on_nodes, su
 
 TC = "/sbin/tc"
 

@@ -47,7 +47,7 @@ import numpy as np
 
 from ..history.ops import Op
 from ..models.core import Model
-from .encode import (EV_CLOSE, EV_OK, EncodedBatch, EncodeFailure,
+from .encode import (EV_CLOSE, EV_OK, EncodedBatch,
                      batch_encode, bucket_encode, encode_history,
                      slot_ops_at_event)
 

@@ -5,7 +5,6 @@ from __future__ import annotations
 
 import logging
 import re
-import time
 from typing import Dict, Optional, Sequence, Union
 
 from ..control.core import RemoteError, exec_, lit, su

@@ -27,7 +27,7 @@ from ..control import net_helpers
 from ..control import util as cu
 from ..db import DB
 from ..models.core import mutex
-from ..ops.folds import total_queue_checker_tpu, unique_ids_checker_tpu
+from ..ops.folds import unique_ids_checker_tpu
 from ..os_impl import debian
 from .local_common import ServiceClient, service_test
 

@@ -26,7 +26,7 @@ from ..control import core as c
 from ..control import util as cu
 from ..control.core import lit
 from ..db import DB
-from ..os_impl import debian, smartos
+from ..os_impl import smartos
 from ..runtime import primary, synchronize
 from .etcd import EtcdClient, workload as register_workload
 from .local_common import service_test

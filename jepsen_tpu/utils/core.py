@@ -7,7 +7,7 @@ import time as _time
 from fractions import Fraction
 from typing import Callable, List, Optional, Sequence, Tuple
 
-from ..history.ops import Op, INVOKE, OK, FAIL, INFO
+from ..history.ops import Op, OK, FAIL, INFO
 
 
 def majority(n: int) -> int:
