@@ -198,6 +198,36 @@ def subhistory(k, history: Sequence[Op]) -> List[Op]:
     return out
 
 
+def _key_subdir(opts, k) -> list:
+    """The per-key artifact directory, nested under any enclosing
+    subdirectory (so lifted checkers compose)."""
+    return list((opts or {}).get("subdirectory", [])) + [DIR, str(k)]
+
+
+def _write_key_artifacts(test, opts, k, h, r, *, render=False,
+                         model=None) -> None:
+    """Per-key store artifacts: results.json + the subhistory (and the
+    counterexample render when the caller didn't already produce one
+    via the lifted checker). Artifact IO must never alter an
+    already-computed verdict — any failure here is logged and
+    swallowed."""
+    store = (opts or {}).get("store") or test.get("store_handle")
+    if store is None:
+        return
+    try:
+        sub = _key_subdir(opts, k)
+        store.write_json(sub + ["results.json"], r)
+        store.write_history(sub + ["history"], h)
+        if render:
+            from .checkers.linear_report import write_analysis
+            write_analysis(test, model, h, r,
+                           {"store": store, "subdirectory": sub})
+    except Exception:
+        import logging
+        logging.getLogger("jepsen.independent").warning(
+            "per-key artifact write failed for key %r", k, exc_info=True)
+
+
 class IndependentChecker(Checker):
     """Lift a checker over v-values to one over KV-valued histories
     (independent.clj:246-295): check each key's subhistory; valid iff
@@ -212,14 +242,9 @@ class IndependentChecker(Checker):
         results = {}
         for k in history_keys(history):
             h = subhistory(k, history)
-            sub_opts = {**opts,
-                        "subdirectory": list(opts.get("subdirectory", []))
-                        + [DIR, str(k)]}
+            sub_opts = {**opts, "subdirectory": _key_subdir(opts, k)}
             r = check_safe(self.checker, test, model, h, sub_opts)
-            store = opts.get("store") or test.get("store_handle")
-            if store is not None:
-                store.write_json([DIR, str(k), "results.json"], r)
-                store.write_history([DIR, str(k), "history"], h)
+            _write_key_artifacts(test, opts, k, h, r)
             results[k] = r
         failures = [k for k, r in results.items()
                     if r.get("valid") is not True]
@@ -257,6 +282,14 @@ class BatchLinearizableChecker(Checker):
         results = dict(zip(ks, rs))
         failures = [k for k, r in results.items()
                     if r.get("valid") is not True]
+        # Per-key artifacts when a store is attached, matching the
+        # non-batch independent checker (results + subhistory), plus
+        # the counterexample render for invalid keys — the lifted
+        # checker isn't LinearizableChecker here, so the batch path
+        # renders itself (checker.clj:98-103's seam).
+        for k, sub, r in zip(ks, subs, rs):
+            _write_key_artifacts(test, opts, k, sub, r,
+                                 render=True, model=model)
         return {
             "valid": merge_valid(r["valid"] for r in results.values())
             if results else True,

@@ -200,3 +200,31 @@ def test_g2_gen_shape():
     o2 = g.op(gen, test, 0, c)
     o3 = g.op(gen, test, 1, c)
     assert o2["value"].key == o3["value"].key != k0
+
+
+def test_batch_checker_writes_per_key_artifacts(tmp_path):
+    """The device-batched independent checker mirrors the non-batch
+    path's per-key store artifacts, including the counterexample render
+    for invalid keys."""
+    from jepsen_tpu import independent
+    from jepsen_tpu.history.core import index as index_history
+    from jepsen_tpu.history.ops import invoke_op, ok_op
+    from jepsen_tpu.models.core import cas_register
+    from jepsen_tpu.store import Store
+
+    KV = independent.tuple_
+    h = index_history([
+        invoke_op(0, "write", KV(1, 3)), ok_op(0, "write", KV(1, 3)),
+        invoke_op(1, "read", None), ok_op(1, "read", KV(1, 3)),
+        invoke_op(0, "write", KV(2, 5)), ok_op(0, "write", KV(2, 5)),
+        invoke_op(1, "read", None), ok_op(1, "read", KV(2, 9)),
+    ])
+    handle = Store(base=tmp_path).create("batch-artifacts", ts="r0")
+    r = independent.batch_checker().check(
+        {"store_handle": handle}, cas_register(), h)
+    assert r["valid"] is False and r["failures"] == [2]
+    assert (handle.dir / "independent" / "1" / "results.json").exists()
+    assert (handle.dir / "independent" / "2" / "results.json").exists()
+    assert not (handle.dir / "independent" / "1" / "linear.svg").exists()
+    svg = (handle.dir / "independent" / "2" / "linear.svg").read_text()
+    assert "counterexample" in svg
