@@ -150,12 +150,54 @@ def lib():
             L.jt_encode.argtypes = [
                 i32p, i32p, i32p, u8p, ctypes.c_int32, ctypes.c_int32,
                 ctypes.c_int32, i32p, i32p, i32p, i32p]
+            i8p = ctypes.POINTER(ctypes.c_int8)
+            i16p = ctypes.POINTER(ctypes.c_int16)
+            L.jt_encode_walk.restype = None
+            L.jt_encode_walk.argtypes = [
+                i8p, i16p, i32p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, i8p, ctypes.c_void_p, ctypes.c_int32,
+                i32p, i32p, i32p, u8p, ctypes.c_int32]
             _lib = L
     return _lib
 
 
 def _ptr(a: np.ndarray, typ):
     return a.ctypes.data_as(ctypes.POINTER(typ))
+
+
+def encode_walk(typ: np.ndarray, proc: np.ndarray, kind: np.ndarray,
+                E: int, S: int, K: int, *,
+                n_threads: Optional[int] = None):
+    """The columnar encode slot-walk, natively (the C twin of the
+    per-line loop in ops.encode.encode_columnar; rows thread-parallel).
+    Returns (ev_slot, ev_slots, ev_opidx, max_live, n_events,
+    overflow) with the exact layouts/dtypes the numpy walk produces."""
+    L = lib()
+    B, N = typ.shape
+    typ = np.ascontiguousarray(typ, np.int8)
+    proc = np.ascontiguousarray(proc, np.int16)
+    kind = np.ascontiguousarray(kind, np.int32)
+    P = int(proc.max(initial=0)) + 1
+    slots_wide = K >= 127
+    slot_dtype = np.int32 if slots_wide else np.int8
+    ev_slot = np.zeros((B, E), np.int8)
+    ev_slots = np.full((B, E, S), K, slot_dtype)
+    ev_opidx = np.full((B, E), -1, np.int32)
+    max_live = np.zeros(B, np.int32)
+    cnt = np.zeros(B, np.int32)
+    overflow = np.zeros(B, np.uint8)
+    L.jt_encode_walk(
+        _ptr(typ, ctypes.c_int8), _ptr(proc, ctypes.c_int16),
+        _ptr(kind, ctypes.c_int32), B, N, E, S, K, P,
+        _ptr(ev_slot, ctypes.c_int8),
+        ev_slots.ctypes.data_as(ctypes.c_void_p),
+        1 if slots_wide else 0,
+        _ptr(ev_opidx, ctypes.c_int32), _ptr(max_live, ctypes.c_int32),
+        _ptr(cnt, ctypes.c_int32), _ptr(overflow, ctypes.c_uint8),
+        n_threads or min(16, os.cpu_count() or 1))
+    return ev_slot, ev_slots, ev_opidx, max_live, cnt + 1, \
+        overflow.astype(bool)
 
 
 class Lowered:

@@ -17,6 +17,7 @@
 // (jepsen_tpu/ops/linearize.py).
 //
 // Build: g++ -O3 -shared -fPIC -std=c++17 -o libjepsen_native.so wgl.cpp
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <thread>
@@ -294,6 +295,89 @@ int32_t jt_encode(const int32_t* ev_type, const int32_t* ev_proc,
   out_meta[0] = n_ok;
   out_meta[1] = slots.max_live;
   return 0;
+}
+
+// Columnar encode walk: the C twin of ops/encode.py encode_columnar's
+// per-line loop. Rows are independent, so the batch splits across
+// threads; per row it runs the slot-allocation walk (lowest free slot
+// per invoke, event emission per ok, overflow when the window exceeds
+// S) and writes the trailing close event. Callers prefill ev_slots
+// with the sentinel K and ev_opidx with -1.
+//   type  int8  [B, N]   (-1 pad / 0 invoke / 1 ok / 2 info)
+//   proc  int16 [B, N]
+//   kind  int32 [B, N]
+//   ev_slot  int8 [B, E]; ev_slots int8|int32 [B, E, S];
+//   ev_opidx int32 [B, E]; max_live/cnt int32 [B]; overflow uint8 [B]
+void jt_encode_walk(const int8_t* type, const int16_t* proc,
+                    const int32_t* kind, int64_t B, int64_t N, int64_t E,
+                    int32_t S, int32_t K, int32_t P, int8_t* ev_slot,
+                    void* ev_slots_v, int32_t slots_wide,
+                    int32_t* ev_opidx, int32_t* max_live, int32_t* cnt,
+                    uint8_t* overflow, int32_t n_threads) {
+  auto walk_row = [&](int64_t r) {
+    std::vector<int32_t> table((size_t)S, K);
+    std::vector<int32_t> slot_of((size_t)P, -1);
+    uint32_t free_mask =
+        (S >= 32) ? 0xFFFFFFFFu : ((uint32_t)1 << S) - 1;
+    int32_t live = 0, peak = 0, c = 0;
+    const int8_t* tr = type + r * N;
+    const int16_t* pr = proc + r * N;
+    const int32_t* kr = kind + r * N;
+    int8_t* es = ev_slot + r * E;
+    int32_t* eo = ev_opidx + r * E;
+    int8_t* s8 = slots_wide ? nullptr : (int8_t*)ev_slots_v + r * E * S;
+    int32_t* s32 = slots_wide ? (int32_t*)ev_slots_v + r * E * S
+                              : nullptr;
+    auto emit_table = [&](int64_t at) {
+      if (s8)
+        for (int32_t i = 0; i < S; ++i) s8[at * S + i] = (int8_t)table[i];
+      else
+        for (int32_t i = 0; i < S; ++i) s32[at * S + i] = table[i];
+    };
+    for (int64_t j = 0; j < N; ++j) {
+      int8_t t = tr[j];
+      if (t == 0) {  // invoke
+        if (free_mask == 0) {
+          overflow[r] = 1;
+          break;  // matches the numpy walk: state frozen at overflow,
+                  // trailing close still written (row is a failure)
+        }
+        uint32_t bit = free_mask & (~free_mask + 1u);
+        int32_t slot = __builtin_ctz(bit);
+        free_mask &= ~bit;
+        slot_of[(size_t)pr[j]] = slot;
+        table[(size_t)slot] = kr[j];
+        if (++live > peak) peak = live;
+      } else if (t == 1) {  // ok
+        int32_t slot = slot_of[(size_t)pr[j]];
+        if (slot < 0) continue;
+        es[c] = (int8_t)slot;
+        emit_table(c);
+        eo[c] = (int32_t)j;
+        table[(size_t)slot] = K;
+        free_mask |= (uint32_t)1 << slot;
+        slot_of[(size_t)pr[j]] = -1;
+        ++c;
+        --live;
+      }
+      // info: the pending slot stays pinned; nothing to track.
+    }
+    emit_table(c);  // trailing close/flush event
+    max_live[r] = peak;
+    cnt[r] = c;
+  };
+
+  if (n_threads <= 1 || B < 64) {
+    for (int64_t r = 0; r < B; ++r) walk_row(r);
+    return;
+  }
+  std::atomic<int64_t> next(0);
+  std::vector<std::thread> pool;
+  for (int32_t t = 0; t < n_threads; ++t)
+    pool.emplace_back([&] {
+      for (int64_t r; (r = next.fetch_add(1)) < B;) walk_row(r);
+    });
+  for (auto& th : pool) th.join();
 }
 
 }  // extern "C"

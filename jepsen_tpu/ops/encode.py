@@ -358,11 +358,13 @@ def batch_encode(model: Model, prepared_histories: Sequence[List[Op]], *,
 
 def encode_columnar(space: StateSpace, cols, *,
                     max_slots: int = 16, min_v: int = 8,
-                    min_w: int = 4) -> Tuple[List[EncodedBatch],
-                                             List[Tuple[int, str]]]:
+                    min_w: int = 4, native: bool = True
+                    ) -> Tuple[List[EncodedBatch],
+                               List[Tuple[int, str]]]:
     """Vectorized twin of ``bucket_encode`` for a ColumnarOps batch: the
-    slot walk runs once over the line axis with every history advancing
-    in lockstep (numpy row vectors), then rows bucket by exact pending
+    slot walk runs once over the line axis — threaded C
+    (native/wgl.cpp jt_encode_walk) when the native engine is
+    available, else numpy lockstep — then rows bucket by exact pending
     window W. Returns (buckets, failures) where failures are
     (row, reason) pairs for histories overflowing ``max_slots`` —
     callers route those to a host engine via columnar_to_ops.
@@ -377,6 +379,28 @@ def encode_columnar(space: StateSpace, cols, *,
     S = max_slots
     assert S <= 32
     K = space.n_kinds
+
+    if native:
+        walked = None
+        try:
+            from ..native import encode_walk
+            walked = encode_walk(cols.type, cols.process, cols.kind,
+                                 _round_up(N // 2 + 1, 8), S, K)
+        except (ImportError, RuntimeError, OSError):
+            # Can't build/load the native engine on this box: the numpy
+            # walk is the oracle. Anything else (e.g. a ctypes
+            # signature bug) must surface, not silently degrade.
+            import logging
+            logging.getLogger("jepsen.encode").warning(
+                "native encode walk unavailable; using numpy",
+                exc_info=True)
+        if walked is not None:
+            ev_slot, ev_slots, ev_opidx, max_live, n_events, overflow = \
+                walked
+            return _bucket_encoded(space, ev_slot, ev_slots, ev_opidx,
+                                   max_live, n_events, overflow,
+                                   B, S, K, min_v, min_w, max_slots)
+
     P = int(cols.process.max(initial=0)) + 1
 
     table = np.full((B, S), K,
@@ -438,6 +462,18 @@ def encode_columnar(space: StateSpace, cols, *,
     ev_slots[rows, cnt, :] = table
     n_events = cnt + 1
 
+    return _bucket_encoded(space, ev_slot, ev_slots, ev_opidx, max_live,
+                           n_events, overflow, B, S, K, min_v, min_w,
+                           max_slots)
+
+
+def _bucket_encoded(space, ev_slot, ev_slots, ev_opidx, max_live,
+                    n_events, overflow, B, S, K, min_v, min_w,
+                    max_slots):
+    """Bucket walked rows by exact pending window W (shared by the
+    native and numpy walks)."""
+    rows = np.arange(B)
+    cnt = n_events - 1
     failures = [(int(r), f"more than {max_slots} concurrently-pending ops")
                 for r in rows[overflow]]
     keep = ~overflow
