@@ -181,7 +181,8 @@ def run_cli(subcommands: Dict[str, dict],
 SUITE_OPT_KEYS = ("time_limit", "nemesis_mode", "persist", "n_ops",
                   "ops_per_key", "threads_per_key", "n_nodes",
                   "base_port", "casd_dir", "nemesis_cadence", "n_values",
-                  "split_ms", "accounts", "seed", "workload", "clock_skew",
+                  "split_ms", "accounts", "keys", "seed", "workload",
+                  "clock_skew",
                   "ts_wall", "serialized")
 
 
@@ -305,6 +306,9 @@ def suite_cmd() -> dict:
                        help="bank: seed the split-transfer race")
         p.add_argument("--accounts", dest="accounts", type=int,
                        default=None, help="bank: number of accounts")
+        p.add_argument("--keys", dest="keys", type=int, default=None,
+                       help="independent-set workloads (crate "
+                            "lost-updates): size of the key space")
         # Suites pick their own concurrency unless the user insists.
         p.set_defaults(concurrency=None, time_limit=None)
 
@@ -352,6 +356,11 @@ def suite_cmd() -> dict:
             return 254
         if kw.get("clock_skew") and kw.get("nemesis_mode") != "clock":
             print("--clock-skew requires --nemesis clock")
+            return 254
+        if kw.get("keys") is not None and not (
+                name == "crate" and workload == "lost-updates"):
+            print("--keys only applies to the crate lost-updates "
+                  "workload")
             return 254
         if d.get("concurrency") is not None:
             kw["concurrency"] = parse_concurrency(

@@ -173,3 +173,12 @@ def test_cli_round4_workload_dispatches(tmp_path):
         shutil.rmtree("/tmp/jepsen/elasticsearch-dirty",
                       ignore_errors=True)
     assert rc == 1
+
+
+def test_cli_keys_flag_scoped_to_lost_updates():
+    """--keys outside crate lost-updates is a usage error, not a silent
+    no-op (the scoped-flag discipline)."""
+    assert _main_rc(["test", "--suite", "etcd-casd", "--keys", "4",
+                     "--base-port", "25400"]) == 254
+    assert _main_rc(["test", "--suite", "crate", "--keys", "4",
+                     "--base-port", "25400"]) == 254   # register workload
