@@ -1,0 +1,42 @@
+"""bench.py contract smoke test.
+
+The driver consumes bench.py's single JSON line blind; a regression
+there loses the round's headline measurement. This runs the real
+script at toy scale (quick parity mode) and pins the contract: one
+JSON object on stdout with the metric/value/vs_baseline fields and
+truthful parity flags.
+"""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_bench_emits_contract_json():
+    env = dict(os.environ,
+               JT_BENCH_B="200", JT_BENCH_OPS="100",
+               JT_BENCH_REPEATS="1", JT_BENCH_FOLD_B="50",
+               JT_BENCH_STORE_B="20", JT_BENCH_CONVERTED="200",
+               JT_BENCH_FULL_PARITY="0")
+    r = subprocess.run([sys.executable, str(REPO / "bench.py")],
+                       capture_output=True, text=True, env=env,
+                       cwd=REPO, timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"exactly one JSON line expected: {lines}"
+    d = json.loads(lines[0])
+    assert d["metric"] == "linearizability_check_throughput_1kop_cas_e2e"
+    assert d["unit"] == "histories/sec"
+    assert d["value"] > 0 and d["vs_baseline"] > 0
+    assert d["histories"] == 200
+    assert d["ops_per_history"] == 200
+    # Quick mode must not claim full parity.
+    assert d["parity"]["full"] is False
+    assert d["parity"]["valid"] is True          # sampled check ran
+    assert d["converted_verdict_match"] is True
+    assert d["store_recheck_runs"] == 20
+    assert d["store_recheck_rate"] > 0
+    assert d["fold_histories"] == 50
