@@ -15,5 +15,6 @@ from .simple import (
     counter_checker,
 )
 from .linearizable import linearizable, LinearizableChecker
+from .brute import brute, brute_check, BruteChecker
 from .perf import latency_graph, perf, rate_graph_checker
 from .timeline import html_timeline

@@ -165,11 +165,13 @@ class LinearizableChecker(Checker):
     the native CPU engine against the device path and returns whichever
     finishes first — the knossos :competition analog (the reference
     exposes competition/linear/wgl at checker.clj:90-94; here every
-    engine runs the same WGL algorithm, so the race is across
-    hardware, not algorithms)."""
+    WGL engine runs the same algorithm, so that race is across
+    hardware, not algorithms); "brute" is the independent
+    permutation-search oracle (checkers/brute.py) — a genuinely
+    different algorithm, for small histories only."""
 
     def __init__(self, backend: str = "host", **kw):
-        assert backend in ("host", "native", "tpu", "competition")
+        assert backend in ("host", "native", "tpu", "competition", "brute")
         # Fail fast at construction if the backend isn't available.
         if backend in ("native", "competition"):
             from ..native import wgl_check_native  # noqa: F401
@@ -230,6 +232,9 @@ class LinearizableChecker(Checker):
             r = check_one_tpu(model, history, **self.kw)
         elif self.backend == "competition":
             r = self._compete(model, history)
+        elif self.backend == "brute":
+            from .brute import brute_check
+            r = brute_check(model, history, **self.kw)
         else:
             raise AssertionError
         # Invalid analyses render to linear.svg in the run dir when a
