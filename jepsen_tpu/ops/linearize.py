@@ -604,7 +604,7 @@ def check_one_tpu(model: Model, history: List[Op], **kw) -> dict:
 
 
 def check_columnar(model: Model, cols, *, max_slots: int = 16,
-                   host_fallback=None, details: bool = False,
+                   host_fallback=None, details=False,
                    min_device_batch: int = 1):
     """Device-check a ColumnarOps batch end-to-end at tensor speed.
 
@@ -620,12 +620,18 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
     matching the host engine's shape — {"valid", "op", "configs"} with
     the reference's truncate-to-10 config-sample discipline
     (checker.clj:104-107) — decoded from the latched device frontiers.
+    ``details="invalid"`` decodes lazily: only invalid rows pay the
+    per-row Python replay walk; valid rows return {"valid": True} bare.
+    The reference renders analysis only for invalid results
+    (checker.clj:98-103), so this is the replay product path's mode —
+    it keeps the batch at tensor speed when most rows are clean.
     """
     from ..checkers.linearizable import wgl_check
     from ..history.columnar import columnar_to_ops
     from .encode import encode_columnar
     from .statespace import enumerate_statespace
 
+    assert details in (False, True, "invalid"), details
     space = enumerate_statespace(model, cols.kinds, MAX_PACKED_STATES)
     eff_slots = max_slots + (device_frontier_capacity()
                              if max_slots >= DATA_MAX_SLOTS else 0)
@@ -672,6 +678,9 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
         if details:
             from ..history.core import complete
             for bi, row in enumerate(batch.indices):
+                if details == "invalid" and bool(v[bi]):
+                    results[row] = {"valid": True}
+                    continue
                 # Propagate observations back onto invokes so the replay
                 # walk sees the same op kinds the encoder did.
                 ops = complete(columnar_to_ops(cols, row))
@@ -694,13 +703,15 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
 
 def check_batch_columnar(model: Model, histories: Sequence[List[Op]], *,
                          max_slots: int = 16, max_states: int = 64,
-                         host_fallback=None,
+                         host_fallback=None, details=True,
                          min_device_batch: int = 1) -> List[dict]:
     """Check recorded Op-list histories through the columnar fast path:
     one fused conversion walk (history.columnar.ops_to_columnar), one
     vectorized encode, one device dispatch per cost bucket. Falls back
     to the per-history path (``check_batch_tpu``) when the shared
-    vocabulary's state space explodes. Per-history result dicts."""
+    vocabulary's state space explodes. Per-history result dicts;
+    ``details="invalid"`` skips the valid rows' Python decode (see
+    check_columnar)."""
     from ..history.columnar import ops_to_columnar
     from .statespace import StateSpaceExplosion
 
@@ -715,6 +726,7 @@ def check_batch_columnar(model: Model, histories: Sequence[List[Op]], *,
                                max_slots=max_slots,
                                host_fallback=host_fallback,
                                min_device_batch=min_device_batch)
-    return check_columnar(model, cols, max_slots=max_slots, details=True,
+    assert details in (True, "invalid"), details   # contract: List[dict]
+    return check_columnar(model, cols, max_slots=max_slots, details=details,
                           host_fallback=host_fallback,
                           min_device_batch=min_device_batch)

@@ -225,7 +225,11 @@ class Store:
                         "error": f"no stored histories for {test_name!r}"}
             try:
                 cols = jsonl_to_columnar(model, texts)
-                rs = check_columnar(model, cols, details=True)
+                # Lazy details: only invalid rows pay the Python replay
+                # decode — valid rows stay at tensor speed, matching the
+                # reference's render-only-failures discipline
+                # (checker.clj:98-103).
+                rs = check_columnar(model, cols, details="invalid")
             except StateSpaceExplosion:
                 # Vocabulary too rich for the packed table: degrade to
                 # the Op-list path, whose batch checker falls back to
@@ -233,7 +237,8 @@ class Store:
                 units = [loaded["history"] for t in ts
                          if "history" in
                          (loaded := self.load(test_name, t))]
-                rs = check_batch_columnar(model, units)
+                rs = check_batch_columnar(model, units,
+                                          details="invalid")
         else:
             units, labels = [], []
             for t in ts:
@@ -250,7 +255,7 @@ class Store:
                 # histories to check".
                 return {"valid": "unknown", "runs": {},
                         "error": f"no stored histories for {test_name!r}"}
-            rs = check_batch_columnar(model, units)
+            rs = check_batch_columnar(model, units, details="invalid")
         runs: Dict[str, dict] = {}
         for (t, k), r in zip(labels, rs):
             run = runs.setdefault(t, {"results": {}})

@@ -356,3 +356,44 @@ def test_store_recheck_survives_statespace_explosion(tmp_path, model):
     hd.save_history(h)
     rr = store.recheck("boom", model)
     assert rr["valid"] is True, rr
+
+
+def test_details_invalid_mode_is_lazy_but_complete(model, hists):
+    """details="invalid" (the replay product path's mode): valid rows
+    skip the Python replay decode entirely; invalid rows still carry
+    the full counterexample contract — op + config sample identical to
+    full-details mode."""
+    full = check_batch_columnar(model, hists)
+    lazy = check_batch_columnar(model, hists, details="invalid")
+    n_bare = 0
+    for i, (f, l) in enumerate(zip(full, lazy, strict=True)):
+        assert (f["valid"] is True) == (l["valid"] is True), i
+        if f["valid"] is True:
+            if "configs" not in l:
+                n_bare += 1
+        else:
+            assert l["op"]["index"] == f["op"]["index"], i
+            assert l["configs"] == f["configs"], i
+    assert n_bare > 0         # the lazy path really skipped valid decode
+
+
+def test_recheck_invalid_rows_keep_counterexamples(tmp_path, model):
+    """Store.recheck rides the lazy mode; a stored violation must still
+    come back with the impossible op, not a bare verdict."""
+    from jepsen_tpu.store import Store
+
+    bad = index_history([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "read", None), ok_op(1, "read", 2)])
+    good = index_history([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "read", None), ok_op(1, "read", 1)])
+    store = Store(base=tmp_path)
+    store.create("lazy", ts="r0").save_history(bad)
+    store.create("lazy", ts="r1").save_history(good)
+    rr = store.recheck("lazy", model)
+    assert rr["valid"] is False
+    r_bad = rr["runs"]["r0"]["results"]["history"]
+    assert r_bad["valid"] is False and r_bad["op"]["index"] == 3
+    assert "configs" in r_bad
+    assert rr["runs"]["r1"]["results"]["history"] == {"valid": True}
