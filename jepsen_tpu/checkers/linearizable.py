@@ -222,7 +222,17 @@ class LinearizableChecker(Checker):
             ex.shutdown(wait=False)
 
     def check(self, test, model, history, opts=None) -> dict:
-        if self.backend == "host":
+        # Seeded batch mode: the runner may have pooled this unit's
+        # verdict into one cross-run device dispatch (runtime.LinearPool);
+        # a pool miss computes normally — pooling is an accelerator,
+        # never a correctness gate. The brute backend NEVER consults the
+        # pool: its whole purpose is an independently-derived verdict,
+        # and the pool holds WGL results.
+        pooled = (None if self.backend == "brute"
+                  else _pooled_result(test, opts))
+        if pooled is not None:
+            r = pooled
+        elif self.backend == "host":
             r = wgl_check(model, history, **self.kw)
         elif self.backend == "native":
             from ..native import wgl_check_native
@@ -249,6 +259,18 @@ class LinearizableChecker(Checker):
             logging.getLogger("jepsen.checker").warning(
                 "linear.svg render failed", exc_info=True)
         return r
+
+
+def _pooled_result(test, opts) -> Optional[dict]:
+    """Look up this check's unit in the seeded-batch LinearPool, if one
+    is armed on the test map. The unit key is the independent key when
+    this checker runs lifted under independent.checker (threaded via
+    opts), else None for the whole history. Returns a copy
+    (LinearPool.take) so consumers never alias the pool."""
+    pool = test.get("_linear_pool") if isinstance(test, dict) else None
+    if pool is None:
+        return None
+    return pool.take(test, (opts or {}).get("independent_key"))
 
 
 def linearizable(backend: str = "host", **kw) -> Checker:

@@ -309,6 +309,11 @@ def suite_cmd() -> dict:
         p.add_argument("--keys", dest="keys", type=int, default=None,
                        help="independent-set workloads (crate "
                             "lost-updates): size of the key space")
+        p.add_argument("--seeds", type=int, default=None,
+                       help="Batch mode: replay the suite's generator "
+                            "under N nemesis seeds and pool every "
+                            "run's linearizability analysis into one "
+                            "device dispatch (north-star batch mode)")
         # Suites pick their own concurrency unless the user insists.
         p.set_defaults(concurrency=None, time_limit=None)
 
@@ -375,12 +380,44 @@ def suite_cmd() -> dict:
                       concurrency=m["concurrency"],
                       time_limit=m["time_limit"])
         builder = suite_registry()[name]
+        if d.get("seeds"):
+            if d["test_count"] != 1:
+                print("--seeds replaces --test-count (one batch of N "
+                      "seeded runs)")
+                return 254
+            return _run_seeded_batch(builder, kw, d["seeds"],
+                                     d.get("seed") or 0, d["no_store"])
         for _ in range(d["test_count"]):
             if not _run_built_test(builder(dict(kw)), d["no_store"]):
                 return 1
         return 0
 
     return {"test": {"add_opts": add_opts, "run": run}}
+
+
+def _run_seeded_batch(builder: Callable, kw: dict, n_seeds: int,
+                      base_seed: int, no_store: bool) -> int:
+    """Run one suite under N nemesis seeds, pooling all analyses into
+    one device dispatch (runtime.run_seeds). Prints one JSON line of
+    per-seed verdicts + store dirs; exit 1 unless every seed is valid."""
+    import json as _json
+
+    from . import runtime
+
+    seeds = [base_seed + i for i in range(n_seeds)]
+    tests = runtime.run_seeds(lambda s: builder(dict(kw, seed=s)), seeds,
+                              store=not no_store)
+    out = {"seeds": {}, "valid": True}
+    for s, t in zip(seeds, tests):
+        v = (t.get("results") or {}).get("valid")
+        handle = t.get("store_handle")
+        out["seeds"][str(s)] = {
+            "valid": v,
+            **({"dir": str(handle.dir)} if handle is not None else {})}
+        if v is not True:
+            out["valid"] = False
+    print(_json.dumps(out, default=str))
+    return 0 if out["valid"] else 1
 
 
 def recheck_cmd() -> dict:

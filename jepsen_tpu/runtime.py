@@ -273,9 +273,11 @@ def _on_nodes_local(test: dict, f: Callable) -> None:
         raise errs[0]
 
 
-def run(test: dict) -> dict:
+def run(test: dict, analyze: bool = True) -> dict:
     """Run a complete test; returns the test dict with :history and
-    :results filled in (core.clj:329-436)."""
+    :results filled in (core.clj:329-436). ``analyze=False`` stops
+    after the history is recorded and persisted — the batch mode
+    (run_seeds) pools the analysis phase across runs."""
     test = dict(test)
     nodes = test.get("nodes") or []
     test.setdefault("concurrency", max(1, len(nodes)))
@@ -315,6 +317,16 @@ def run(test: dict) -> dict:
             if os_ is not None:
                 _on_nodes_local(test, os_.teardown)
 
+    if not analyze:
+        return test
+    return analyze_run(test)
+
+
+def analyze_run(test: dict) -> dict:
+    """Analysis phase: run the checker over the recorded history and
+    persist results (core.clj:414-436's tail). Split from ``run`` so
+    the seeded batch mode can pool device dispatches across runs."""
+    store = test.get("store_handle")
     test["results"] = check_safe(test.get("checker"), test,
                                  test.get("model"), test["history"])
     if store is not None:
@@ -322,3 +334,125 @@ def run(test: dict) -> dict:
     valid = test["results"].get("valid")
     log.info("Analysis complete: valid? = %s", valid)
     return test
+
+
+class LinearPool:
+    """Precomputed linearizability verdicts for a batch of seeded runs.
+
+    ``results`` maps (run_index, independent_key_or_None) -> result
+    dict. The linearizable checkers consult the pool (via the
+    ``_linear_pool`` / ``_pool_run`` test keys) before dispatching an
+    engine; a miss falls back to normal computation, so the pool is an
+    accelerator, never a correctness gate."""
+
+    def __init__(self):
+        self.results: dict = {}
+
+    def take(self, test: dict, key) -> Optional[dict]:
+        """Pooled result for this test's (run, key) unit, copied so a
+        consumer's later mutation (render fields, fallback notes) can't
+        alias the pool or another consumer's view."""
+        r = self.results.get((test.get("_pool_run"), key))
+        return dict(r) if r is not None else None
+
+
+def _linear_unit_kinds(checker) -> tuple:
+    """(per_key, whole): which unit shapes the checker tree will ask
+    the pool for — per-key subhistories (independent linearizable
+    lifts) and/or the whole history (plain linearizable)."""
+    from .checkers.core import Compose
+    from .checkers.linearizable import LinearizableChecker
+    from .independent import BatchLinearizableChecker, IndependentChecker
+
+    per_key = whole = False
+
+    def walk(c, lifted):
+        nonlocal per_key, whole
+        if isinstance(c, Compose):
+            for sub in c.checker_map.values():
+                walk(sub, lifted)
+        elif isinstance(c, BatchLinearizableChecker):
+            per_key = True
+        elif isinstance(c, IndependentChecker):
+            walk(c.checker, True)
+        elif isinstance(c, LinearizableChecker):
+            if c.backend == "brute":
+                return       # independent oracle: never pooled (it
+                             # would just echo the WGL verdict back)
+            if lifted:
+                per_key = True
+            else:
+                whole = True
+
+    walk(checker, False)
+    return per_key, whole
+
+
+def run_seeds(builder: Callable[[int], dict], seeds,
+              store: bool = True) -> List[dict]:
+    """The north-star batch mode (BASELINE.md): replay one generator
+    under N nemesis seeds and feed the whole history batch to ONE
+    pooled device dispatch.
+
+    ``builder(seed)`` -> test map. Each seed's test executes in full
+    (own cluster lifecycle, own store dir); the linearizability units
+    of ALL runs — per-key subhistories for independent workloads, whole
+    histories otherwise — then ride one check_batch_columnar call, and
+    each run's checker composition consumes the pooled verdicts during
+    its normal analysis (perf/timeline/artifacts unchanged). Returns
+    the list of completed test maps with per-seed ``results``.
+
+    The reference's run! checks each run as it completes
+    (core.clj:329-436); pooling the batch axis across seeds is the
+    device-native reformulation this framework exists for.
+    """
+    from .independent import history_keys, subhistory
+
+    tests: List[dict] = []
+    handles: List = []
+    try:
+        for s in seeds:
+            t = builder(s)
+            if store:
+                from . import store as store_mod
+                store_mod.attach(t)
+            # Record the handle BEFORE running: a mid-batch crash must
+            # still detach this run's log handler in the finally below.
+            if t.get("store_handle") is not None:
+                handles.append(t["store_handle"])
+            tests.append(run(t, analyze=False))
+
+        assert all(t.get("model") == tests[0].get("model")
+                   for t in tests), \
+            "run_seeds pools one model across seeds; builder returned " \
+            "seed-dependent models"
+        pool = LinearPool()
+        units, labels = [], []
+        for i, t in enumerate(tests):
+            t["_linear_pool"], t["_pool_run"] = pool, i
+            per_key, whole = _linear_unit_kinds(t.get("checker"))
+            h = t["history"]
+            if per_key:
+                for k in history_keys(h):
+                    units.append(subhistory(k, h))
+                    labels.append((i, k))
+            if whole:
+                units.append(h)
+                labels.append((i, None))
+        model = tests[0].get("model") if tests else None
+        if units and model is not None:
+            from .ops.linearize import check_batch_columnar
+            # Full details: pooled results must be indistinguishable
+            # from what each run's checker would have computed itself
+            # (per-key artifacts included) — pooling changes the
+            # dispatch count, never the outputs.
+            rs = check_batch_columnar(model, units, details=True)
+            pool.results = dict(zip(labels, rs))
+            log.info("Pooled linearizability dispatch: %d units across "
+                     "%d seeded runs", len(units), len(tests))
+        for t in tests:
+            analyze_run(t)
+    finally:
+        for handle in handles:
+            handle.stop_logging()
+    return tests

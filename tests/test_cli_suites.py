@@ -182,3 +182,61 @@ def test_cli_keys_flag_scoped_to_lost_updates():
                      "--base-port", "25400"]) == 254
     assert _main_rc(["test", "--suite", "crate", "--keys", "4",
                      "--base-port", "25400"]) == 254   # register workload
+
+
+def test_cli_seeds_batch_mode(tmp_path, capsys, monkeypatch):
+    """--seeds N: the north-star batch mode from argv. One pooled
+    check_batch_columnar dispatch covers every run's keys (DISPATCH_LOG
+    shows pooled buckets, not N singleton dispatches); per-seed
+    verdicts + store dirs land in one JSON line and match re-checking
+    each stored run individually."""
+    import json
+    from pathlib import Path
+
+    import jepsen_tpu.ops.linearize as lin
+    from jepsen_tpu.independent import history_keys
+    from jepsen_tpu.models.core import cas_register
+    from jepsen_tpu.store import Store
+    from jepsen_tpu.suites.etcd import ABSENT
+
+    calls = []
+    real = lin.check_batch_columnar
+
+    def counting(model, units, **kw):
+        calls.append(len(units))
+        return real(model, units, **kw)
+
+    monkeypatch.setattr(lin, "check_batch_columnar", counting)
+    log_before = len(lin.DISPATCH_LOG)
+
+    rc = _main_rc(["test", "--suite", "etcd-casd", "--n-ops", "40",
+                   "--ops-per-key", "20", "--threads-per-key", "2",
+                   "--base-port", "25240", "--time-limit", "8",
+                   "--seeds", "3"])
+    assert rc == 0
+    assert len(calls) == 1, calls          # ONE pooled dispatch
+    total_units = calls[0]
+
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["valid"] is True
+    assert set(out["seeds"]) == {"0", "1", "2"}
+    for info in out["seeds"].values():
+        assert info["valid"] is True
+        assert Path(info["dir"]).exists()
+
+    # Pooled buckets: at least one logged device bucket holds more rows
+    # than any single run contributes.
+    store = Store("store")
+    per_run_keys = [len(history_keys(h))
+                    for h in store.load_histories("etcd-casd")]
+    assert len(per_run_keys) == 3 and sum(per_run_keys) == total_units
+    new_batches = [b for (_, _, _, b)
+                   in list(lin.DISPATCH_LOG)[log_before:]]
+    assert max(new_batches) > max(per_run_keys), (new_batches,
+                                                  per_run_keys)
+
+    # Per-seed verdicts match individually-checked stored runs.
+    rr = store.recheck("etcd-casd", cas_register(ABSENT),
+                       independent=True)
+    assert len(rr["runs"]) == 3
+    assert all(r["valid"] is True for r in rr["runs"].values())

@@ -140,3 +140,98 @@ def test_client_node_striping():
     run(noop_test(nodes=["n1", "n2", "n3"], concurrency=5, client=Probe(),
                   generator=g.clients(g.limit(5, {"f": "ping"}))))
     assert sorted(nodes_seen) == ["n1", "n1", "n2", "n2", "n3"]
+
+
+# --------------------------------------------- seeded batch mode (run_seeds)
+
+class LyingAtomClient(AtomClient):
+    """An atom client that corrupts one read — a deterministic seeded
+    violation for the batch-mode tests."""
+
+    def __init__(self, register=None, lie=False):
+        super().__init__(register)
+        self.lie = lie
+        self.n = 0
+
+    def setup(self, test, node):
+        cl = LyingAtomClient(self.register, self.lie)
+        return cl
+
+    def invoke(self, test, op):
+        out = super().invoke(test, op)
+        if self.lie and out["f"] == "read" and out["type"] == "ok":
+            self.lie = False           # exactly one corrupt observation
+            out = {**out, "value": 999}
+        return out
+
+
+def test_run_seeds_pools_one_dispatch(monkeypatch):
+    """North-star batch mode: N seeded runs, ONE pooled device dispatch,
+    per-seed verdicts identical to individually-checked runs."""
+    import jepsen_tpu.ops.linearize as lin
+    from jepsen_tpu.checkers.linearizable import wgl_check
+    from jepsen_tpu.runtime import run_seeds
+
+    calls = []
+    real = lin.check_batch_columnar
+
+    def counting(model, units, **kw):
+        calls.append(len(units))
+        return real(model, units, **kw)
+
+    monkeypatch.setattr(lin, "check_batch_columnar", counting)
+
+    def build(seed):
+        reg = AtomRegister()
+        return atom_cas_test(n_ops=40, concurrency=3, seed=seed,
+                             client=LyingAtomClient(reg, lie=(seed == 1)))
+
+    tests = run_seeds(build, [0, 1, 2], store=False)
+    # ONE pooled dispatch covering all three whole histories — not
+    # three singleton engine calls.
+    assert calls == [3]
+    verdicts = [t["results"]["valid"] for t in tests]
+    assert verdicts == [True, False, True]
+    for t in tests:
+        want = wgl_check(t["model"], t["history"])["valid"]
+        assert t["results"]["valid"] is want
+        # the pooled run reused the seeded generator ctx
+        assert t["rng"] is not None
+
+
+def test_run_seeds_pool_miss_recomputes(monkeypatch):
+    """A pool miss must fall back to normal computation, never return
+    a wrong or missing verdict."""
+    from jepsen_tpu.runtime import LinearPool, analyze_run, run
+
+    t = run(atom_cas_test(n_ops=20, concurrency=2, seed=5), analyze=False)
+    t["_linear_pool"], t["_pool_run"] = LinearPool(), 0   # empty pool
+    analyze_run(t)
+    assert t["results"]["valid"] is True
+
+
+def test_run_seeds_never_pools_the_brute_oracle():
+    """The independent permutation-search oracle must derive its own
+    verdict even in seeded-batch mode — a pooled WGL result handed to
+    it would close the cross-derivation loop the oracle exists to
+    break."""
+    from jepsen_tpu.checkers.core import compose
+    from jepsen_tpu.checkers.linearizable import linearizable
+    from jepsen_tpu.runtime import LinearPool, _linear_unit_kinds
+
+    chk = compose({"wgl": linearizable(),
+                   "oracle": linearizable(backend="brute")})
+    per_key, whole = _linear_unit_kinds(chk)
+    assert whole is True            # the WGL checker pools
+    # ...and the brute checker ignores an armed pool outright:
+    pool = LinearPool()
+    pool.results[(0, None)] = {"valid": False, "op": {"index": 0}}
+    test = {"_linear_pool": pool, "_pool_run": 0}
+    from jepsen_tpu.history.core import index
+    from jepsen_tpu.history.ops import invoke_op, ok_op
+    from jepsen_tpu.models.core import cas_register
+    h = index([invoke_op(0, "write", 1), ok_op(0, "write", 1)])
+    r = linearizable(backend="brute").check(test, cas_register(), h)
+    assert r["valid"] is True       # derived, not the pool's False
+    r2 = linearizable().check(test, cas_register(), h)
+    assert r2["valid"] is False     # the WGL checker DID consume it
