@@ -182,7 +182,7 @@ SUITE_OPT_KEYS = ("time_limit", "nemesis_mode", "persist", "n_ops",
                   "ops_per_key", "threads_per_key", "n_nodes",
                   "base_port", "casd_dir", "nemesis_cadence", "n_values",
                   "split_ms", "accounts", "keys", "seed", "workload",
-                  "clock_skew",
+                  "clock_skew", "wipe_after_ops",
                   "ts_wall", "serialized")
 
 
@@ -309,6 +309,12 @@ def suite_cmd() -> dict:
         p.add_argument("--keys", dest="keys", type=int, default=None,
                        help="independent-set workloads (crate "
                             "lost-updates): size of the key space")
+        p.add_argument("--wipe-after-ops", dest="wipe_after_ops",
+                       type=int, default=None,
+                       help="Deterministic seeded data loss: the local "
+                            "daemon drops all in-memory state when its "
+                            "Nth mutating request arrives (casd "
+                            "--wipe-after-ops)")
         p.add_argument("--seeds", type=int, default=None,
                        help="Batch mode: replay the suite's generator "
                             "under N nemesis seeds and pool every "

@@ -27,7 +27,17 @@
 // locks double-grant, a reset id counter duplicates, wiped queues/sets
 // lose elements, a wiped counter under-reads.
 //
+// Deterministic fault seeding: --wipe-after-ops N drops ALL in-memory
+// state the instant the Nth mutating request arrives (before serving
+// it) — exactly the data loss a kill -9 + restart of a non-persistent
+// node causes, but at a point fixed by the workload's own op count
+// instead of a wall-clock race between nemesis cadence and workload
+// phase. Fault-detection tests use it so their seeded violations are
+// deterministic under any scheduler load; the kill/pause nemeses still
+// exercise the process-control paths on top.
+//
 // Usage: casd --port P [--persist FILE] [--delay-ms N]
+//             [--wipe-after-ops N]
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -36,6 +46,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <atomic>
 #include <chrono>
 #include <cstdio>
 #include <cstring>
@@ -86,6 +97,59 @@ int g_dirty_split_ms = 0;
 long g_index = 0;
 std::string g_persist_path;
 int g_delay_ms = 0;
+// --wipe-after-ops: deterministic seeded data loss (see file header).
+long g_wipe_after_ops = 0;
+std::atomic<long> g_mutations_seen{0};
+std::atomic<bool> g_wiped{false};
+// Bumped by every wipe so a writer sleeping with the lock released
+// (split-ms seeded races) can tell its world changed and die like a
+// crashed writer instead of resurrecting pre-wipe state.
+std::atomic<long> g_wipe_epoch{0};
+
+// The mutation count must survive kill+restart (the nemesis restarts
+// this process with the same argv and cwd): a fresh counter would let
+// a fast kill cadence keep every lifetime under N and silently turn
+// the deterministic wipe back into a timing race. Not the WAL — this
+// is harness bookkeeping, not replayable state.
+const char* WIPE_STATE_FILE = "casd-wipe.state";
+
+void save_wipe_state() {
+  std::ofstream f(WIPE_STATE_FILE, std::ios::trunc);
+  f << g_mutations_seen.load() << " " << (g_wiped.load() ? 1 : 0) << "\n";
+  f.flush();
+}
+
+void load_wipe_state() {
+  std::ifstream f(WIPE_STATE_FILE);
+  long seen = 0;
+  int wiped = 0;
+  if (f >> seen >> wiped) {
+    g_mutations_seen = seen;
+    g_wiped = wiped != 0;
+  }
+}
+
+// Drop every piece of in-memory state a kill -9 of a non-persistent
+// node would lose. The WAL file (if any) is untouched — this is a
+// memory wipe, not a disk wipe; the clock offset survives because it
+// models the NODE's clock, not process state.
+void wipe_all_state() {
+  g_store.clear();
+  g_locks.clear();
+  g_counters.clear();
+  g_queues.clear();
+  g_sets.clear();
+  g_banks.clear();
+  g_dirty.clear();
+  g_kv.clear();
+  g_kv_index.clear();
+  g_next_id = 0;
+  g_next_ts = 0;
+  g_ts_seq = 0;
+  g_kv_counter = 0;
+  g_index = 0;
+  ++g_wipe_epoch;
+}
 
 const char* B64 =
     "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
@@ -509,7 +573,15 @@ void handle_bank(int fd, Request& req, const std::string& name) {
           std::chrono::milliseconds(g_bank_split_ms));
       lock.lock();
     }
-    bank[to] += amount;
+    // Re-look-up after the unlocked window: a --wipe-after-ops wipe
+    // may have dropped the bank; die like a crashed mid-transfer
+    // writer rather than dereference the stale node or resurrect it.
+    auto wt = g_banks.find(name);
+    if (wt == g_banks.end() || wt->second.find(to) == wt->second.end()) {
+      respond(fd, 409, "{\"error\":\"wiped mid-transfer\"}");
+      return;
+    }
+    wt->second[to] += amount;
     plog('T', name, std::to_string(from) + ":" + std::to_string(to) +
                         ":" + std::to_string(amount));
     respond(fd, 200, "{\"ok\":true}");
@@ -538,7 +610,14 @@ void handle_bank(int fd, Request& req, const std::string& name) {
           std::chrono::milliseconds(g_bank_split_ms));
       lock.lock();
     }
-    g_banks[tob][0] += amount;
+    // Same re-look-up discipline as transfer: never resurrect a
+    // wiped bank through operator[].
+    auto xt = g_banks.find(tob);
+    if (xt == g_banks.end()) {
+      respond(fd, 409, "{\"error\":\"wiped mid-transfer\"}");
+      return;
+    }
+    xt->second[0] += amount;
     plog('M', fromb, tob + ":" + std::to_string(amount));
     respond(fd, 200, "{\"ok\":true}");
   } else if (op == "xread") {
@@ -631,8 +710,18 @@ void handle_dirty(int fd, Request& req, const std::string& name) {
       // Row at a time with the lock dropped in between; an abort stops
       // after the first half, leaving its rows visible (the bug).
       size_t upto = abort ? n / 2 : n;
+      long epoch = g_wipe_epoch.load();
       for (size_t i = 0; i < upto; ++i) {
-        g_dirty[name][i] = x;
+        // Re-look-up after every relock: a concurrent wipe
+        // (--wipe-after-ops) may have dropped — or a client re-init
+        // recreated — the table mid-write; the epoch check makes the
+        // writer die like a crashed one either way rather than write
+        // pre-wipe values into a post-wipe table.
+        auto jt = g_dirty.find(name);
+        if (g_wipe_epoch.load() != epoch || jt == g_dirty.end() ||
+            i >= jt->second.size())
+          break;
+        jt->second[i] = x;
         lock.unlock();
         std::this_thread::sleep_for(
             std::chrono::milliseconds(g_dirty_split_ms));
@@ -665,6 +754,18 @@ void handle(int fd) {
   if (read_request(fd, &req)) {
     if (g_delay_ms > 0)
       std::this_thread::sleep_for(std::chrono::milliseconds(g_delay_ms));
+    // Deterministic seeded wipe: when the Nth mutating request arrives
+    // (counted across restarts via casd-wipe.state), all in-memory
+    // state vanishes BEFORE it is served — mutations 1..N-1 are the
+    // acknowledged-then-lost prefix.
+    if (g_wipe_after_ops > 0 && req.method != "GET" &&
+        req.path != "/health") {
+      std::lock_guard<std::mutex> lock(g_mu);
+      long n = ++g_mutations_seen;
+      if (n >= g_wipe_after_ops && !g_wiped.exchange(true))
+        wipe_all_state();
+      save_wipe_state();
+    }
     const std::string prefix = "/v2/keys/";
     std::string bank_name;
     if (req.path == "/health") {
@@ -734,7 +835,10 @@ int main(int argc, char** argv) {
       g_bank_split_ms = atoi(argv[i + 1]);
     if (!strcmp(argv[i], "--dirty-split-ms"))
       g_dirty_split_ms = atoi(argv[i + 1]);
+    if (!strcmp(argv[i], "--wipe-after-ops"))
+      g_wipe_after_ops = atol(argv[i + 1]);
   }
+  if (g_wipe_after_ops > 0) load_wipe_state();
   replay();
   signal(SIGPIPE, SIG_IGN);
 

@@ -104,6 +104,17 @@ class EtcdDB(DB):
         return [f"{DIR}/etcd.log"]
 
 
+def resolve_daemon_args(daemon_args, opts: dict) -> list:
+    """Suite-level fault knobs that translate to casd flags.
+    ``wipe_after_ops``: deterministic seeded data loss (casd
+    --wipe-after-ops) — the violation no longer depends on a nemesis
+    kill racing the workload phase under scheduler load."""
+    args = list(daemon_args)
+    if opts.get("wipe_after_ops"):
+        args += ["--wipe-after-ops", str(opts["wipe_after_ops"])]
+    return args
+
+
 class CasdDB(DB):
     """The local-mode stand-in: compile the shipped casd source on the
     node and run it under start-stop-daemon. One instance per logical
@@ -348,7 +359,7 @@ def _casd_restarter(db: CasdDB, targeter=None) -> Client:
 
 
 def casd_test(nemesis_mode: str = "pause", persist: bool = True,
-              **opts) -> dict:
+              daemon_args=(), **opts) -> dict:
     """The local-mode etcd-suite test: N real casd processes on
     localhost ports, driven through the LocalTransport. ``nemesis_mode``:
     "pause" (SIGSTOP hammer), "restart" (kill -9 + restart), or None.
@@ -362,7 +373,8 @@ def casd_test(nemesis_mode: str = "pause", persist: bool = True,
     nodes = [f"n{i + 1}" for i in range(n)]
     base = opts.get("base_port", 23790)
     ports = {node: base + i for i, node in enumerate(nodes)}
-    db = CasdDB(persist=persist)
+    db = CasdDB(persist=persist,
+                extra_args=resolve_daemon_args(daemon_args, opts))
     concurrency = derive_concurrency(n, opts.get("threads_per_key", 5),
                                      opts.get("concurrency"))
     test = noop_test(

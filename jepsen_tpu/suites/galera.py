@@ -185,6 +185,11 @@ class DirtyReadsChecker:
                 "dirty-count": len(filthy)}
 
 
+# The drain phase's aborted write uses a value no _dirty_gen counter
+# reaches, so the checker attributes it unambiguously.
+DRAIN_WRITE_VALUE = 999_999_999
+
+
 def _dirty_gen(abort_every: int):
     """Reads vs unique-value writes; every ``abort_every``-th write
     requests a rollback (the reference's aborts come from deadlock
@@ -210,9 +215,20 @@ def _dirty_gen(abort_every: int):
 def dirty_reads_workload(opts: dict) -> dict:
     from .. import gen as g
     n_ops = opts.get("n_ops", 200)
+    main = g.limit(n_ops, g.stagger(
+        1 / 100, _dirty_gen(opts.get("abort_every", 4))))
+    # Drain phases: after the main mix, ONE aborted write followed —
+    # behind a barrier, so it has completed — by ONE final read. In
+    # atomic mode the abort leaves nothing (healthy runs stay valid);
+    # under --dirty-split-ms its half-applied rows are still in the
+    # table when the read lands, so the seeded violation is observed
+    # deterministically instead of depending on a reader racing the
+    # split window under scheduler load.
+    drain_write = g.once({"type": "invoke", "f": "write",
+                          "value": DRAIN_WRITE_VALUE, "abort": True})
+    drain_read = g.once({"type": "invoke", "f": "read", "value": None})
     return {
-        "generator": g.limit(n_ops, g.stagger(
-            1 / 100, _dirty_gen(opts.get("abort_every", 4)))),
+        "generator": g.phases(main, drain_write, drain_read),
         "checker": DirtyReadsChecker(),
         "model": None,
     }

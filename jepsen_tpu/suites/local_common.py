@@ -29,6 +29,7 @@ from ..client import Client
 from ..os_ import NoopOS
 from ..testing import noop_test
 from .etcd import (CasdDB, _casd_pauser, _casd_restarter, _with_nemesis,
+                   resolve_daemon_args,
                    derive_concurrency)
 
 
@@ -156,7 +157,8 @@ def service_test(name: str, client: Client, workload: dict,
     nodes = [f"n{i + 1}" for i in range(n)]
     base = opts.get("base_port", 24790)
     ports = {node: base + i for i, node in enumerate(nodes)}
-    db = CasdDB(persist=persist, extra_args=daemon_args)
+    db = CasdDB(persist=persist,
+                extra_args=resolve_daemon_args(daemon_args, opts))
     # Independent-keys workloads need concurrency to be a multiple of
     # the thread-group size; derive/validate once for every suite.
     tpk = opts.get("threads_per_key")

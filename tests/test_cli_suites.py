@@ -39,8 +39,11 @@ def test_cli_runs_suite_and_exits_zero(tmp_path):
 
 
 def test_cli_invalid_run_exits_one(tmp_path):
+    # --wipe-after-ops resets the id counter deterministically: the
+    # pre-wipe grants are re-issued after it, a guaranteed duplicate.
     rc = _main_rc(["test", "--suite", "hazelcast-ids", "--nemesis",
                    "restart", "--no-persist", "--n-ops", "800",
+                   "--wipe-after-ops", "40",
                    "--base-port", "25210", "--time-limit", "6"])
     assert rc == 1
 
@@ -159,19 +162,13 @@ def test_cli_round4_workload_dispatches(tmp_path):
     assert (tmp_path / "store" / "mongodb-transfer" / "latest").exists()
 
     # Seeded fault through the same surface: elasticsearch dirty +
-    # restart on a non-persistent daemon must exit 1 when the wipe is
-    # observed (retry with longer windows; observation is timing-based).
-    for attempt in range(3):
-        rc = _main_rc(["test", "--suite", "elasticsearch", "--workload",
-                       "dirty", "--nemesis", "restart", "--no-persist",
-                       "--n-ops", "700", "--nemesis-cadence", "0.3",
-                       "--base-port", str(25330 + attempt),
-                       "--time-limit", str(12 + 4 * attempt)])
-        if rc == 1:
-            break
-        _cleanup()
-        shutil.rmtree("/tmp/jepsen/elasticsearch-dirty",
-                      ignore_errors=True)
+    # restart on a non-persistent daemon must exit 1. --wipe-after-ops
+    # makes the data loss deterministic (no nemesis/scheduler race).
+    rc = _main_rc(["test", "--suite", "elasticsearch", "--workload",
+                   "dirty", "--nemesis", "restart", "--no-persist",
+                   "--n-ops", "300", "--nemesis-cadence", "0.3",
+                   "--wipe-after-ops", "60",
+                   "--base-port", "25330", "--time-limit", "20"])
     assert rc == 1
 
 

@@ -90,24 +90,18 @@ def test_casd_pause_nemesis_stays_valid(tmp_path):
 
 def test_casd_restart_without_persistence_detected_invalid(tmp_path):
     """kill -9 + restart of a non-persistent node wipes the register —
-    a real consistency violation the checker must catch end-to-end."""
-    # Violation observation is probabilistic (the kill window must
-    # overlap live keys); retry with a longer window before declaring
-    # the detector broken (CPU contention can starve the fault window).
-    result = None
-    for attempt in range(3):
-        test = etcd.casd_test(nemesis_mode="restart", persist=False,
-                              **_base_opts(tmp_path,
-                                           base_port=23990 + attempt,
-                                           time_limit=8 + 4 * attempt,
-                                           n_nodes=1,
-                                           ops_per_key=200,
-                                           nemesis_cadence=1.0,
-                                           n_values=3))
-        result = run_stored(test, tmp_path / f"a{attempt}")
-        if result["results"]["independent"]["valid"] is False:
-            return
-        _cleanup()
-    raise AssertionError(
-        "state-wiping restarts must produce a linearizability violation: "
-        f"{result['results']}")
+    a real consistency violation the checker must catch end-to-end.
+    The wipe itself is deterministic (casd --wipe-after-ops drops state
+    when the 25th mutation arrives), so detection can't be starved by
+    scheduler load; the restart nemesis still exercises the
+    process-control path on top."""
+    test = etcd.casd_test(nemesis_mode="restart", persist=False,
+                          wipe_after_ops=25,
+                          **_base_opts(tmp_path, base_port=23990,
+                                       time_limit=8, n_nodes=1,
+                                       ops_per_key=200,
+                                       nemesis_cadence=1.0,
+                                       n_values=3))
+    result = run_stored(test, tmp_path / "a0")
+    assert result["results"]["independent"]["valid"] is False, \
+        result["results"]
