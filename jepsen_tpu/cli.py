@@ -312,8 +312,8 @@ def suite_cmd() -> dict:
         p.add_argument("--wipe-after-ops", dest="wipe_after_ops",
                        type=int, default=None,
                        help="Deterministic seeded data loss: the local "
-                            "daemon drops all in-memory state when its "
-                            "Nth mutating request arrives (casd "
+                            "daemon drops all in-memory state at its "
+                            "Nth applied state change (casd "
                             "--wipe-after-ops)")
         p.add_argument("--seeds", type=int, default=None,
                        help="Batch mode: replay the suite's generator "

@@ -28,16 +28,18 @@
 // lose elements, a wiped counter under-reads.
 //
 // Deterministic fault seeding: --wipe-after-ops N drops ALL in-memory
-// state the instant the Nth mutating request arrives (before serving
-// it) — exactly the data loss a kill -9 + restart of a non-persistent
-// node causes, but at a point fixed by the workload's own op count
-// instead of a wall-clock race between nemesis cadence and workload
-// phase. Fault-detection tests use it so their seeded violations are
-// deterministic under any scheduler load; the kill/pause nemeses still
-// exercise the process-control paths on top.
+// state the instant the Nth APPLIED state change lands (still under
+// the state lock, deferred past N until there is state to lose, and
+// counted across restarts via casd-wipe.state) — exactly the data
+// loss a kill -9 + restart of a non-persistent node causes, but at a
+// point fixed by the workload's own progress instead of a wall-clock
+// race between nemesis cadence and workload phase. Fault-detection
+// tests use it so their seeded violations are deterministic under any
+// scheduler load; the kill/pause nemeses still exercise the
+// process-control paths on top.
 //
 // Usage: casd --port P [--persist FILE] [--delay-ms N]
-//             [--wipe-after-ops N]
+//             [--wipe-after-ops N] [--resp-port P]
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -151,6 +153,36 @@ void wipe_all_state() {
   ++g_wipe_epoch;
 }
 
+// Is there any acknowledged state a wipe would actually lose? The
+// deterministic wipe defers until this holds, so a family whose state
+// happens to be empty at change N (a fully-drained queue, say) still
+// gets a guaranteed loss at the next state-creating change.
+bool state_to_lose() {
+  if (!g_store.empty() || !g_locks.empty() || !g_sets.empty() ||
+      !g_banks.empty() || !g_dirty.empty() || !g_kv.empty() ||
+      g_next_id > 0 || g_next_ts > 0 || g_ts_seq > 0)
+    return true;
+  for (const auto& q : g_queues)
+    if (!q.second.empty()) return true;
+  for (const auto& c : g_counters)
+    if (c.second != 0) return true;
+  return false;
+}
+
+// One state change just applied (plog's caller holds the state lock):
+// advance the deterministic-wipe counter and fire the wipe — still
+// under the lock, so nothing can observe the pre-wipe state between
+// change N and the loss — once the count crosses N and there is state
+// to lose.
+void note_state_change() {
+  if (g_wipe_after_ops <= 0 || g_wiped.load()) return;
+  long n = ++g_mutations_seen;
+  if (n >= g_wipe_after_ops && state_to_lose() &&
+      !g_wiped.exchange(true))
+    wipe_all_state();
+  save_wipe_state();
+}
+
 const char* B64 =
     "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
 
@@ -198,10 +230,14 @@ std::string b64_decode(const std::string& in) {
 // B bank init, T in-bank transfer, M cross-bank transfer,
 // Y dirty-table init, W completed dirty-table write.
 void plog(char code, const std::string& a, const std::string& b) {
-  if (g_persist_path.empty()) return;
-  std::ofstream f(g_persist_path, std::ios::app);
-  f << code << " " << a << " " << b << "\n";
-  f.flush();
+  if (!g_persist_path.empty()) {
+    std::ofstream f(g_persist_path, std::ios::app);
+    f << code << " " << a << " " << b << "\n";
+    f.flush();
+  }
+  // plog marks exactly the applied-state-change points, always under
+  // the state lock — the deterministic-wipe counter lives here.
+  note_state_change();
 }
 
 void persist(const std::string& key, const std::string& value, bool del) {
@@ -754,18 +790,6 @@ void handle(int fd) {
   if (read_request(fd, &req)) {
     if (g_delay_ms > 0)
       std::this_thread::sleep_for(std::chrono::milliseconds(g_delay_ms));
-    // Deterministic seeded wipe: when the Nth mutating request arrives
-    // (counted across restarts via casd-wipe.state), all in-memory
-    // state vanishes BEFORE it is served — mutations 1..N-1 are the
-    // acknowledged-then-lost prefix.
-    if (g_wipe_after_ops > 0 && req.method != "GET" &&
-        req.path != "/health") {
-      std::lock_guard<std::mutex> lock(g_mu);
-      long n = ++g_mutations_seen;
-      if (n >= g_wipe_after_ops && !g_wiped.exchange(true))
-        wipe_all_state();
-      save_wipe_state();
-    }
     const std::string prefix = "/v2/keys/";
     std::string bank_name;
     if (req.path == "/health") {
@@ -818,6 +842,175 @@ void handle(int fd) {
   close(fd);
 }
 
+// --------------------------------------------------------------- RESP
+// A second, binary data plane: the disque job-queue command subset
+// over RESP (REdis Serialization Protocol — what jedis speaks to real
+// Disque in the reference suite, disque/src/jepsen/disque.clj:129-150).
+// Commands: PING, ADDJOB <q> <body> <timeout-ms>, GETJOB [NOHANG]
+// FROM <q>..., ACKJOB <id>, QLEN <q>. State is the SAME g_queues the
+// HTTP plane serves, so kill/restart/--wipe-after-ops semantics apply
+// identically to both planes. Enabled with --resp-port P.
+
+long g_job_id = 0;  // guarded by g_mu
+
+// Buffered line/byte reader for one RESP connection.
+struct RespReader {
+  int fd;
+  std::string buf;
+  size_t pos = 0;
+
+  explicit RespReader(int fd) : fd(fd) {}
+
+  bool fill() {
+    char chunk[4096];
+    ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) return false;
+    buf.append(chunk, (size_t)n);
+    return true;
+  }
+
+  // One CRLF-terminated line, without the CRLF.
+  bool line(std::string* out) {
+    for (;;) {
+      size_t nl = buf.find("\r\n", pos);
+      if (nl != std::string::npos) {
+        *out = buf.substr(pos, nl - pos);
+        pos = nl + 2;
+        if (pos > 65536) { buf.erase(0, pos); pos = 0; }
+        return true;
+      }
+      if (!fill()) return false;
+    }
+  }
+
+  // Exactly n bytes followed by CRLF (a bulk-string payload).
+  bool bulk(size_t n, std::string* out) {
+    while (buf.size() - pos < n + 2)
+      if (!fill()) return false;
+    *out = buf.substr(pos, n);
+    pos += n + 2;
+    return true;
+  }
+};
+
+// Parse one command: an array of bulk strings (*N, then N x $len).
+// Inline-command form is not supported (no client here uses it).
+bool resp_read_command(RespReader& r, std::vector<std::string>* out) {
+  std::string l;
+  if (!r.line(&l) || l.empty() || l[0] != '*') return false;
+  long n = atol(l.c_str() + 1);
+  if (n <= 0 || n > 64) return false;
+  out->clear();
+  for (long i = 0; i < n; ++i) {
+    if (!r.line(&l) || l.empty() || l[0] != '$') return false;
+    long len = atol(l.c_str() + 1);
+    if (len < 0 || len > 1 << 20) return false;
+    std::string s;
+    if (!r.bulk((size_t)len, &s)) return false;
+    out->push_back(std::move(s));
+  }
+  return true;
+}
+
+void resp_send(int fd, const std::string& s) {
+  send(fd, s.data(), s.size(), MSG_NOSIGNAL);
+}
+
+std::string resp_bulk(const std::string& s) {
+  return "$" + std::to_string(s.size()) + "\r\n" + s + "\r\n";
+}
+
+std::string upper(std::string s) {
+  for (auto& c : s) c = (char)toupper((unsigned char)c);
+  return s;
+}
+
+void resp_handle(int fd) {
+  RespReader r(fd);
+  std::vector<std::string> cmd;
+  while (resp_read_command(r, &cmd)) {
+    std::string c = upper(cmd[0]);
+    if (c == "PING") {
+      resp_send(fd, "+PONG\r\n");
+    } else if (c == "ADDJOB" && cmd.size() >= 3) {
+      std::string id;
+      {
+        std::lock_guard<std::mutex> lock(g_mu);
+        g_queues[cmd[1]].push_back(cmd[2]);
+        id = "D-" + std::to_string(++g_job_id);
+        plog('Q', cmd[1], cmd[2]);
+      }
+      resp_send(fd, resp_bulk(id));
+    } else if (c == "GETJOB") {
+      // GETJOB [NOHANG] [COUNT n] FROM q1 [q2 ...] — serve the first
+      // non-empty queue, never block (the suite client always NOHANG).
+      size_t from = 0;
+      for (size_t i = 1; i < cmd.size(); ++i)
+        if (upper(cmd[i]) == "FROM") { from = i + 1; break; }
+      std::string q, body, id;
+      bool got = false;
+      if (from > 0) {
+        std::lock_guard<std::mutex> lock(g_mu);
+        for (size_t i = from; i < cmd.size() && !got; ++i) {
+          auto it = g_queues.find(cmd[i]);
+          if (it != g_queues.end() && !it->second.empty()) {
+            q = cmd[i];
+            body = it->second.front();
+            it->second.pop_front();
+            id = "D-" + std::to_string(++g_job_id);
+            got = true;
+          }
+        }
+      }
+      if (!got) {
+        resp_send(fd, "*-1\r\n");
+      } else {
+        // At-least-once: acknowledge BEFORE journaling the removal
+        // (same crash-window discipline as the HTTP deq path).
+        resp_send(fd, "*1\r\n*3\r\n" + resp_bulk(q) + resp_bulk(id) +
+                          resp_bulk(body));
+        std::lock_guard<std::mutex> lock(g_mu);
+        plog('R', q, body);
+      }
+    } else if (c == "ACKJOB") {
+      resp_send(fd, ":1\r\n");   // jobs are popped at GETJOB; ack is
+                                 // a no-op in this at-least-once model
+    } else if (c == "QLEN" && cmd.size() >= 2) {
+      std::lock_guard<std::mutex> lock(g_mu);
+      auto it = g_queues.find(cmd[1]);
+      long n = it == g_queues.end() ? 0 : (long)it->second.size();
+      resp_send(fd, ":" + std::to_string(n) + "\r\n");
+    } else {
+      resp_send(fd, "-ERR unknown command '" + cmd[0] + "'\r\n");
+    }
+  }
+  close(fd);
+}
+
+int g_resp_port = 0;
+
+void resp_listener() {
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((uint16_t)g_resp_port);
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("resp bind");
+    return;
+  }
+  listen(srv, 128);
+  fprintf(stderr, "casd RESP listening on 127.0.0.1:%d\n", g_resp_port);
+  while (true) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(resp_handle, fd).detach();
+  }
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -837,10 +1030,12 @@ int main(int argc, char** argv) {
       g_dirty_split_ms = atoi(argv[i + 1]);
     if (!strcmp(argv[i], "--wipe-after-ops"))
       g_wipe_after_ops = atol(argv[i + 1]);
+    if (!strcmp(argv[i], "--resp-port")) g_resp_port = atoi(argv[i + 1]);
   }
   if (g_wipe_after_ops > 0) load_wipe_state();
   replay();
   signal(SIGPIPE, SIG_IGN);
+  if (g_resp_port > 0) std::thread(resp_listener).detach();
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;

@@ -115,14 +115,21 @@ def resolve_daemon_args(daemon_args, opts: dict) -> list:
     return args
 
 
+# RESP data plane rides the HTTP port + this offset (casd --resp-port).
+RESP_OFFSET = 1000
+
+
 class CasdDB(DB):
     """The local-mode stand-in: compile the shipped casd source on the
     node and run it under start-stop-daemon. One instance per logical
-    node, ports from test["casd_ports"]."""
+    node, ports from test["casd_ports"]; ``resp=True`` additionally
+    serves the disque RESP plane on port + RESP_OFFSET."""
 
-    def __init__(self, persist: bool = True, extra_args=()):
+    def __init__(self, persist: bool = True, extra_args=(),
+                 resp: bool = False):
         self.persist = persist
         self.extra_args = list(extra_args)
+        self.resp = resp
 
     def _dir(self, test, node) -> str:
         return f"{test.get('casd_dir', '/tmp/jepsen/casd')}/{node}"
@@ -139,6 +146,8 @@ class CasdDB(DB):
         args = ["--port", port]
         if self.persist:
             args += ["--persist", f"{d}/casd.wal"]
+        if self.resp:
+            args += ["--resp-port", str(port + RESP_OFFSET)]
         args += self.extra_args
         cu.start_daemon(
             {"logfile": f"{d}/casd.log", "pidfile": f"{d}/casd.pid",

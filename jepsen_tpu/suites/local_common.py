@@ -158,7 +158,8 @@ def service_test(name: str, client: Client, workload: dict,
     base = opts.get("base_port", 24790)
     ports = {node: base + i for i, node in enumerate(nodes)}
     db = CasdDB(persist=persist,
-                extra_args=resolve_daemon_args(daemon_args, opts))
+                extra_args=resolve_daemon_args(daemon_args, opts),
+                resp=bool(opts.get("casd_resp")))
     # Independent-keys workloads need concurrency to be a multiple of
     # the thread-group size; derive/validate once for every suite.
     tpk = opts.get("threads_per_key")
