@@ -427,26 +427,34 @@ def _run_seeded_batch(builder: Callable, kw: dict, n_seeds: int,
 
 
 def recheck_cmd() -> dict:
-    """``recheck --test NAME``: re-analyze every stored run of a test
-    through the batched device path (the replay seam)."""
+    """``recheck --test NAME --model FAMILY``: re-analyze every stored
+    run of a test (the replay seam). Every checker family a suite can
+    record is accepted — linearizable models ride the batched device
+    path, fold families pool all runs into one ops.folds dispatch, bank
+    replays the balance invariant (jepsen_tpu.recheck registry)."""
+    from .recheck import FAMILY_NAMES
+
     def add_opts(p):
         p.add_argument("--test", required=True,
                        help="Stored test name (store/<name>/...)")
         p.add_argument("--model", default="cas-absent",
-                       choices=["cas", "cas-absent", "mutex"])
+                       choices=list(FAMILY_NAMES))
         p.add_argument("--independent", action="store_true",
                        help="Strain per-key subhistories first")
+        p.add_argument("--accounts", type=int, default=5,
+                       help="bank: expected account count")
+        p.add_argument("--balance", type=int, default=10,
+                       help="bank: expected per-account start balance")
 
     def run(opts):
         import json as _json
 
-        from .models.core import cas_register, mutex
+        from .recheck import recheck_family
         from .store import DEFAULT
-        from .suites.etcd import ABSENT
-        model = {"cas": cas_register(), "mutex": mutex(),
-                 "cas-absent": cas_register(ABSENT)}[opts.model]
-        out = DEFAULT.recheck(opts.test, model,
-                              independent=opts.independent)
+        out = recheck_family(DEFAULT, opts.test, opts.model,
+                             independent=opts.independent,
+                             accounts=opts.accounts,
+                             balance=opts.balance)
         print(_json.dumps(
             {"valid": out["valid"],
              "runs": {ts: r["valid"] for ts, r in out["runs"].items()}},

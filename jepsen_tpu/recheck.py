@@ -1,0 +1,86 @@
+"""Re-analysis of stored histories by checker family.
+
+The reference re-derives results by running a test's checker over a
+loaded history in the REPL (store.clj:165-171 + checker API); here every
+family a suite records is reachable from the command line: the
+linearizable models ride the batched device path (Store.recheck), the
+fold families pool every stored run into one device dispatch per fold
+(ops.folds), and the bank invariant replays on the host. One registry so
+``cli recheck --model`` accepts anything a suite can produce.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from .store import Store
+
+
+def _linear(model_fn) -> dict:
+    return {"kind": "linear", "model": model_fn}
+
+
+def _fold(batch_fn_name: str) -> dict:
+    return {"kind": "fold", "fold": batch_fn_name}
+
+
+# family name -> how to re-derive verdicts for stored histories of it.
+# Linearizable families give a model for the WGL device path; fold
+# families name their ops.folds batch checker (resolved lazily so the
+# registry import stays jax-free); "bank" replays the invariant host-side.
+def registry() -> Dict[str, dict]:
+    from .models.core import cas_register, fifo_queue, mutex
+    from .suites.etcd import ABSENT
+    return {
+        "cas": _linear(cas_register),
+        "cas-absent": _linear(lambda: cas_register(ABSENT)),
+        "mutex": _linear(mutex),
+        "fifo-queue": _linear(fifo_queue),
+        "set": _fold("check_sets_batch"),
+        "crdb-set": _fold("check_crdb_sets_batch"),
+        "queue": _fold("check_queues_batch"),
+        "total-queue": _fold("check_total_queues_batch"),
+        "ids": _fold("check_unique_ids_batch"),
+        "counter": _fold("check_counters_batch"),
+        "bank": {"kind": "bank"},
+    }
+
+
+FAMILY_NAMES = ("cas", "cas-absent", "mutex", "fifo-queue", "set",
+                "crdb-set", "queue", "total-queue", "ids", "counter",
+                "bank")
+
+
+def recheck_family(store: Store, test_name: str, family: str, *,
+                   independent: bool = False,
+                   accounts: int = 5, balance: int = 10) -> dict:
+    """Re-analyze every stored run of ``test_name`` under ``family``.
+
+    Returns the Store.recheck shape: {"valid", "runs": {ts: {"valid",
+    "results"}}}. Linearizable families delegate to Store.recheck
+    (batched device dispatch, optional per-key straining); fold
+    families pool ALL stored runs into one ops.folds batch dispatch;
+    "bank" replays the balance-sum invariant on the host.
+    """
+    from .store import group_unit_results
+
+    spec = registry()[family]
+    if spec["kind"] == "linear":
+        return store.recheck(test_name, spec["model"](),
+                             independent=independent)
+
+    ts = store.tests().get(test_name, [])
+    units, labels = store.strain_units(test_name, ts,
+                                       independent=independent)
+    if not units:
+        return {"valid": "unknown", "runs": {},
+                "error": f"no stored histories for {test_name!r}"}
+
+    if spec["kind"] == "fold":
+        from .ops import folds
+        rs = getattr(folds, spec["fold"])(units)
+    else:                                  # bank
+        from .suites.cockroachdb import BankChecker
+        chk = BankChecker(accounts=accounts, balance=balance)
+        rs = [chk.check({}, None, h) for h in units]
+
+    return group_unit_results(labels, rs)

@@ -199,8 +199,6 @@ class Store:
         (run, key) units into the one batch. Returns
         {"valid", "runs": {ts: {"valid", "results"}}}.
         """
-        from .checkers.core import merge_valid
-        from .independent import history_keys, subhistory
         from .ops.linearize import check_batch_columnar, check_columnar
         from .ops.statespace import StateSpaceExplosion
 
@@ -240,15 +238,8 @@ class Store:
                 rs = check_batch_columnar(model, units,
                                           details="invalid")
         else:
-            units, labels = [], []
-            for t in ts:
-                loaded = self.load(test_name, t)
-                h = loaded.get("history")
-                if h is None:
-                    continue
-                for k in history_keys(h):
-                    units.append(subhistory(k, h))
-                    labels.append((t, k))
+            units, labels = self.strain_units(test_name, ts,
+                                              independent=True)
             if not units:
                 # Nothing loadable is not a pass: distinguish
                 # "re-checked and valid" from "found no stored
@@ -256,18 +247,32 @@ class Store:
                 return {"valid": "unknown", "runs": {},
                         "error": f"no stored histories for {test_name!r}"}
             rs = check_batch_columnar(model, units, details="invalid")
-        runs: Dict[str, dict] = {}
-        for (t, k), r in zip(labels, rs):
-            run = runs.setdefault(t, {"results": {}})
-            run["results"][k if k is not None else "history"] = r
-        for run in runs.values():
-            run["valid"] = merge_valid(
-                r["valid"] for r in run["results"].values())
-        return {
-            "valid": merge_valid(run["valid"] for run in runs.values())
-            if runs else True,
-            "runs": runs,
-        }
+        return group_unit_results(labels, rs)
+
+    def strain_units(self, test_name: str, ts, *,
+                     independent: bool) -> tuple:
+        """(units, labels) over a test's stored runs: per-key
+        subhistories when ``independent`` (falling back to the whole
+        history for runs with no KV-keyed ops, so keyless runs are
+        never silently excluded), else whole histories. Labels are
+        (timestamp, key-or-None)."""
+        from .independent import history_keys, subhistory
+
+        units, labels = [], []
+        for t in ts:
+            loaded = self.load(test_name, t)
+            h = loaded.get("history")
+            if h is None:
+                continue
+            keys = history_keys(h) if independent else []
+            if keys:
+                for k in keys:
+                    units.append(subhistory(k, h))
+                    labels.append((t, k))
+            else:
+                units.append(h)
+                labels.append((t, None))
+        return units, labels
 
     def delete(self, test_name: str, ts: Optional[str] = None) -> None:
         """Remove a run, or all of a test's runs (store.clj:328-345)."""
@@ -275,6 +280,27 @@ class Store:
             (self.base / test_name)
         if target.exists():
             shutil.rmtree(target)
+
+
+def group_unit_results(labels, rs) -> dict:
+    """Fold per-unit results back into the recheck shape
+    {"valid", "runs": {ts: {"valid", "results"}}} — one grouping
+    invariant shared by every replay path (Store.recheck and
+    jepsen_tpu.recheck's fold/bank families)."""
+    from .checkers.core import merge_valid
+
+    runs: Dict[str, dict] = {}
+    for (t, k), r in zip(labels, rs):
+        run = runs.setdefault(t, {"results": {}})
+        run["results"][k if k is not None else "history"] = r
+    for run in runs.values():
+        run["valid"] = merge_valid(
+            r["valid"] for r in run["results"].values())
+    return {
+        "valid": merge_valid(run["valid"] for run in runs.values())
+        if runs else True,
+        "runs": runs,
+    }
 
 
 DEFAULT = Store()

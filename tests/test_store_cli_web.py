@@ -12,6 +12,8 @@ import pytest
 import jepsen_tpu.gen as g
 from jepsen_tpu.checkers.linearizable import linearizable, wgl_check
 from jepsen_tpu.cli import parse_concurrency, run_cli, single_test_cmd
+from jepsen_tpu.history.core import index
+from jepsen_tpu.history.ops import invoke_op, ok_op
 from jepsen_tpu.models.core import cas_register
 from jepsen_tpu.runtime import run
 from jepsen_tpu.store import Store, attach
@@ -166,3 +168,95 @@ def test_web_ui(store):
         assert e.value.code == 404
     finally:
         srv.shutdown()
+
+
+# ------------------------------------------- recheck family registry
+
+def _store_runs(tmp_path, monkeypatch, name, runs):
+    """Store synthetic histories under a tmp store/ and chdir there so
+    the CLI's default store finds them."""
+    from jepsen_tpu.store import Store
+
+    monkeypatch.chdir(tmp_path)
+    store = Store("store")
+    for i, h in enumerate(runs):
+        store.create(name, ts=f"r{i}").save_history(index(h))
+    return store
+
+
+def _recheck_rc(args):
+    from jepsen_tpu.cli import main
+    with pytest.raises(SystemExit) as e:
+        main(["recheck"] + args)
+    return e.value.code or 0
+
+
+@pytest.mark.parametrize("family,good,bad", [
+    ("set",
+     [invoke_op(0, "add", 1), ok_op(0, "add", 1),
+      invoke_op(0, "add", 2), ok_op(0, "add", 2),
+      invoke_op(1, "read", None), ok_op(1, "read", [1, 2])],
+     [invoke_op(0, "add", 1), ok_op(0, "add", 1),
+      invoke_op(0, "add", 2), ok_op(0, "add", 2),
+      invoke_op(1, "read", None), ok_op(1, "read", [1])]),
+    ("crdb-set",
+     [invoke_op(0, "add", 1), ok_op(0, "add", 1),
+      invoke_op(1, "read", None), ok_op(1, "read", [1])],
+     [invoke_op(0, "add", 1), ok_op(0, "add", 1),
+      invoke_op(0, "add", 2), ok_op(0, "add", 2),
+      invoke_op(1, "read", None), ok_op(1, "read", [2])]),
+    ("queue",
+     [invoke_op(0, "enqueue", 7), ok_op(0, "enqueue", 7),
+      invoke_op(1, "dequeue", None), ok_op(1, "dequeue", 7)],
+     [invoke_op(1, "dequeue", None), ok_op(1, "dequeue", 9)]),
+    ("total-queue",
+     [invoke_op(0, "enqueue", 7), ok_op(0, "enqueue", 7),
+      invoke_op(1, "drain", None), ok_op(1, "drain", [7])],
+     [invoke_op(0, "enqueue", 7), ok_op(0, "enqueue", 7),
+      invoke_op(0, "enqueue", 8), ok_op(0, "enqueue", 8),
+      invoke_op(1, "drain", None), ok_op(1, "drain", [7])]),
+    ("ids",
+     [invoke_op(0, "generate", None), ok_op(0, "generate", 1),
+      invoke_op(1, "generate", None), ok_op(1, "generate", 2)],
+     [invoke_op(0, "generate", None), ok_op(0, "generate", 1),
+      invoke_op(1, "generate", None), ok_op(1, "generate", 1)]),
+    ("counter",
+     [invoke_op(0, "add", 5), ok_op(0, "add", 5),
+      invoke_op(1, "read", None), ok_op(1, "read", 5)],
+     [invoke_op(0, "add", 5), ok_op(0, "add", 5),
+      invoke_op(1, "read", None), ok_op(1, "read", 99)]),
+    ("bank",
+     [invoke_op(0, "read", None),
+      ok_op(0, "read", {a: 10 for a in range(5)})],
+     [invoke_op(0, "read", None),
+      ok_op(0, "read", {a: 7 for a in range(5)})]),
+    ("mutex",
+     [invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+      invoke_op(0, "release", None), ok_op(0, "release", None)],
+     [invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+      invoke_op(1, "acquire", None), ok_op(1, "acquire", None)]),
+    ("fifo-queue",
+     [invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+      invoke_op(0, "enqueue", 2), ok_op(0, "enqueue", 2),
+      invoke_op(1, "dequeue", None), ok_op(1, "dequeue", 1)],
+     [invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+      invoke_op(0, "enqueue", 2), ok_op(0, "enqueue", 2),
+      invoke_op(1, "dequeue", None), ok_op(1, "dequeue", 2)]),
+])
+def test_recheck_every_family_from_cli(tmp_path, monkeypatch, family,
+                                       good, bad):
+    """cli recheck --model accepts EVERY checker family a suite can
+    record: per-family good run passes (exit 0) and seeded-violation
+    run fails (exit 1), re-derived from stored histories alone."""
+    import json
+
+    _store_runs(tmp_path, monkeypatch, "fam-good", [good])
+    _store_runs(tmp_path, monkeypatch, "fam-bad", [bad])
+    assert _recheck_rc(["--test", "fam-good", "--model", family]) == 0
+    assert _recheck_rc(["--test", "fam-bad", "--model", family]) == 1
+
+
+def test_recheck_family_names_cover_registry():
+    from jepsen_tpu.cli import recheck_cmd
+    from jepsen_tpu.recheck import FAMILY_NAMES, registry
+    assert set(FAMILY_NAMES) == set(registry())
