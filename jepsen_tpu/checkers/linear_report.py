@@ -36,18 +36,23 @@ def _window(history: Sequence[Op], bad_index: int,
     lo, hi = max(0, pos - radius), min(len(history), pos + radius + 1)
     picked = {id(op) for op in history[lo:hi]}
     out = list(history[lo:hi])
-    # Pull in invocations whose completion lies inside the window.
+    # Pull in invocations whose completion lies inside the window,
+    # keeping history order — render_svg's x-scale is position-based,
+    # so a spanning invocation must sort before the window, not pile
+    # up at a fixed index detached from its completion.
+    pulled = []
     open_inv = {}
     for i, op in enumerate(history):
         if op.is_invoke:
-            open_inv[op.process] = op
+            open_inv[op.process] = (i, op)
         elif op.is_completion:
             inv = open_inv.pop(op.process, None)
             if inv is not None and id(op) in picked \
-                    and id(inv) not in picked:
-                out.insert(0, inv)
-                picked.add(id(inv))
-    return out
+                    and id(inv[1]) not in picked:
+                pulled.append(inv)
+                picked.add(id(inv[1]))
+    pulled.sort(key=lambda iv: iv[0])
+    return [op for _, op in pulled] + out
 
 
 def render_svg(model, history: Sequence[Op], result: dict) -> str:

@@ -182,13 +182,15 @@ class LinearizableChecker(Checker):
 
     def _compete(self, model, history) -> dict:
         """First engine to finish wins (knossos.competition semantics).
-        The loser's thread is left to run out — neither engine can be
-        interrupted mid-search, and both are daemon-safe. Each racer
+        The loser runs out on a DAEMON thread — neither engine can be
+        interrupted mid-search, and a wedged loser must not block
+        interpreter exit (an executor's atexit join would). Each racer
         only receives the kwargs its engine understands — the two
         signatures are disjoint, and a TypeError would silently knock
         one racer out of every race."""
-        import concurrent.futures as cf
         import inspect
+        import queue
+        import threading
 
         from ..native import wgl_check_native
         from ..ops.linearize import check_one_tpu
@@ -200,26 +202,27 @@ class LinearizableChecker(Checker):
                 return dict(self.kw)     # **kw: everything passes through
             return {k: v for k, v in self.kw.items() if k in params}
 
-        ex = cf.ThreadPoolExecutor(2)
-        futs = [ex.submit(wgl_check_native, model, list(history),
-                          **accepted(wgl_check_native)),
-                ex.submit(check_one_tpu, model, list(history),
-                          **accepted(check_one_tpu))]
-        try:
-            done, _ = cf.wait(futs, return_when=cf.FIRST_COMPLETED)
-            errs = []
-            for f in done:
-                if f.exception() is None:
-                    return f.result()
-                errs.append(f.exception())
-            # The first finisher crashed: fall through to the other.
-            done, _ = cf.wait(futs)
-            for f in done:
-                if f.exception() is None:
-                    return f.result()
-            raise errs[0]
-        finally:
-            ex.shutdown(wait=False)
+        results: "queue.Queue" = queue.Queue()
+
+        def race(fn):
+            try:
+                results.put((fn(model, list(history), **accepted(fn)),
+                             None))
+            except BaseException as e:   # noqa: BLE001 — relayed below
+                results.put((None, e))
+
+        for fn in (wgl_check_native, check_one_tpu):
+            threading.Thread(target=race, args=(fn,),
+                             name=f"compete-{fn.__name__}",
+                             daemon=True).start()
+        r, err = results.get()
+        if err is None:
+            return r
+        # The first finisher crashed: fall through to the other.
+        r2, err2 = results.get()
+        if err2 is None:
+            return r2
+        raise err
 
     def check(self, test, model, history, opts=None) -> dict:
         # Seeded batch mode: the runner may have pooled this unit's

@@ -22,6 +22,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -263,15 +264,24 @@ PyObject* walk(PyObject*, PyObject* args) {
       return nullptr;
     }
 
-    // Crashed invocations: kind from the invoke's own value.
-    for (auto& kv : open_line) {
-      int32_t ki = intern_kind(vocab, kinds, open_op[kv.first], nullptr);
+    // Crashed invocations: kind from the invoke's own value, interned
+    // in invocation (line) order so the kinds vocabulary is
+    // bit-identical to the Python oracle's insertion order (same
+    // discipline as walk_jsonl).
+    std::vector<std::pair<int64_t, long>> crashed;
+    crashed.reserve(open_line.size());
+    for (auto& kv : open_line)
+      crashed.emplace_back(kv.second, kv.first);
+    std::sort(crashed.begin(), crashed.end());
+    for (auto& pr : crashed) {
+      int32_t ki = intern_kind(vocab, kinds, open_op[pr.second],
+                               nullptr);
       if (ki == -2) {
         Py_DECREF(ofast);
         Py_DECREF(hfast);
         return nullptr;
       }
-      kind[kv.second] = ki;
+      kind[pr.first] = ki;
     }
     rowlen.push_back((int64_t)code.size() - rowstart);
     Py_DECREF(ofast);
@@ -357,9 +367,13 @@ const char* skip_value(const char* s, const char* e) {
     }
     return nullptr;
   }
-  // number / true / false / null: scan to a delimiter.
+  // number / true / false / null: scan to a delimiter (any JSON
+  // whitespace counts — a tab after a numeric process value must not
+  // leak into the slice and silently demote a client op to nemesis).
   const char* t = s;
-  while (t < e && *t != ',' && *t != '}' && *t != ']' && *t != ' ') t++;
+  while (t < e && *t != ',' && *t != '}' && *t != ']' && *t != ' ' &&
+         *t != '\t' && *t != '\r' && *t != '\n')
+    t++;
   return (t > s) ? t : nullptr;
 }
 
@@ -606,15 +620,23 @@ PyObject* walk_jsonl(PyObject*, PyObject* args) {
       }
     }
 
-    // Crashed invocations: kind from the invoke's own value.
-    for (auto& kv : open) {
+    // Crashed invocations: kind from the invoke's own value. Intern
+    // in invocation (line) order, not unordered_map order — the kinds
+    // vocabulary must be bit-identical to the Python oracle's
+    // insertion order and reproducible across platforms.
+    std::vector<const Open*> crashed;
+    crashed.reserve(open.size());
+    for (auto& kv : open) crashed.push_back(&kv.second);
+    std::sort(crashed.begin(), crashed.end(),
+              [](const Open* a, const Open* b) { return a->j < b->j; });
+    for (const Open* o : crashed) {
       int32_t ki = intern_kind_text(kind_cache, vocab, kinds, parse,
-                                    kv.second.f, kv.second.v);
+                                    o->f, o->v);
       if (ki == -2) {
         Py_DECREF(tfast);
         return nullptr;
       }
-      kind[kv.second.j] = ki;
+      kind[o->j] = ki;
     }
     rowlen.push_back((int64_t)code.size() - rowstart);
   }

@@ -11,6 +11,7 @@ Modules:
   statespace — host-side model state-space enumeration + transition tables
   encode     — history → event tensor lowering (slot assignment, batching)
   linearize  — dense-frontier WGL linearizability kernel (vmapped, sharded)
-  scans      — vmapped single-pass checkers (set/counter/unique-ids/queue)
-  mesh       — device mesh / sharding helpers
+  folds      — vmapped single-pass checkers (set/counter/unique-ids/queue)
+
+(The device mesh / sharding helpers live in jepsen_tpu.parallel.)
 """

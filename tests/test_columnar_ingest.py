@@ -397,3 +397,32 @@ def test_recheck_invalid_rows_keep_counterexamples(tmp_path, model):
     assert r_bad["valid"] is False and r_bad["op"]["index"] == 3
     assert "configs" in r_bad
     assert rr["runs"]["r1"]["results"]["history"] == {"valid": True}
+
+
+def test_jsonl_tab_whitespace_delimits_numbers(model):
+    """A tab after a numeric process value must not leak into the
+    slice: the op stays a client op, not a silently-skipped nemesis
+    line (native ingest skip_value delimiter set)."""
+    from jepsen_tpu.history.columnar import jsonl_to_columnar
+    text = (b'{"process":\t0,\t"type": "invoke", "f": "write",'
+            b' "value": 1}\n'
+            b'{"process":\t0,\t"type": "ok", "f": "write",'
+            b' "value": 1}\n')
+    cols = jsonl_to_columnar(model, [text])
+    assert int((cols.type[0] != PAD).sum()) == 2
+
+
+def test_crashed_invocation_kinds_intern_in_line_order(model):
+    """Crashed-invocation kinds intern in invocation order, matching
+    the Python oracle's insertion order bit-for-bit (the native walk
+    previously followed unordered_map order)."""
+    from jepsen_tpu.history.codec import dumps_op
+
+    h = index_history([invoke_op(p, "write", p) for p in range(10)])
+    native = ops_to_columnar(model, [h], native=True)
+    python = ops_to_columnar(model, [h], native=False)
+    assert native.kinds == python.kinds
+    text = ("\n".join(dumps_op(op) for op in h) + "\n").encode()
+    from jepsen_tpu.history.columnar import jsonl_to_columnar
+    loaded = jsonl_to_columnar(model, [text])
+    assert loaded.kinds == python.kinds
