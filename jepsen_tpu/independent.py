@@ -270,8 +270,15 @@ class BatchLinearizableChecker(Checker):
     ops.linearize.check_batch_columnar); ``columnar=False`` keeps the
     per-history encoder."""
 
-    def __init__(self, columnar: bool = True, **kw):
+    def __init__(self, columnar: bool = True, oracle_spot: int = 2,
+                 **kw):
         self.columnar = columnar
+        # Production tripwire: re-derive up to this many small keys'
+        # verdicts with the algorithm-independent brute oracle
+        # (checkers/brute.py) every run. A disagreement is a CHECKER
+        # bug, not a system violation — it raises, and check_safe
+        # surfaces the run as valid:"unknown" with the error.
+        self.oracle_spot = oracle_spot
         self.kw = kw
 
     def check(self, test, model, history, opts=None) -> dict:
@@ -288,6 +295,7 @@ class BatchLinearizableChecker(Checker):
             check = (check_batch_columnar if self.columnar
                      else check_batch_tpu)
             rs = check(model, subs, **self.kw)
+        spot = self._oracle_spot_check(model, ks, subs, rs)
         results = dict(zip(ks, rs))
         failures = [k for k, r in results.items()
                     if r.get("valid") is not True]
@@ -299,12 +307,44 @@ class BatchLinearizableChecker(Checker):
         for k, sub, r in zip(ks, subs, rs):
             _write_key_artifacts(test, opts, k, sub, r,
                                  render=True, model=model)
-        return {
+        out = {
             "valid": merge_valid(r["valid"] for r in results.values())
             if results else True,
             "results": results,
             "failures": failures,
         }
+        if spot is not None:
+            out["oracle-spot"] = spot
+        return out
+
+    def _oracle_spot_check(self, model, ks, subs, rs):
+        """Cross-derive up to ``oracle_spot`` small keys' verdicts with
+        the independent permutation-search oracle. Returns a summary
+        dict, or None when disabled / no key is small enough. A
+        disagreement raises — the engines and the oracle disagreeing
+        means the CHECKER is broken, and check_safe turns that into
+        valid:"unknown" rather than a false verdict either way."""
+        if not self.oracle_spot:
+            return None
+        from .checkers.brute import brute_check
+        checked = []
+        for k, sub, r in zip(ks, subs, rs):
+            if len(checked) >= self.oracle_spot:
+                break
+            if r.get("valid") not in (True, False):
+                continue
+            n_invocations = sum(1 for op in sub if op.is_invoke)
+            if n_invocations > 12:
+                continue
+            want = brute_check(model, sub)["valid"]
+            got = r["valid"] is True
+            if want is not got:
+                raise AssertionError(
+                    f"checker self-check failed: key {k!r} engine="
+                    f"{r['valid']} oracle={want} — the WGL engine and "
+                    f"the independent oracle disagree")
+            checked.append(k)
+        return {"keys": checked, "agree": True} if checked else None
 
 
 def batch_checker(**kw) -> Checker:

@@ -228,3 +228,37 @@ def test_batch_checker_writes_per_key_artifacts(tmp_path):
     assert not (handle.dir / "independent" / "1" / "linear.svg").exists()
     svg = (handle.dir / "independent" / "2" / "linear.svg").read_text()
     assert "counterexample" in svg
+
+
+def test_batch_checker_oracle_spot_check():
+    """The production tripwire: small keys' verdicts are cross-derived
+    against the brute oracle every run; a seeded engine disagreement
+    surfaces as a raised self-check failure (valid:"unknown" through
+    check_safe), never a false verdict."""
+    from jepsen_tpu.checkers.core import check_safe
+    from jepsen_tpu.history.core import index
+    from jepsen_tpu.history.ops import invoke_op, ok_op
+    from jepsen_tpu.independent import KV, BatchLinearizableChecker
+    from jepsen_tpu.models.core import cas_register
+
+    h = index([
+        invoke_op(0, "write", KV("k1", 1)), ok_op(0, "write", KV("k1", 1)),
+        invoke_op(1, "read", KV("k1", None)), ok_op(1, "read", KV("k1", 1)),
+        invoke_op(0, "write", KV("k2", 2)), ok_op(0, "write", KV("k2", 2)),
+    ])
+    chk = BatchLinearizableChecker(oracle_spot=2)
+    r = chk.check({}, cas_register(), h)
+    assert r["valid"] is True
+    assert r["oracle-spot"]["agree"] is True
+    assert len(r["oracle-spot"]["keys"]) == 2
+
+    # Seeded engine bug: flip the pooled verdict for one key — the
+    # tripwire must refuse to let it through.
+    from jepsen_tpu.runtime import LinearPool
+    pool = LinearPool()
+    pool.results = {(0, "k1"): {"valid": False, "op": {"index": 1}},
+                    (0, "k2"): {"valid": True}}
+    test = {"_linear_pool": pool, "_pool_run": 0}
+    out = check_safe(chk, test, cas_register(), h)
+    assert out["valid"] == "unknown"
+    assert "self-check failed" in str(out.get("error", ""))
