@@ -43,8 +43,8 @@ def test_cli_invalid_run_exits_one(tmp_path):
     # pre-wipe grants are re-issued after it, a guaranteed duplicate.
     rc = _main_rc(["test", "--suite", "hazelcast-ids", "--nemesis",
                    "restart", "--no-persist", "--n-ops", "800",
-                   "--wipe-after-ops", "40",
-                   "--base-port", "25210", "--time-limit", "6"])
+                   "--wipe-after-ops", "15",
+                   "--base-port", "25210", "--time-limit", "20"])
     assert rc == 1
 
 
@@ -166,9 +166,9 @@ def test_cli_round4_workload_dispatches(tmp_path):
     # makes the data loss deterministic (no nemesis/scheduler race).
     rc = _main_rc(["test", "--suite", "elasticsearch", "--workload",
                    "dirty", "--nemesis", "restart", "--no-persist",
-                   "--n-ops", "300", "--nemesis-cadence", "0.3",
-                   "--wipe-after-ops", "60",
-                   "--base-port", "25330", "--time-limit", "20"])
+                   "--n-ops", "100", "--nemesis-cadence", "0.3",
+                   "--wipe-after-ops", "12",
+                   "--base-port", "25330", "--time-limit", "40"])
     assert rc == 1
 
 

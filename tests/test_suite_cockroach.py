@@ -143,12 +143,12 @@ def test_register_healthy_valid(tmp_path):
 def test_register_restart_wipe_detected(tmp_path):
     """A state-wiping restart makes post-wipe reads observe ABSENT after
     acknowledged writes — not linearizable. Deterministic seed: the
-    wipe fires at the 20th mutation (casd --wipe-after-ops); the
+    wipe fires at the 8th applied change (casd --wipe-after-ops); the
     restart nemesis still runs for path coverage."""
     test = register_test(nemesis_mode="restart", persist=False,
-                         wipe_after_ops=20,
+                         wipe_after_ops=8,
                          **_opts(tmp_path, 26010, ops_per_key=60,
-                                 nemesis_cadence=0.5, time_limit=8))
+                                 nemesis_cadence=0.5, time_limit=20))
     r = run(test)
     assert r["results"]["valid"] is False, r["results"]
 
@@ -168,7 +168,7 @@ def test_sets_healthy_valid(tmp_path):
 def test_sets_restart_lost_elements_detected(tmp_path):
     """Adds are unique ints, so any acknowledged add wiped by a restart
     can never reappear: the final read must come up short.
-    Deterministic seed: the wipe fires when the 50th add arrives (casd
+    Deterministic seed: the wipe fires when the 20th add arrives (casd
     --wipe-after-ops), squarely inside the add phase no matter how the
     scheduler stretches it; the 0.2s restart nemesis still runs for
     path coverage."""
@@ -176,9 +176,9 @@ def test_sets_restart_lost_elements_detected(tmp_path):
     # must always land inside the budget, even on a loaded 1-CPU box —
     # the wipe point no longer depends on the phase being long.
     test = sets_test(nemesis_mode="restart", persist=False,
-                     wipe_after_ops=50,
-                     **_opts(tmp_path, 26030, n_ops=200,
-                             nemesis_cadence=0.2, time_limit=25))
+                     wipe_after_ops=20,
+                     **_opts(tmp_path, 26030, n_ops=100,
+                             nemesis_cadence=0.2, time_limit=40))
     r = run(test)
     res = r["results"]
     assert res["valid"] is False, res

@@ -45,13 +45,16 @@ def test_consul_restart_detected_invalid(tmp_path):
     """A state-wiping restart makes post-restart reads observe ABSENT
     after acknowledged writes — a linearizability violation over the
     consul wire protocol. Deterministic seed: casd --wipe-after-ops
-    drops state at the 25th mutation regardless of scheduler load; the
+    drops state at the 8th applied change regardless of scheduler load; the
     restart nemesis still exercises the process-control path."""
+    # The wipe needs only 8 applied changes plus a post-wipe read
+    # (~16 ops); the 20s ceiling gives a ~50x scheduler-load margin
+    # over the nominal op rate, so the seed can't be starved.
     test = consul_test(nemesis_mode="restart", persist=False,
-                       wipe_after_ops=25,
+                       wipe_after_ops=8,
                        **_opts(tmp_path, 25110, ops_per_key=200,
                                n_values=3, nemesis_cadence=1.0,
-                               time_limit=8))
+                               time_limit=20))
     last = run(test)
     assert last["results"]["independent"]["valid"] is False, \
         last["results"]

@@ -116,13 +116,13 @@ def test_es_dirty_read_restart_detected_invalid(tmp_path):
     """A state-wiping restart: values that were observed (reads) and
     acked (writes) vanish from the final strong reads — dirty + lost.
     Deterministic seed: casd --wipe-after-ops fixes the wipe at the
-    60th mutation; the restart nemesis still runs for path coverage."""
+    12th applied change; the restart nemesis still runs for coverage."""
     # Modest op count + generous budget: the final strong-read phase
     # must land inside time_limit even on a loaded box.
     test = dirty_read_test(
-        nemesis_mode="restart", persist=False, wipe_after_ops=60,
-        **_opts(tmp_path, 26230, n_ops=300, nemesis_cadence=0.3,
-                time_limit=25))
+        nemesis_mode="restart", persist=False, wipe_after_ops=12,
+        **_opts(tmp_path, 26230, n_ops=100, nemesis_cadence=0.3,
+                time_limit=40))
     last = run(test)
     assert last["results"]["valid"] is False, last["results"]
     assert (last["results"]["dirty-count"] >= 1
@@ -151,13 +151,13 @@ def test_crate_lost_updates_restart_detects_lost(tmp_path):
     from jepsen_tpu.suites.crate import crate_test
 
     shutil.rmtree("/tmp/jepsen/crate-lost-updates", ignore_errors=True)
-    # Deterministic seed: the wipe fires at the 20th mutation, so acked
-    # pre-wipe adds are lost regardless of nemesis/scheduler timing.
+    # Deterministic seed: the wipe fires at the 10th applied change, so
+    # acked pre-wipe adds are lost regardless of scheduler timing.
     test = crate_test(workload="lost-updates",
                       nemesis_mode="restart", persist=False,
-                      wipe_after_ops=20,
+                      wipe_after_ops=10,
                       **_opts(tmp_path, 26310, ops_per_key=30,
-                              nemesis_cadence=0.5, time_limit=25))
+                              nemesis_cadence=0.5, time_limit=45))
     last = run(test)
     assert last["results"]["valid"] is False, last["results"]
 

@@ -49,9 +49,9 @@ def test_disque_resp_kill_restart_violation_detected(tmp_path):
     jobs over the REAL wire protocol; --wipe-after-ops pins the loss
     deterministically and total-queue must flag the lost elements."""
     test = disque_test(nemesis_mode="restart", persist=False,
-                       wipe_after_ops=25,
+                       wipe_after_ops=12,
                        **_opts(tmp_path, 27420, n_ops=200,
-                               nemesis_cadence=0.5, time_limit=25))
+                               nemesis_cadence=0.5, time_limit=30))
     r = run(test)
     res = r["results"]
     assert res["valid"] is False, res

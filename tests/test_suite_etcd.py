@@ -92,13 +92,13 @@ def test_casd_restart_without_persistence_detected_invalid(tmp_path):
     """kill -9 + restart of a non-persistent node wipes the register —
     a real consistency violation the checker must catch end-to-end.
     The wipe itself is deterministic (casd --wipe-after-ops drops state
-    when the 25th mutation arrives), so detection can't be starved by
+    at the 8th applied change), so detection can't be starved by
     scheduler load; the restart nemesis still exercises the
     process-control path on top."""
     test = etcd.casd_test(nemesis_mode="restart", persist=False,
-                          wipe_after_ops=25,
+                          wipe_after_ops=8,
                           **_base_opts(tmp_path, base_port=23990,
-                                       time_limit=8, n_nodes=1,
+                                       time_limit=20, n_nodes=1,
                                        ops_per_key=200,
                                        nemesis_cadence=1.0,
                                        n_values=3))

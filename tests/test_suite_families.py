@@ -116,13 +116,13 @@ def test_queue_restart_with_persistence_stays_valid(tmp_path):
 def test_queue_restart_lost_elements_detected(tmp_path):
     """Wiping the queue loses acknowledged enqueues: total-queue must
     report them as lost. Deterministic seed: the wipe fires at the
-    25th state change — deferred until the queue is non-empty, so an
+    12th state change — deferred until the queue is non-empty, so an
     acked enqueue is ALWAYS lost regardless of how the enq/deq random
     walk happens to drain (casd state_to_lose discipline)."""
     test = rabbitmq_test(nemesis_mode="restart", persist=False,
-                         wipe_after_ops=25,
-                         **_opts(tmp_path, 24750, n_ops=400,
-                                 nemesis_cadence=0.5, time_limit=20))
+                         wipe_after_ops=12,
+                         **_opts(tmp_path, 24750, n_ops=200,
+                                 nemesis_cadence=0.5, time_limit=30))
     r = run_stored(test, tmp_path)
     assert r["results"]["total-queue"]["valid"] is False, r["results"]
     assert r["results"]["total-queue"]["lost"]
