@@ -327,18 +327,27 @@ def main():
     import tempfile
 
     from jepsen_tpu.store import Store
-    SB = min(int(os.environ.get("JT_BENCH_STORE_B", "500")), B)
+    # Default to the headline scale: the replay seam is batch-oriented,
+    # and a small sample is tunnel-latency-bound rather than measuring
+    # the path (500 rows ~ 13 round trips ~ fixed cost dominates).
+    SB = min(int(os.environ.get("JT_BENCH_STORE_B", str(B))), B)
     store_rate = None
     if SB:
         with tempfile.TemporaryDirectory() as td:
             store = Store(base=td)
+            from jepsen_tpu.history.codec import write_jsonl
             for i in range(SB):
                 h = store.create("bench-recheck", ts=f"r{i:05d}")
-                h.save_history(conv_hists[i])
+                # Setup, not the measured seam: skip the .txt render
+                # (recheck reads history.jsonl alone).
+                write_jsonl(h.path("history.jsonl"), conv_hists[i])
             store.recheck("bench-recheck", model)    # warm compiles
-            t0 = time.time()
-            rr = store.recheck("bench-recheck", model)
-            t_store = time.time() - t0
+            store_times = []
+            for _ in range(max(2, repeats)):         # median vs jitter
+                t0 = time.time()
+                rr = store.recheck("bench-recheck", model)
+                store_times.append(time.time() - t0)
+            t_store = statistics.median(store_times)
             store_rate = round(SB / t_store, 2)
             want = [bool(dev_valid[i]) for i in range(SB)
                     if i not in skip]

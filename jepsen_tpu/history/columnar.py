@@ -320,12 +320,16 @@ def ops_to_columnar(model, histories: Sequence[Sequence[Op]], *,
     return _pack_walk(model, arrays, all_kinds, max_states)
 
 
-def columnar_to_ops(cols: ColumnarOps, row: int) -> List[Op]:
+def columnar_to_ops(cols: ColumnarOps, row: int,
+                    propagated: bool = False) -> List[Op]:
     """One row as an indexed Op-list history (host-engine routing and
     oracle tests). Invoke values are un-propagated where the semantics
-    require (a read invokes with value None, observes on completion).
-    Op indices are the row's line positions, or the original-history op
-    indices when the batch was converted (``cols.index``)."""
+    require (a read invokes with value None, observes on completion);
+    ``propagated=True`` keeps the columnar kinds' already-propagated
+    values on the invokes instead — the decode path's form, sparing a
+    full history.core.complete() copy pass per row. Op indices are the
+    row's line positions, or the original-history op indices when the
+    batch was converted (``cols.index``)."""
     out: List[Op] = []
     pending = {}
     for j in range(cols.n_lines):
@@ -337,7 +341,8 @@ def columnar_to_ops(cols: ColumnarOps, row: int) -> List[Op]:
             kind = cols.kinds[int(cols.kind[row, j])]
             f, v = kind[0], _kind_value(kind)
             pending[p] = (f, v)
-            op = invoke_op(p, f, None if f == "read" else v)
+            op = invoke_op(p, f,
+                           None if f == "read" and not propagated else v)
         elif t == C_OK:
             f, v = pending.pop(p)
             op = ok_op(p, f, v)

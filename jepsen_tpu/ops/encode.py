@@ -197,7 +197,8 @@ def encode_history(model: Model, prepared: List[Op], *,
 
 def slot_ops_at_event(space: StateSpace, prepared: List[Op],
                       event_index: Optional[int] = None, *,
-                      max_slots: int = 32) -> Dict[int, int]:
+                      max_slots: int = 32,
+                      predropped: bool = False) -> Dict[int, int]:
     """Replay the encode walk to recover ``{slot: op history-index}`` —
     the pending table as of encoded event ``event_index`` (the snapshot
     the device saw, including the completing op), or the final pending
@@ -206,9 +207,13 @@ def slot_ops_at_event(space: StateSpace, prepared: List[Op],
 
     ``max_slots`` defaults to 32, the frontier mask width — allocation
     picks the lowest free slot, so a larger pool assigns the same slots
-    as any smaller pool the history actually fit in.
+    as any smaller pool the history actually fit in. ``predropped``
+    marks streams whose identity-droppable invocations were already
+    removed (columnar-sourced rows apply the prepared-history contract
+    at conversion), sparing the per-op state-space recompute.
     """
-    dropped = dropped_invocations(space, prepared)
+    dropped = (set() if predropped
+               else dropped_invocations(space, prepared))
 
     table_op: Dict[int, int] = {}
     free = (1 << max_slots) - 1

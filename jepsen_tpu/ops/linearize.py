@@ -347,15 +347,20 @@ def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
 
     Blocking; multi-bucket callers overlap the per-dispatch round trips
     with ``run_buckets_threaded``. Returns (valid [B] bool, bad [B],
-    frontier) — frontier is [B, words(V), 2^W] uint32 when requested
-    and None otherwise (skipping the device→host transfer, which
-    verdict-only hot paths shouldn't pay).
+    frontier) — frontier is [B, words(V), 2^W] uint32 when
+    ``return_frontier=True``, None when False (skipping the
+    device→host transfer, which verdict-only hot paths shouldn't pay),
+    and with ``return_frontier="invalid"`` a dict {row: frontier_row}
+    holding ONLY the invalid rows — gathered on device, so the replay
+    product path never ships the valid majority's frontiers across a
+    latency-bound link.
     """
     if batch.batch == 0:
         z = np.zeros((0,), bool)
-        return (z, np.zeros((0,), np.int32),
-                np.zeros((0, 1, 1 << batch.W), np.uint32)
-                if return_frontier else None)
+        empty_front = ({} if return_frontier == "invalid" else
+                       np.zeros((0, 1, 1 << batch.W), np.uint32)
+                       if return_frontier else None)
+        return z, np.zeros((0,), np.int32), empty_front
 
     if batch.W > DATA_MAX_SLOTS:
         D = 1 << (batch.W - DATA_MAX_SLOTS)
@@ -383,13 +388,24 @@ def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
             pending = _data1_dispatch(batch, return_frontier)
 
     valids, bads, fronts = [], [], []
+    front_map = {} if return_frontier == "invalid" else None
+    off = 0
     for valid, bad, front, nb in pending:
-        valids.append(np.asarray(valid)[:nb])
+        v = np.asarray(valid)[:nb]
+        valids.append(v)
         bads.append(np.asarray(bad)[:nb])
-        if return_frontier:
+        if return_frontier is True:
             fronts.append(np.asarray(front)[:nb])
-    return (np.concatenate(valids), np.concatenate(bads),
-            np.concatenate(fronts) if return_frontier else None)
+        elif return_frontier == "invalid":
+            rows = np.nonzero(~v)[0]
+            if rows.size:
+                sel = np.asarray(front[rows])   # device-side gather
+                for i, r in enumerate(rows):
+                    front_map[off + int(r)] = sel[i]
+        off += nb
+    frontier = (np.concatenate(fronts) if return_frontier is True
+                else front_map)
+    return np.concatenate(valids), np.concatenate(bads), frontier
 
 
 def _data1_dispatch(batch: EncodedBatch, return_frontier: bool,
@@ -440,8 +456,23 @@ def run_buckets_threaded(batches: Sequence[EncodedBatch],
 
     if len(batches) == 1:
         return [one(batches[0])]
-    with ThreadPoolExecutor(min(12, len(batches))) as ex:
-        return list(ex.map(one, batches))
+    ex = ThreadPoolExecutor(min(12, len(batches)))
+    futs = [ex.submit(one, b) for b in batches]
+
+    def stream():
+        # Yield in SUBMISSION order (callers zip against their input
+        # list) as results become consumable, so the caller's
+        # per-bucket host work overlaps buckets still on device. A
+        # slow FIRST bucket still head-of-line blocks host work —
+        # completion-order delivery would need an order-free caller
+        # contract.
+        try:
+            for f in futs:
+                yield f.result()
+        finally:
+            ex.shutdown(wait=False)
+
+    return stream()
 
 
 def _dispatch_sharded(kind: str, batch: EncodedBatch, mesh,
@@ -503,21 +534,26 @@ def decode_frontier(frontier: np.ndarray, space, slot_to_op: Dict[int, int],
 
 
 def _decode_result(space, ops: List[Op], valid: bool, ev: int,
-                   op_index: int, frontier_row) -> dict:
+                   op_index: int, frontier_row,
+                   predropped: bool = False) -> dict:
     """Host-shaped result dict from a kernel verdict: {"valid"} plus, on
     failure, the impossible op and a decoded config sample — one decoder
-    for both device paths so counterexample discipline can't drift."""
+    for both device paths so counterexample discipline can't drift.
+    ``predropped``: the op stream already had identity drops applied
+    (columnar-sourced rows), so the slot replay can skip the per-op
+    state-space recompute."""
     if valid:
         out = {"valid": True}
         if space is not None:
-            table = slot_ops_at_event(space, ops, None)
+            table = slot_ops_at_event(space, ops, None,
+                                      predropped=predropped)
             out["configs"] = decode_frontier(frontier_row, space, table)
         return out
     op = next((o for o in ops if o.index == op_index), None)
     out = {"valid": False,
            "op": op.to_dict() if op is not None else {"index": op_index}}
     if space is not None:
-        table = slot_ops_at_event(space, ops, ev)
+        table = slot_ops_at_event(space, ops, ev, predropped=predropped)
         out["configs"] = decode_frontier(frontier_row, space, table)
     return out
 
@@ -612,9 +648,12 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
     the first impossible completion (the original-history index for
     converted batches, else the line position; INT32_MAX when valid).
     Rows the encoder cannot bound are converted to Op lists and routed
-    to ``host_fallback`` (default: the exact host engine); cost-class
-    buckets smaller than ``min_device_batch`` go to the native CPU
-    engine (the info-heavy tail isn't worth an XLA compile).
+    to ``host_fallback`` (default: the exact host engine). In
+    verdict-only and ``details="invalid"`` modes, WIDE tail buckets
+    (W >= 16) smaller than ``min_device_batch`` ride the native CPU
+    engine on a side thread under the device window — the measured
+    device/native cost crossover; narrow small buckets stay on device,
+    and ``details=True`` keeps every row there.
 
     With ``details=True`` the return is a list of per-row result dicts
     matching the host engine's shape — {"valid", "op", "configs"} with
@@ -640,29 +679,49 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
     bad = np.full(cols.batch, INT32_MAX, np.int32)
     results: List[Optional[dict]] = [None] * cols.batch if details else None
     failures = list(failures)
-    # In details mode every row must carry the full host-shaped result
-    # (op + configs); the native engine returns verdicts only, so the
-    # small-bucket shortcut applies to the verdict-only path alone.
-    if min_device_batch > 1 and not details:
-        small = [b for b in buckets if 0 < b.batch < min_device_batch]
-        buckets = [b for b in buckets if b.batch >= min_device_batch]
+    host_fallback = host_fallback or wgl_check
+    # Wide-tail shortcut: measured per-row device cost doubles per W
+    # while the native engine's grows far more slowly — on one chip the
+    # crossover sits at W~16 (W15: 0.12 s/row device vs ~0.3 native;
+    # W16: 0.77 device vs ~0.4 native). Small W>=16 buckets therefore
+    # ride the native engine ON A SIDE THREAD, chewed by the
+    # otherwise-idle CPU UNDER the device window (the bench's overlap
+    # discipline). Verdict-only and lazy-details callers only;
+    # full-details mode keeps every row on device so valid rows'
+    # config samples stay device-derived.
+    tail_future = None
+    if min_device_batch > 1 and details in (False, "invalid"):
+        # Without the native engine the wide rows must STAY on device:
+        # the host fallback's cost grows exponentially in W, while the
+        # device check stays bounded.
         try:
             from ..native import check_batch_native
         except Exception:
             check_batch_native = None
-        for b in small:
-            try:
-                if check_batch_native is None:
-                    raise RuntimeError("native engine unavailable")
-                rs = check_batch_native(
-                    model, [columnar_to_ops(cols, i) for i in b.indices])
-            except Exception:
-                failures.extend((i, "small bucket") for i in b.indices)
-                continue
-            for i, r in zip(b.indices, rs):
-                valid[i] = r["valid"] is True
-                if r["valid"] is False:
-                    bad[i] = r["op"].get("index", -1)
+        small = ([b for b in buckets
+                  if b.W >= 16 and 0 < b.batch < min_device_batch]
+                 if check_batch_native is not None else [])
+        small_ids = {id(b) for b in small}
+        buckets = [b for b in buckets if id(b) not in small_ids]
+
+        def run_tail():
+            out = []          # (row, result-or-None)
+            for b in small:
+                try:
+                    rs = check_batch_native(
+                        model,
+                        [columnar_to_ops(cols, i) for i in b.indices])
+                except Exception:
+                    out.extend((i, None) for i in b.indices)
+                    continue
+                out.extend(zip(b.indices, rs))
+            return out
+
+        if small:
+            from concurrent.futures import ThreadPoolExecutor
+            _tail_ex = ThreadPoolExecutor(1)
+            tail_future = _tail_ex.submit(run_tail)
+            _tail_ex.shutdown(wait=False)
     for batch, out in run_buckets_threaded(buckets,
                                            return_frontier=details):
         if isinstance(out, WindowOverflow):
@@ -676,18 +735,35 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
         bad[bad_rows] = (cols.index[bad_rows, bad_lines]
                          if cols.index is not None else bad_lines)
         if details:
-            from ..history.core import complete
             for bi, row in enumerate(batch.indices):
                 if details == "invalid" and bool(v[bi]):
                     results[row] = {"valid": True}
                     continue
-                # Propagate observations back onto invokes so the replay
-                # walk sees the same op kinds the encoder did.
-                ops = complete(columnar_to_ops(cols, row))
+                # The columnar form already applied the prepared-history
+                # contract (value propagation + identity drop) at
+                # conversion: reconstruct with propagated invokes and
+                # skip both complete() and the per-op drop recompute —
+                # the decode walk still sees exactly the encoder's op
+                # kinds and slot assignment.
+                ops = columnar_to_ops(cols, row, propagated=True)
                 results[row] = _decode_result(
                     space, ops, bool(v[bi]), int(b[bi]),
-                    int(bad[row]) if not bool(v[bi]) else -1, front[bi])
-    host_fallback = host_fallback or wgl_check
+                    int(bad[row]) if not bool(v[bi]) else -1, front[bi],
+                    predropped=True)
+    if tail_future is not None:
+        for i, r in tail_future.result():
+            if r is None:                    # native engine unavailable
+                failures.append((i, "small bucket"))
+                continue
+            valid[i] = r["valid"] is True
+            if r["valid"] is False:
+                bad[i] = r["op"].get("index", -1)
+            if details == "invalid":
+                # Native verdicts lack config samples; the rare invalid
+                # row re-derives its full counterexample on the host.
+                results[i] = ({"valid": True} if r["valid"] is True
+                              else host_fallback(
+                                  model, columnar_to_ops(cols, i)))
     for row, reason in failures:
         r = host_fallback(model, columnar_to_ops(cols, row))
         valid[row] = r["valid"] is True

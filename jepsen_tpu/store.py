@@ -224,10 +224,13 @@ class Store:
             try:
                 cols = jsonl_to_columnar(model, texts)
                 # Lazy details: only invalid rows pay the Python replay
-                # decode — valid rows stay at tensor speed, matching the
-                # reference's render-only-failures discipline
-                # (checker.clj:98-103).
-                rs = check_columnar(model, cols, details="invalid")
+                # decode and the frontier transfer — valid rows stay at
+                # tensor speed, matching the reference's
+                # render-only-failures discipline (checker.clj:98-103).
+                # Tiny tall-W buckets ride the native engine instead of
+                # paying a latency-bound device round trip each.
+                rs = check_columnar(model, cols, details="invalid",
+                                    min_device_batch=64)
             except StateSpaceExplosion:
                 # Vocabulary too rich for the packed table: degrade to
                 # the Op-list path, whose batch checker falls back to
