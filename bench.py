@@ -335,12 +335,12 @@ def main():
     if SB:
         with tempfile.TemporaryDirectory() as td:
             store = Store(base=td)
-            from jepsen_tpu.history.codec import write_jsonl
             for i in range(SB):
                 h = store.create("bench-recheck", ts=f"r{i:05d}")
-                # Setup, not the measured seam: skip the .txt render
-                # (recheck reads history.jsonl alone).
-                write_jsonl(h.path("history.jsonl"), conv_hists[i])
+                # What the runtime writes per run, minus the .txt
+                # render (setup, not the measured seam): jsonl + the
+                # machine-form sidecar recheck rides.
+                h.save_history(conv_hists[i], model=model, txt=False)
             store.recheck("bench-recheck", model)    # warm compiles
             store_times = []
             for _ in range(max(2, repeats)):         # median vs jitter

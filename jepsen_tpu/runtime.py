@@ -306,7 +306,7 @@ def run(test: dict, analyze: bool = True) -> dict:
                 history = run_case(test)
                 test["history"] = history
                 if store is not None:
-                    store.save_history(history)
+                    store.save_history(history, model=test.get("model"))
             except BaseException:
                 snarf_logs(test)  # emergency log dump (core.clj:133-137)
                 raise

@@ -34,6 +34,9 @@ from .history.ops import Op
 
 BASE = Path("store")
 
+# Machine-form sidecar magic (history.cols.bin, Store._save_machine_form).
+MACHINE_MAGIC = b"JTCOLS1\n"
+
 # Test-map keys that are live objects, never serialized
 # (store.clj:155-163 default-nonserializable-keys).
 NONSERIALIZABLE_KEYS = {
@@ -87,10 +90,62 @@ class StoreHandle:
                  if k not in NONSERIALIZABLE_KEYS}
         self.write_json("test.json", clean)
 
-    def save_history(self, history: Sequence[Op]) -> None:
+    def save_history(self, history: Sequence[Op], model=None,
+                     txt: bool = True) -> None:
         """Phase 1: history lands before analysis (save-1!,
-        store.clj:279-290)."""
-        self.write_history("history", history)
+        store.clj:279-290). With ``model``, additionally cache the
+        MACHINE form — the exact columnar walk output the replay seam
+        would recompute from jsonl text — the fressian-beside-
+        history.txt discipline (store.clj's dual forms). The sidecar
+        is best-effort: any failure (state-space explosion, kinds that
+        don't survive the JSON round-trip) leaves only the text forms,
+        and recheck falls back to parsing them."""
+        if txt:
+            write_txt(self.path("history.txt"), history)
+        write_jsonl(self.path("history.jsonl"), history)
+        if model is not None:
+            try:
+                self._save_machine_form(history, model)
+            except Exception:
+                logging.getLogger("jepsen.store").debug(
+                    "machine-form sidecar skipped", exc_info=True)
+
+    def _save_machine_form(self, history: Sequence[Op], model) -> None:
+        import numpy as np
+
+        from .history.columnar import ops_to_columnar
+        cols = ops_to_columnar(model, [list(history)])
+        kinds_json = json.dumps(cols.kinds)
+        # Self-validation: only cache kinds that survive the JSON
+        # round-trip bit-for-bit — anything exotic stays on the
+        # parse-from-text path.
+        if _kinds_from_json(kinds_json) != list(cols.kinds):
+            return
+        # Flat binary, not npz: the replay seam loads tens of
+        # thousands of these per recheck, and per-file zip parsing
+        # costs more than the whole text walk. Header json + raw
+        # little-endian array bytes, one frombuffer view each on load.
+        index = (cols.index if cols.index is not None
+                 else np.full_like(cols.kind, -1))
+        meta = json.dumps({
+            "n": int(cols.type.shape[1]),
+            "kinds": kinds_json,
+            "model": repr(model),
+        }).encode()
+        # tmp + rename: a crash mid-write must never leave a torn
+        # sidecar a later recheck would have to survive.
+        target = self.path("history.cols.bin")
+        tmp = target + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(MACHINE_MAGIC)
+            f.write(len(meta).to_bytes(4, "little"))
+            f.write(meta)
+            f.write(np.ascontiguousarray(cols.type[0], np.int8).tobytes())
+            f.write(np.ascontiguousarray(cols.process[0],
+                                         np.int16).tobytes())
+            f.write(np.ascontiguousarray(cols.kind[0], np.int32).tobytes())
+            f.write(np.ascontiguousarray(index[0], np.int32).tobytes())
+        os.replace(tmp, target)
 
     def save_results(self, results: dict) -> None:
         """Phase 2: analysis output (save-2!, store.clj:292-302)."""
@@ -205,24 +260,32 @@ class Store:
         ts = (list(timestamps) if timestamps is not None
               else self.tests().get(test_name, []))
         if not independent:
-            # Fast path: serialized histories ride the native jsonl
-            # loader straight onto the columnar pipeline — no per-op
-            # Python objects between disk and device (the native
-            # data-loader; the reference reads its machine form through
+            # Fastest path: every run saved its machine-form sidecar
+            # (the columnar walk cached at save time under this model)
+            # — assemble the batch straight from npz arrays, no text
+            # parse at all. Falls back to the native jsonl loader when
+            # sidecars are absent/mismatched: no per-op Python objects
+            # either way (the reference reads its machine form through
             # JVM-native fressian).
             from .history.columnar import jsonl_to_columnar
 
+            machine = self._load_machine_forms(test_name, ts, model)
             texts, labels = [], []
-            for t in ts:
-                f = self.run_dir(test_name, t) / "history.jsonl"
-                if f.exists():
-                    texts.append(f.read_bytes())
-                    labels.append((t, None))
-            if not texts:
-                return {"valid": "unknown", "runs": {},
-                        "error": f"no stored histories for {test_name!r}"}
+            if machine is None:
+                for t in ts:
+                    f = self.run_dir(test_name, t) / "history.jsonl"
+                    if f.exists():
+                        texts.append(f.read_bytes())
+                        labels.append((t, None))
+                if not texts:
+                    return {"valid": "unknown", "runs": {},
+                            "error":
+                            f"no stored histories for {test_name!r}"}
             try:
-                cols = jsonl_to_columnar(model, texts)
+                if machine is not None:
+                    cols, labels = machine
+                else:
+                    cols = jsonl_to_columnar(model, texts)
                 # Lazy details: only invalid rows pay the Python replay
                 # decode and the frontier transfer — valid rows stay at
                 # tensor speed, matching the reference's
@@ -251,6 +314,77 @@ class Store:
                         "error": f"no stored histories for {test_name!r}"}
             rs = check_batch_columnar(model, units, details="invalid")
         return group_unit_results(labels, rs)
+
+    def _load_machine_forms(self, test_name: str, ts, model):
+        """(ColumnarOps, labels) assembled from every run's machine-form
+        sidecar, or None when any run lacks one / was cached under a
+        different model — all-or-nothing, so a mixed store degrades to
+        the text path rather than silently dropping runs."""
+        import numpy as np
+
+        from .history.columnar import PAD, ColumnarOps
+
+        fingerprint = repr(model)
+        rows = []          # (ts, n_lines, kinds_json, body_bytes, off)
+        for t in ts:
+            f = self.run_dir(test_name, t) / "history.cols.bin"
+            if not f.exists():
+                return None
+            # Any malformed sidecar (torn write survived somehow,
+            # foreign file) sends the WHOLE batch to the text path —
+            # the sidecar is an accelerator, never a failure mode.
+            try:
+                raw = f.read_bytes()
+                if not raw.startswith(MACHINE_MAGIC):
+                    return None
+                hlen = int.from_bytes(raw[8:12], "little")
+                meta = json.loads(raw[12:12 + hlen])
+                n_lines = int(meta["n"])
+                if len(raw) < 12 + hlen + n_lines * 11:
+                    return None            # short body: torn file
+                if meta["model"] != fingerprint:
+                    return None
+            except Exception:
+                return None
+            rows.append((t, n_lines, meta["kinds"], raw, 12 + hlen))
+        if not rows:
+            return None
+        vocab: Dict[tuple, int] = {}
+        kinds: List[tuple] = []
+        # Kinds vocabularies repeat across runs of one test: memoize
+        # the per-run LUT by the kinds json text.
+        lut_cache: Dict[str, np.ndarray] = {}
+        n = max(m for _, m, _, _, _ in rows)
+        B = len(rows)
+        type_ = np.full((B, n), PAD, np.int8)
+        process = np.zeros((B, n), np.int16)
+        kind = np.full((B, n), -1, np.int32)
+        index = np.full((B, n), -1, np.int32)
+        for r, (_, m, kjson, raw, off) in enumerate(rows):
+            lut = lut_cache.get(kjson)
+            if lut is None:
+                ks = _kinds_from_json(kjson)
+                # Slot -1 keeps non-invoke lines' -1 (negative
+                # indexing hits it).
+                lut = np.empty(len(ks) + 1, np.int32)
+                for i, k in enumerate(ks):
+                    j = vocab.get(k)
+                    if j is None:
+                        j = vocab[k] = len(kinds)
+                        kinds.append(k)
+                    lut[i] = j
+                lut[-1] = -1
+                lut_cache[kjson] = lut
+            type_[r, :m] = np.frombuffer(raw, np.int8, m, off)
+            off += m
+            process[r, :m] = np.frombuffer(raw, np.int16, m, off)
+            off += 2 * m
+            kind[r, :m] = lut[np.frombuffer(raw, np.int32, m, off)]
+            off += 4 * m
+            index[r, :m] = np.frombuffer(raw, np.int32, m, off)
+        cols = ColumnarOps(type=type_, process=process, kind=kind,
+                           kinds=kinds, index=index)
+        return cols, [(t, None) for t, _, _, _, _ in rows]
 
     def strain_units(self, test_name: str, ts, *,
                      independent: bool) -> tuple:
@@ -283,6 +417,18 @@ class Store:
             (self.base / test_name)
         if target.exists():
             shutil.rmtree(target)
+
+
+def _kinds_from_json(text: str) -> list:
+    """Decode a kinds vocabulary from JSON, restoring the tuple
+    structure JSON flattens to lists (kinds are (f, value) tuples whose
+    values may themselves be tuples, e.g. cas pairs)."""
+    def detuple(x):
+        if isinstance(x, list):
+            return tuple(detuple(v) for v in x)
+        return x
+
+    return [detuple(k) for k in json.loads(text)]
 
 
 def group_unit_results(labels, rs) -> dict:

@@ -426,3 +426,93 @@ def test_crashed_invocation_kinds_intern_in_line_order(model):
     from jepsen_tpu.history.columnar import jsonl_to_columnar
     loaded = jsonl_to_columnar(model, [text])
     assert loaded.kinds == python.kinds
+
+
+# ------------------------------------------- machine-form sidecar
+
+def test_machine_form_sidecar_rides_recheck(tmp_path, model, hists):
+    """save_history(model=...) caches the columnar walk; recheck
+    assembles the batch from sidecars without touching the jsonl text
+    (proved by poisoning the text loader), and verdicts +
+    counterexamples match the text path exactly."""
+    import numpy as np
+
+    from jepsen_tpu.store import Store
+
+    store = Store(base=tmp_path)
+    for i, h in enumerate(hists[:12]):
+        store.create("mf", ts=f"r{i:02d}").save_history(h, model=model)
+        assert (store.run_dir("mf", f"r{i:02d}")
+                / "history.cols.bin").exists()
+
+    import jepsen_tpu.history.columnar as colmod
+
+    def poisoned(*a, **k):
+        raise AssertionError("jsonl path used despite sidecars")
+
+    real = colmod.jsonl_to_columnar
+    colmod.jsonl_to_columnar = poisoned
+    try:
+        rr = store.recheck("mf", model)
+    finally:
+        colmod.jsonl_to_columnar = real
+
+    # drop the sidecars: same verdicts via the text path
+    for i in range(12):
+        (store.run_dir("mf", f"r{i:02d}") / "history.cols.bin").unlink()
+    rr_text = store.recheck("mf", model)
+    assert len(rr["runs"]) == 12
+    for t in rr["runs"]:
+        a = rr["runs"][t]["results"]["history"]
+        b = rr_text["runs"][t]["results"]["history"]
+        assert a["valid"] == b["valid"], t
+        if a["valid"] is False:
+            assert a["op"]["index"] == b["op"]["index"], t
+            assert a["configs"] == b["configs"], t
+
+
+def test_machine_form_model_mismatch_falls_back(tmp_path, model):
+    """Sidecars cached under one model must not serve a recheck under
+    another — the text path re-derives under the requested model."""
+    from jepsen_tpu.models.core import cas_register
+    from jepsen_tpu.store import Store
+    from jepsen_tpu.suites.etcd import ABSENT
+
+    h = index_history([invoke_op(0, "write", 1), ok_op(0, "write", 1),
+                       invoke_op(1, "read", None), ok_op(1, "read", 1)])
+    store = Store(base=tmp_path)
+    store.create("mm", ts="r0").save_history(h, model=cas_register(ABSENT))
+    rr = store.recheck("mm", model)       # plain cas: different model
+    assert rr["runs"]["r0"]["valid"] is True
+
+
+def test_machine_form_partial_sidecars_fall_back(tmp_path, model):
+    """All-or-nothing: one run without a sidecar sends the whole batch
+    down the text path so no run is silently dropped."""
+    from jepsen_tpu.store import Store
+
+    good = index_history([invoke_op(0, "write", 1), ok_op(0, "write", 1)])
+    store = Store(base=tmp_path)
+    store.create("px", ts="r0").save_history(good, model=model)
+    store.create("px", ts="r1").save_history(good)       # no sidecar
+    rr = store.recheck("px", model)
+    assert len(rr["runs"]) == 2
+    assert rr["valid"] is True
+
+
+def test_machine_form_torn_sidecar_falls_back(tmp_path, model):
+    """A truncated sidecar must degrade to the text path, never crash
+    the recheck (the best-effort contract)."""
+    from jepsen_tpu.store import Store
+
+    h = index_history([invoke_op(0, "write", 1), ok_op(0, "write", 1),
+                       invoke_op(1, "read", None), ok_op(1, "read", 2)])
+    store = Store(base=tmp_path)
+    store.create("torn", ts="r0").save_history(h, model=model)
+    f = store.run_dir("torn", "r0") / "history.cols.bin"
+    f.write_bytes(f.read_bytes()[:-7])            # short body
+    rr = store.recheck("torn", model)
+    assert rr["runs"]["r0"]["valid"] is False     # text path verdict
+    f.write_bytes(b"garbage")                     # not even magic
+    rr = store.recheck("torn", model)
+    assert rr["runs"]["r0"]["valid"] is False
