@@ -19,10 +19,12 @@ Prints exactly one JSON line:
 
 Env knobs: JT_BENCH_B (histories, default 10000), JT_BENCH_OPS (op pairs
 per history, default 500 → 1k history lines), JT_BENCH_REPEATS,
-JT_BENCH_MIN_DEVICE_BATCH (smaller cost-class buckets go to the native
-CPU engine instead of paying an XLA compile), JT_BENCH_STORE_B (runs in
-the store→recheck figure), JT_BENCH_FULL_PARITY=0 (fall back to sampled
-parity for quick local runs).
+JT_BENCH_STORE_B (runs in the store→recheck figure),
+JT_BENCH_FULL_PARITY=0 (fall back to sampled parity for quick local
+runs), JT_SCHED_CLASSES / JT_SCHED_CHUNK_ROWS / JT_SCHED_ENCODE_ROWS
+(streaming scheduler knobs, see ops/schedule.py). Narrow buckets all
+stay on device now (the scheduler consolidates them into W classes);
+only tiny wide buckets route to the native CPU engine.
 """
 import json
 import os
@@ -33,22 +35,25 @@ def main():
     B = int(os.environ.get("JT_BENCH_B", "10000"))
     n_ops = int(os.environ.get("JT_BENCH_OPS", "500"))
     repeats = int(os.environ.get("JT_BENCH_REPEATS", "3"))
-    min_dev = int(os.environ.get("JT_BENCH_MIN_DEVICE_BATCH", "32"))
     full_parity = os.environ.get("JT_BENCH_FULL_PARITY", "1") != "0"
     baseline_rate = 10_000 / 60.0  # north-star target, histories/sec
 
-    import jax
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   ".jax_cache"))
+    import jax  # noqa: F401 — backend selected before first dispatch
+    from jepsen_tpu.ops.schedule import (BucketScheduler,
+                                         enable_compilation_cache,
+                                         iter_columnar_groups)
+    # Persistent compile cache: repeat bench runs (and store rechecks)
+    # deserialize kernels instead of recompiling.
+    enable_compilation_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
     import numpy as np
     from jepsen_tpu.checkers.linearizable import wgl_check
     from jepsen_tpu.history.columnar import columnar_to_ops
     from jepsen_tpu.models.core import cas_register
     from jepsen_tpu.ops.encode import encode_columnar
     from jepsen_tpu.ops.linearize import (DATA_MAX_SLOTS,
-                                          device_frontier_capacity,
-                                          run_buckets_threaded)
+                                          device_frontier_capacity)
     from jepsen_tpu.ops.statespace import enumerate_statespace
     from jepsen_tpu.workloads.synth import synth_cas_columnar
 
@@ -65,11 +70,15 @@ def main():
     # Two-phase encode: the 16-slot table covers ~99.98% of rows at the
     # cheaper width; only overflow rows re-encode wide.
     #
-    # Measured non-lever: consolidating cost classes by padding W up
-    # (fewer, fatter dispatches) LOSES at every granularity tried —
-    # {8,12,16} 5.8->23.2s, tail-only {13..16 -> 16} 5.8->15.5s,
-    # low-only {<=8 -> 8} neutral. The kernel is compute-bound in 2^W
-    # per row, so exact-W bucketing is already the optimal schedule.
+    # W classes: r05 measured NAIVE fixed-grid consolidation losing at
+    # every granularity ({8,12,16} 5.8->23.2s; tail-only {13..16->16}
+    # 5.8->15.5s) — those grids pad the fat mid-W buckets into the next
+    # power of two, multiplying the dominant frontier work. The bucket
+    # scheduler instead picks classes by a DP over the observed
+    # rows x events x 2^W distribution (ops.schedule.choose_w_classes),
+    # which keeps the expensive windows near-exact and folds only the
+    # cheap long tail — JT_SCHED_CLASSES tunes the budget (large value
+    # ~ exact-W bucketing).
     eff_slots = DATA_MAX_SLOTS + device_frontier_capacity()
 
     def encode(c):
@@ -102,21 +111,20 @@ def main():
         check_batch_native = None
 
     def route(bkts, fails):
-        """Tail cost classes below the threshold go to the native CPU
-        engine (a handful of info-heavy rows isn't worth an XLA
-        compile). Wide windows (W > 16) are cost-routed: the device
-        wide path (HBM-resident mask axis) wins on utilization once a
-        few rows share the dispatch, but one or two rows leave its
-        2000-step sequential scan latency-bound — slower than letting
-        the exact host engine chew them on the otherwise-idle CPU
-        UNDER the device window (both paths stay tested either way).
-        Encoder-overflow rows (beyond even the wide path) go to the
-        CPU engines."""
+        """Narrow (W <= 16) buckets ALL stay on device: the scheduler
+        folds small ones into consolidated W classes, so they no longer
+        pay a per-bucket XLA compile (r05 routed them to the CPU
+        instead). Wide windows (W > 16) are still cost-routed: the
+        device wide path (HBM-resident mask axis) wins on utilization
+        once a few rows share the dispatch, but one or two rows leave
+        its 2000-step sequential scan latency-bound — slower than
+        letting the exact host engine chew them on the otherwise-idle
+        CPU UNDER the device window. Encoder-overflow rows (beyond
+        even the wide path) go to the CPU engines."""
         if check_batch_native is None:
             return bkts, [i for i, _ in fails]
         dev = [b for b in bkts
-               if (b.batch >= min_dev if b.W <= DATA_MAX_SLOTS
-                   else b.batch > 2)]
+               if b.W <= DATA_MAX_SLOTS or b.batch > 2]
         dev_ids = {id(b) for b in dev}
         cpu = [i for b in bkts if id(b) not in dev_ids
                for i in b.indices]
@@ -125,31 +133,42 @@ def main():
     dev_buckets, cpu_rows = route(buckets, failures)
     cpu_hists = [columnar_to_ops(cols, i) for i in cpu_rows]
 
-    def run_all():
-        # Buckets run concurrently from a thread pool (overlapping the
-        # per-dispatch round trips); the CPU tail rides another thread.
+    def cpu_tail():
+        if not cpu_hists:
+            return 0
+        if check_batch_native is not None:
+            rs = check_batch_native(model, cpu_hists)
+        else:
+            rs = [wgl_check(model, h) for h in cpu_hists]
+        return sum(1 for r in rs if r["valid"] is not True)
+
+    def run_all(stats_out=None):
+        # Device buckets ride the streaming scheduler (W-class
+        # consolidation + chunked double-buffered dispatch); the CPU
+        # tail rides another thread under the device window. NOTE: the
+        # yielded buckets are the scheduler's consolidated classes —
+        # results scatter through batch.indices, never positional zips
+        # against dev_buckets.
         from concurrent.futures import ThreadPoolExecutor
 
-        def cpu_tail():
-            if not cpu_hists:
-                return 0
-            if check_batch_native is not None:
-                rs = check_batch_native(model, cpu_hists)
-            else:
-                rs = [wgl_check(model, h) for h in cpu_hists]
-            return sum(1 for r in rs if r["valid"] is not True)
-
+        sch = BucketScheduler()
         with ThreadPoolExecutor(1) as ex:
             tail = ex.submit(cpu_tail)
-            # run_buckets_threaded preserves input order
-            outs = [out for _, out in run_buckets_threaded(dev_buckets)]
+            pairs = list(sch.run(dev_buckets))
             n_bad = tail.result()
-        return outs, n_bad
+        if stats_out is not None:
+            stats_out.update(sch.stats)
+        return pairs, n_bad
 
-    # Warmup / compile.
+    # Warmup / compile. The first run pays every kernel compile this
+    # mix needs (persistent cache: near-zero on repeat processes);
+    # sched_stats["compiled_shapes"] is the headline compile count.
+    sched_stats = {}
     t0 = time.time()
-    outs, cpu_bad = run_all()
+    pairs, cpu_bad = run_all(stats_out=sched_stats)
     t_compile = time.time() - t0
+    kernel_compiles = sched_stats.get("compiled_shapes")
+    w_classes = sched_stats.get("classes")
 
     # Median-of-N: honest against tunnel jitter in both directions
     # (min-of-N hid slow outliers; a single slow run would lie the
@@ -158,14 +177,63 @@ def main():
     times = []
     for _ in range(repeats):
         t0 = time.time()
-        outs, cpu_bad = run_all()
+        pairs, cpu_bad = run_all()
         times.append(time.time() - t0)
     t_dev = statistics.median(times)
 
     n_checked = sum(b.batch for b in dev_buckets) + len(cpu_rows)
-    n_invalid = int(sum(int((~v).sum()) for v, _, _ in outs)) + cpu_bad
+    n_invalid = int(sum(int((~v).sum())
+                        for _, (v, _, _) in pairs)) + cpu_bad
     t_e2e = t_encode + t_dev
     rate = n_checked / t_e2e
+
+    # Streamed end-to-end: the columnar encode walk chunks into groups
+    # that overlap device dispatch (one pipeline from raw columns to
+    # verdicts), which is where time-to-first-verdict and the pipeline
+    # overlap ratio are measured. Encode work re-runs inside, so this
+    # figure is directly comparable to t_e2e.
+    def run_streamed():
+        from concurrent.futures import ThreadPoolExecutor
+
+        from jepsen_tpu.ops.linearize import WindowOverflow
+        from jepsen_tpu.ops.schedule import DIVERTED
+        # Divert small wide buckets only when the native engine is
+        # there to actually check them — otherwise they must stay on
+        # device (check_columnar's own routing rule), and the streamed
+        # count must only include rows that got a verdict.
+        sch = BucketScheduler(
+            min_device_rows=4 if check_batch_native is not None else 0)
+        space_s = enumerate_statespace(model, cols.kinds, 64)
+        n_dev, diverted = 0, []
+        with ThreadPoolExecutor(1) as ex:
+            tail = ex.submit(cpu_tail)
+            groups = iter_columnar_groups(space_s, cols,
+                                          max_slots=eff_slots,
+                                          failures=[])
+            for bt, out in sch.run(groups):
+                if out is DIVERTED:
+                    diverted.extend(bt.indices)
+                    continue
+                if isinstance(out, WindowOverflow):
+                    continue        # unverdicted: not a checked row
+                n_dev += bt.batch
+            tail.result()          # cpu_rows already cover the fails
+        cpu_set = set(cpu_rows)
+        extra = [i for i in diverted if i not in cpu_set]
+        if extra:
+            check_batch_native(model, [columnar_to_ops(cols, i)
+                                       for i in extra])
+        n = n_dev + len(cpu_set | set(diverted))
+        return n, sch.stats
+
+    run_streamed()        # warmup: streamed-only shapes compile here
+    streamed_times, streamed_stats = [], {}
+    for _ in range(max(2, repeats)):
+        t0 = time.time()
+        n_streamed, streamed_stats = run_streamed()
+        streamed_times.append(time.time() - t0)
+    t_streamed = statistics.median(streamed_times)
+    streamed_rate = n_streamed / t_streamed
 
     # ------------------------------------------------------ roofline
     # Achieved device bandwidth during the headline run, from analytic
@@ -179,15 +247,18 @@ def main():
     def bucket_traffic(b):
         return b.batch * b.ev_opidx.shape[-1] * b.V * (2 ** b.W) // 8 * 2
 
-    traffic = sum(bucket_traffic(b) for b in dev_buckets)
-    events = sum(b.batch * b.ev_opidx.shape[-1] for b in dev_buckets)
+    # Traffic is analytic over the DISPATCHED class buckets (padded W),
+    # not the exact-W input buckets — consolidation is real traffic.
+    disp_buckets = [b for b, _ in pairs]
+    traffic = sum(bucket_traffic(b) for b in disp_buckets)
+    events = sum(b.batch * b.ev_opidx.shape[-1] for b in disp_buckets)
     # Device-only denominator: t_dev is run_all() wall time, i.e.
     # max(device, overlapped CPU tail) — a slow tail would deflate the
     # published bandwidth figure.
     dts = []
     for _ in range(repeats):
         t0 = time.time()
-        list(run_buckets_threaded(dev_buckets))
+        list(BucketScheduler().run(dev_buckets))
         dts.append(time.time() - t0)
     t_dev_only = statistics.median(dts)
     roofline = {
@@ -199,14 +270,15 @@ def main():
         "device_only_time_s": round(t_dev_only, 3),
         "dominant_buckets": [
             [b.V, b.W, b.batch]
-            for b in sorted(dev_buckets, key=bucket_traffic,
+            for b in sorted(disp_buckets, key=bucket_traffic,
                             reverse=True)[:3]],
     }
 
-    # Device verdicts/bad-indices by row (parity + converted compare).
+    # Device verdicts/bad-indices by row (parity + converted compare),
+    # scattered through the consolidated buckets' indices.
     dev_valid = np.ones(B, bool)
     dev_bad = np.full(B, -1, np.int64)
-    for b, (v, bd, _) in zip(dev_buckets, outs):
+    for b, (v, bd, _) in pairs:
         idx = np.asarray(b.indices)
         dev_valid[idx] = v
         iv = idx[~np.asarray(v)]
@@ -214,7 +286,7 @@ def main():
                                  np.asarray(bd)[~np.asarray(v)]]
     skip = set(cpu_rows)                     # rows the device never saw
     row_w = np.zeros(B, np.int32)
-    for b in dev_buckets:
+    for b in disp_buckets:
         row_w[np.asarray(b.indices)] = b.W
 
     # All-rows Op-list reconstruction — shared setup for parity, the
@@ -301,7 +373,7 @@ def main():
 
         with ThreadPoolExecutor(1) as ex:
             tail = ex.submit(cpu_part)
-            for b, out in run_buckets_threaded(cdev):
+            for b, out in BucketScheduler().run(cdev):
                 v, _, _ = out
                 cvalid[np.asarray(b.indices)] = v
             for i, r in zip(ccpu, tail.result()):
@@ -408,11 +480,11 @@ def main():
             bkts, fails = encode(c)
             t_enc = time.time() - t0
             dev, cpu = route(bkts, fails)
-            list(run_buckets_threaded(dev))           # warm compile
+            list(BucketScheduler().run(dev))          # warm compile
             ts = []
             for _ in range(max(2, repeats)):
                 t0 = time.time()
-                outs_p = [o for _, o in run_buckets_threaded(dev)]
+                outs_p = [o for _, o in BucketScheduler().run(dev)]
                 ts.append(time.time() - t0)
             t = statistics.median(ts)
             n = sum(b.batch for b in dev)
@@ -466,6 +538,23 @@ def main():
         "fold_total_queue_rate": round(fold_rate, 2),
         "fold_histories": FB,
         "fold_invalid": fold_invalid,
+        "scheduler": {
+            # Compile count for the standard mix: distinct kernel
+            # shapes the headline run dispatched (acceptance: <= 5,
+            # down from 13 exact-W jits in r05).
+            "kernel_compiles": kernel_compiles,
+            "w_classes": w_classes,
+            # Streamed pipeline figures (columnar encode chunked and
+            # overlapped with dispatch/decode end-to-end).
+            "t_first_verdict_s": streamed_stats.get("t_first_verdict_s"),
+            "overlap_ratio": streamed_stats.get("overlap_ratio"),
+            "streamed_e2e_rate": round(streamed_rate, 2),
+            "streamed_e2e_time_s": round(t_streamed, 3),
+            "streamed_histories": n_streamed,
+            "chunks": streamed_stats.get("chunks"),
+            "pad_rows": streamed_stats.get("pad_rows"),
+            "input_buckets": streamed_stats.get("input_buckets"),
+        },
         "roofline": roofline,
         "long_history": long_stats,
         "device_rate": round(n_checked / t_dev, 2),

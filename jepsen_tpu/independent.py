@@ -287,8 +287,14 @@ class BatchLinearizableChecker(Checker):
         subs = [subhistory(k, history) for k in ks]
         # Seeded batch mode: the runner may have pooled every key's
         # verdict into one cross-run dispatch (runtime.LinearPool); any
-        # miss recomputes the whole run normally.
-        pool = test.get("_linear_pool") if isinstance(test, dict) else None
+        # miss recomputes the whole run normally. The pool computed its
+        # results with check_batch_columnar's DEFAULTS — a checker
+        # configured with its own engine kwargs or columnar=False must
+        # not silently consume verdicts derived under different engine
+        # parameters, so it skips the pool and computes itself.
+        pool = (test.get("_linear_pool")
+                if isinstance(test, dict) and self.columnar and not self.kw
+                else None)
         rs = ([pool.take(test, k) for k in ks]
               if pool is not None else None)
         if rs is None or any(r is None for r in rs):

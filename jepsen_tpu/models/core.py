@@ -149,6 +149,14 @@ class UnorderedQueue(Model):
     def __hash__(self):
         return hash(frozenset(self.pending.items()))
 
+    def __repr__(self):
+        # Value-based and order-stable (Counter iteration order is
+        # insertion order, which differs between equal states reached
+        # by different paths): counterexample configs embed this
+        # string, and equal states must render identically.
+        items = sorted(self.pending.items(), key=lambda kv: repr(kv[0]))
+        return f"UnorderedQueue(pending={dict(items)!r})"
+
 
 def unordered_queue() -> UnorderedQueue:
     return UnorderedQueue()
@@ -177,6 +185,9 @@ class FIFOQueue(Model):
 
     def __hash__(self):
         return hash(self.pending)
+
+    def __repr__(self):
+        return f"FIFOQueue(pending={list(self.pending)!r})"
 
 
 def fifo_queue() -> FIFOQueue:

@@ -507,6 +507,95 @@ def _bucket_encoded(space, ev_slot, ev_slots, ev_opidx, max_live,
     return out, failures
 
 
+def widen_batch(batch: EncodedBatch, W: int) -> EncodedBatch:
+    """Re-target an encoded batch at a wider W class (W >= batch.W).
+
+    Semantics-preserving by construction: the new slots are empty in
+    every snapshot (they point at the all-invalid sentinel row, whose
+    packed target rows are all-zero), so closing under them is a no-op,
+    no completion ever names them, and no frontier mask can acquire
+    their bits — the surviving config set over the original slots is
+    bit-identical, just embedded in a 2^W mask axis. Cost is what
+    changes: the frontier doubles per extra slot, which is why class
+    targeting is a scheduling decision (ops.schedule), not an encoding
+    default."""
+    assert W >= batch.W, (W, batch.W)
+    if W == batch.W:
+        return batch
+    b, n, w = batch.batch, batch.n_events, batch.ev_slots.shape[2]
+    K = batch.target.shape[1] - 1          # sentinel row index
+    ev_slots = np.full((b, n, W), K, batch.ev_slots.dtype)
+    ev_slots[:, :, :w] = batch.ev_slots
+    return EncodedBatch(
+        ev_type=batch.ev_type, ev_slot=batch.ev_slot, ev_slots=ev_slots,
+        ev_opidx=batch.ev_opidx, target=batch.target, V=batch.V, W=W,
+        indices=list(batch.indices), failures=list(batch.failures),
+        spaces=batch.spaces, shared_target=batch.shared_target)
+
+
+def merge_batches(batches: Sequence[EncodedBatch],
+                  W: Optional[int] = None) -> EncodedBatch:
+    """Stack several encoded batches (one V, any W <= the class W) into
+    one class bucket: slot windows widen to the class W (widen_batch's
+    no-op padding), event axes pad to the group max, and kind
+    vocabularies merge by padding each batch's target table to the
+    widest K and re-pointing its empty-slot sentinel entries at the new
+    sentinel row. ``shared_target`` survives only when every input
+    shares one identical table (the columnar path); otherwise the
+    merged bucket carries per-row targets."""
+    batches = [b for b in batches if b.batch]
+    assert batches, "merge_batches needs at least one non-empty batch"
+    V = batches[0].V
+    assert all(b.V == V for b in batches), "one V per class group"
+    Wc = W if W is not None else max(b.W for b in batches)
+    assert all(b.W <= Wc for b in batches)
+    if len(batches) == 1:
+        return widen_batch(batches[0], Wc)
+
+    K = max(b.target.shape[1] - 1 for b in batches)
+    N = max(b.n_events for b in batches)
+    B = sum(b.batch for b in batches)
+    shared = (all(b.shared_target for b in batches)
+              and all(b.target.shape[1] - 1 == K for b in batches)
+              and all(np.array_equal(b.target[0], batches[0].target[0])
+                      for b in batches[1:]))
+
+    slot_dtype = np.int8 if K < 127 else np.int32
+    ev_type = np.zeros((B, N), np.int8)
+    ev_slot = np.zeros((B, N), np.int8)
+    ev_slots = np.full((B, N, Wc), K, slot_dtype)
+    ev_opidx = np.full((B, N), -1, np.int32)
+    if shared:
+        target = np.broadcast_to(batches[0].target[0], (B, K + 1, V))
+    else:
+        target = np.full((B, K + 1, V), -1, np.int32)
+
+    row = 0
+    indices: List[int] = []
+    failures: List[Tuple[int, str]] = []
+    spaces: List[StateSpace] = []
+    for b in batches:
+        n, w, Kb = b.n_events, b.ev_slots.shape[2], b.target.shape[1] - 1
+        sl = slice(row, row + b.batch)
+        ev_type[sl, :n] = b.ev_type
+        ev_slot[sl, :n] = b.ev_slot
+        snap = b.ev_slots.astype(slot_dtype, copy=(Kb != K))
+        if Kb != K:                 # re-point the empty-slot sentinel
+            snap[snap == Kb] = K
+        ev_slots[sl, :n, :w] = snap
+        ev_opidx[sl, :n] = b.ev_opidx
+        if not shared:
+            target[sl, :Kb + 1] = b.target
+        indices.extend(b.indices)
+        failures.extend(b.failures)
+        spaces.extend(b.spaces or [None] * b.batch)
+        row += b.batch
+    return EncodedBatch(ev_type=ev_type, ev_slot=ev_slot, ev_slots=ev_slots,
+                        ev_opidx=ev_opidx, target=target, V=V, W=Wc,
+                        indices=indices, failures=failures, spaces=spaces,
+                        shared_target=shared)
+
+
 def bucket_encode(model: Model, prepared_histories: Sequence[List[Op]], *,
                   max_states: int = 64, max_slots: int = 16,
                   min_v: int = 8, min_w: int = 4) -> List[EncodedBatch]:

@@ -34,6 +34,19 @@ batch and shardable over the device mesh on the batch axis
 (jepsen_tpu.parallel). The mask axis provides long 128-lane vectors for
 the VPU. Cost scales with 2^W * events, so callers bucket histories by
 (V, W) cost class before batching.
+
+Scheduling around the kernel lives in ops.schedule: production callers
+(check_batch_tpu / check_columnar / Store.recheck) stream their cost
+buckets through its BucketScheduler, which folds exact-W buckets into
+a few W *classes* (padding a history into a wider class is semantics-
+preserving — the extra slots stay empty in every snapshot and can never
+acquire mask bits, see ops.encode.widen_batch), chunks each class for
+double-buffered dispatch, and yields verdicts incrementally. Every
+compiled checker variant resolves through this module's process-wide
+``get_kernel`` registry, so compile accounting, AOT pre-warming, and
+the persistent compilation cache see one coherent kernel set. The
+exact-W flow (``scheduler=False`` / run_buckets_threaded) remains the
+parity oracle the streamed path is tested against.
 """
 from __future__ import annotations
 
@@ -208,22 +221,87 @@ def make_kernel(V: int, W: int):
     return check
 
 
-# One compiled batch kernel per static (V, W); jit caches per event-shape.
-_BATCH_KERNELS: Dict[Tuple[int, int, bool], object] = {}
+# ------------------------------------------------------ kernel registry
+# One process-wide registry resolves EVERY compiled checker variant —
+# single-device ("data1"), batch-sharded ("data"), and mask-sharded
+# ("frontier") — keyed by (kind, V, W, mesh, shared_target, donate).
+# The streaming scheduler (ops.schedule) and the mesh dispatchers
+# (parallel.mesh / parallel.frontier) all come through here, so compile
+# accounting, pre-warming, and the persistent compilation cache see one
+# coherent kernel set. ``donate=True`` builds the jit with the event
+# arrays' device buffers donated (donate_argnums) — the chunked
+# scheduler path ships each chunk exactly once, so XLA may recycle the
+# input buffers as scan scratch instead of holding both live.
+_KERNEL_REGISTRY: Dict[Tuple, object] = {}
+
+# Every (registry key, batch shape, event shape) the process has
+# dispatched — one entry per XLA compilation (jit compiles per shape).
+# bench.py reports len() deltas; ops.schedule pre-warms against it.
+KERNEL_SHAPE_LOG: "set" = set()
+
+def _silence_donation_warning() -> None:
+    # Donated buffers that XLA can't alias into outputs (ours never are
+    # — the verdict outputs are tiny) warn on every call; the donation
+    # is still honored for scratch reuse, so the warning is pure noise
+    # here. Installed lazily on the first donated kernel build so
+    # merely importing this library doesn't mutate global warning
+    # state for applications that want the diagnostic for their own
+    # donate_argnums jits.
+    import warnings
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable")
+
+
+def get_kernel(V: int, W: int, *, kind: str = "data1", mesh=None,
+               shared_target: bool = False, donate: bool = False):
+    """Resolve (build + cache) a compiled checker kernel.
+
+    kind "data1" is the single-device vmapped kernel; "data" shards the
+    batch axis over ``mesh``; "frontier" splits the mask axis over the
+    mesh's frontier devices. All variants share the contract
+    check(ev_type, ev_slot, ev_slots, target) -> (valid, bad, frontier).
+    The frontier variant does not support donation (its shard_map
+    carries the event arrays through a collective scan), so ``donate``
+    is normalized off there rather than cached under a key that lies.
+    """
+    if kind == "frontier":
+        donate = False
+    key = (kind, V, W, id(mesh) if mesh is not None else None,
+           shared_target, donate)
+    k = _KERNEL_REGISTRY.get(key)
+    if k is None:
+        donate_argnums = (0, 1, 2) if donate else ()
+        if donate:
+            _silence_donation_warning()
+        if kind == "data1":
+            k = jax.jit(jax.vmap(make_kernel(V, W),
+                                 in_axes=(0, 0, 0,
+                                          None if shared_target else 0)),
+                        donate_argnums=donate_argnums)
+        elif kind == "frontier":
+            from ..parallel.frontier import frontier_sharded_kernel
+            k = frontier_sharded_kernel(V, W, mesh, shared_target)
+        elif kind == "data":
+            from ..parallel.mesh import data_sharded_kernel
+            k = data_sharded_kernel(V, W, mesh, shared_target,
+                                    donate=donate)
+        else:
+            raise ValueError(f"unknown kernel kind {kind!r}")
+        _KERNEL_REGISTRY[key] = k
+    return k
+
+
+def log_kernel_shapes(V: int, W: int, kind: str, shared_target: bool,
+                      donate: bool, B: int, N: int) -> None:
+    """Record a dispatch shape (one registry entry per XLA compile)."""
+    KERNEL_SHAPE_LOG.add((kind, V, W, shared_target, donate, B, N))
 
 
 def batch_kernel(V: int, W: int, shared_target: bool = False):
     """``shared_target``: every row uses one transition table — the
     table is passed unbatched ([K+1, V]) and broadcast on device,
     saving the per-row transfer."""
-    key = (V, W, shared_target)
-    k = _BATCH_KERNELS.get(key)
-    if k is None:
-        k = jax.jit(jax.vmap(make_kernel(V, W),
-                             in_axes=(0, 0, 0,
-                                      None if shared_target else 0)))
-        _BATCH_KERNELS[key] = k
-    return k
+    return get_kernel(V, W, shared_target=shared_target)
 
 
 # Frontier-words budget per device dispatch: B * words(V) * 2^W uint32.
@@ -257,7 +335,6 @@ from collections import deque
 DISPATCH_LOG: "deque" = deque(maxlen=256)
 
 _PROD_MESHES: Dict[Tuple[int, int], object] = {}
-_SHARDED_KERNELS: Dict[Tuple, object] = {}
 
 
 def device_frontier_capacity() -> int:
@@ -295,17 +372,8 @@ def production_mesh(n_frontier: int = 1):
 
 def _sharded_kernel(kind: str, V: int, W: int, mesh,
                     shared_target: bool = False):
-    key = (kind, V, W, id(mesh), shared_target)
-    k = _SHARDED_KERNELS.get(key)
-    if k is None:
-        if kind == "frontier":
-            from ..parallel.frontier import frontier_sharded_kernel
-            k = frontier_sharded_kernel(V, W, mesh, shared_target)
-        else:
-            from ..parallel.mesh import data_sharded_kernel
-            k = data_sharded_kernel(V, W, mesh, shared_target)
-        _SHARDED_KERNELS[key] = k
-    return k
+    return get_kernel(V, W, kind="frontier" if kind == "frontier"
+                      else "data", mesh=mesh, shared_target=shared_target)
 
 
 def _pad_rows(batch: EncodedBatch, bp: int) -> Tuple[np.ndarray, ...]:
@@ -420,6 +488,8 @@ def _data1_dispatch(batch: EncodedBatch, return_frontier: bool,
     pending = []
     for lo in range(0, batch.batch, chunk):
         hi = min(lo + chunk, batch.batch)
+        log_kernel_shapes(batch.V, batch.W, "data1", batch.shared_target,
+                          False, hi - lo, batch.n_events)
         valid, bad, front = kern(
             batch.ev_type[lo:hi], batch.ev_slot[lo:hi],
             batch.ev_slots[lo:hi],
@@ -436,17 +506,90 @@ class WindowOverflow(Exception):
     can host; the rows belong on a host/native engine."""
 
 
+class DaemonFuture:
+    """A one-shot future computed on a DAEMON thread. Device dispatch
+    can wedge (a dead tunnel, a hung runtime) and neither jax nor the
+    native engine is interruptible mid-call — a non-daemon worker (or a
+    concurrent.futures pool, whose atexit hook joins its threads even
+    after shutdown(wait=False)) would then block interpreter exit, the
+    exact hazard LinearizableChecker._compete dodges the same way."""
+
+    def __init__(self, fn, *args, name: str = "jepsen-async"):
+        import queue
+        self._q: "queue.Queue" = queue.Queue(1)
+
+        def run():
+            try:
+                self._q.put((fn(*args), None))
+            except BaseException as e:   # noqa: BLE001 — relayed below
+                self._q.put((None, e))
+
+        import threading
+        threading.Thread(target=run, name=name, daemon=True).start()
+
+    def result(self):
+        r, err = self._q.get()
+        if err is not None:
+            raise err
+        return r
+
+
+def daemon_map(fn, items, max_workers: int = 12):
+    """Map ``fn`` over ``items`` on daemon worker threads, yielding
+    results in SUBMISSION order as they become consumable (the
+    run_buckets_threaded delivery contract)."""
+    items = list(items)
+    if not items:
+        return
+    if len(items) == 1:
+        yield fn(items[0])
+        return
+    import queue
+    import threading
+
+    slots = [queue.Queue(1) for _ in items]
+    feed: "queue.Queue" = queue.Queue()
+    for i, it in enumerate(items):
+        feed.put((i, it))
+
+    def work():
+        while True:
+            try:
+                i, it = feed.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                slots[i].put((fn(it), None))
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                slots[i].put((None, e))
+
+    for n in range(min(max_workers, len(items))):
+        threading.Thread(target=work, name=f"jepsen-bucket-{n}",
+                         daemon=True).start()
+    for s in slots:
+        r, err = s.get()
+        if err is not None:
+            raise err
+        yield r
+
+
 def run_buckets_threaded(batches: Sequence[EncodedBatch],
                          return_frontier: bool = False):
-    """Run many cost buckets concurrently from a thread pool and yield
-    (batch, (valid, bad, frontier) | WindowOverflow) pairs. JAX
+    """Run many cost buckets concurrently from a daemon-thread pool and
+    yield (batch, (valid, bad, frontier) | WindowOverflow) pairs. JAX
     execution is thread-safe; overlapping the per-call round trips is
     what keeps many-bucket batches fast when the device sits behind a
     link with real latency (PCIe queues locally, a network tunnel under
-    axon)."""
+    axon). Yields in SUBMISSION order (callers zip against their input
+    list) as results become consumable, so the caller's per-bucket host
+    work overlaps buckets still on device. Workers are daemon threads:
+    a wedged device dispatch must not block interpreter exit.
+
+    See ops.schedule.run_buckets_streamed for the pipelined variant
+    (W-class consolidation + chunked double-buffered dispatch); this
+    exact-bucket path remains the parity oracle for it."""
     if not batches:
         return []
-    from concurrent.futures import ThreadPoolExecutor
 
     def one(batch):
         try:
@@ -454,25 +597,7 @@ def run_buckets_threaded(batches: Sequence[EncodedBatch],
         except WindowOverflow as e:
             return batch, e
 
-    if len(batches) == 1:
-        return [one(batches[0])]
-    ex = ThreadPoolExecutor(min(12, len(batches)))
-    futs = [ex.submit(one, b) for b in batches]
-
-    def stream():
-        # Yield in SUBMISSION order (callers zip against their input
-        # list) as results become consumable, so the caller's
-        # per-bucket host work overlaps buckets still on device. A
-        # slow FIRST bucket still head-of-line blocks host work —
-        # completion-order delivery would need an order-free caller
-        # contract.
-        try:
-            for f in futs:
-                yield f.result()
-        finally:
-            ex.shutdown(wait=False)
-
-    return stream()
+    return daemon_map(one, batches)
 
 
 def _dispatch_sharded(kind: str, batch: EncodedBatch, mesh,
@@ -500,6 +625,8 @@ def _dispatch_sharded(kind: str, batch: EncodedBatch, mesh,
             target=batch.target[lo:hi], V=batch.V, W=batch.W,
             indices=[], failures=[], shared_target=batch.shared_target)
         ev_type, ev_slot, ev_slots, target = _pad_rows(sub, bp)
+        log_kernel_shapes(batch.V, batch.W, kind, batch.shared_target,
+                          False, bp, batch.n_events)
         valid, bad, front = kern(
             ev_type, ev_slot, ev_slots,
             batch.target[0] if batch.shared_target else target)
@@ -570,7 +697,8 @@ def _result_for(row: int, batch: EncodedBatch, valid: np.ndarray,
 
 def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
                     max_states: int = MAX_PACKED_STATES, max_slots: int = 16,
-                    host_fallback=None, min_device_batch: int = 1) -> List[dict]:
+                    host_fallback=None, min_device_batch: int = 1,
+                    scheduler: bool = True) -> List[dict]:
     """Check many raw histories on device; per-history result dicts.
 
     Histories the encoder cannot bound (state-space explosion, pending
@@ -579,6 +707,14 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
     ``min_device_batch`` go to the native CPU engine instead — the tail
     of info-heavy (large-W) histories is typically a handful of rows,
     not worth an XLA compile or the widest frontier.
+
+    ``scheduler=True`` (default) dispatches through the streaming
+    bucket scheduler (ops.schedule): exact-W buckets consolidate into
+    few W classes, chunks pipeline against host decode, and — because
+    small narrow buckets now merge into healthy classes — the
+    ``min_device_batch`` CPU route only applies to *wide*
+    (W >= DATA_MAX_SLOTS) stragglers. ``scheduler=False`` keeps the
+    one-kernel-per-exact-W flow (the parity oracle for the scheduler).
     """
     from ..checkers.linearizable import prepare_history, wgl_check
     from ..history.core import index as index_history
@@ -603,7 +739,11 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
     results: List[Optional[dict]] = [None] * len(histories)
     device_batches = []
     for batch in buckets:
-        if 0 < batch.batch < min_device_batch:
+        if 0 < batch.batch < min_device_batch and \
+                (not scheduler or batch.W >= DATA_MAX_SLOTS):
+            # Small-bucket CPU route. Under the scheduler, narrow small
+            # buckets instead merge into a consolidated class — only
+            # wide stragglers still ride the native engine.
             try:
                 from ..native import check_batch_native
                 rs = check_batch_native(model,
@@ -619,8 +759,12 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
             r = host_fallback(model, histories[i])
             r.setdefault("fallback", reason)
             results[i] = r
-    for batch, out in run_buckets_threaded(device_batches,
-                                           return_frontier=True):
+    if scheduler:
+        from .schedule import run_buckets_streamed
+        stream = run_buckets_streamed(device_batches, return_frontier=True)
+    else:
+        stream = run_buckets_threaded(device_batches, return_frontier=True)
+    for batch, out in stream:
         if isinstance(out, WindowOverflow):
             for i in batch.indices:
                 r = host_fallback(model, histories[i])
@@ -639,9 +783,53 @@ def check_one_tpu(model: Model, history: List[Op], **kw) -> dict:
     return check_batch_tpu(model, [history], **kw)[0]
 
 
+class _NativeTailWorker:
+    """Chews small wide (W >= DATA_MAX_SLOTS) buckets on the native CPU
+    engine, on a daemon thread UNDER the device window — the measured
+    device/native cost crossover for tiny wide dispatches. ``add``
+    enqueues row indices as the stream discovers them; ``finish``
+    returns [(row, result-or-None)] (None = native engine failed; the
+    caller re-routes those rows)."""
+
+    def __init__(self, model: Model, cols):
+        import queue
+        self.model = model
+        self.cols = cols
+        self._q: "queue.Queue" = queue.Queue()
+        self._fut: Optional[DaemonFuture] = None
+
+    def add(self, indices) -> None:
+        if self._fut is None:
+            self._fut = DaemonFuture(self._run, name="jepsen-native-tail")
+        self._q.put(list(indices))
+
+    def finish(self) -> list:
+        if self._fut is None:
+            return []
+        self._q.put(None)
+        return self._fut.result()
+
+    def _run(self) -> list:
+        from ..history.columnar import columnar_to_ops
+        from ..native import check_batch_native
+        out = []
+        while True:
+            idxs = self._q.get()
+            if idxs is None:
+                return out
+            try:
+                rs = check_batch_native(
+                    self.model,
+                    [columnar_to_ops(self.cols, i) for i in idxs])
+            except Exception:
+                out.extend((i, None) for i in idxs)
+                continue
+            out.extend(zip(idxs, rs))
+
+
 def check_columnar(model: Model, cols, *, max_slots: int = 16,
                    host_fallback=None, details=False,
-                   min_device_batch: int = 1):
+                   min_device_batch: int = 1, scheduler: bool = True):
     """Device-check a ColumnarOps batch end-to-end at tensor speed.
 
     Returns (valid [B] bool, bad [B] int32) — ``bad`` is the op index of
@@ -664,6 +852,14 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
     The reference renders analysis only for invalid results
     (checker.clj:98-103), so this is the replay product path's mode —
     it keeps the batch at tensor speed when most rows are clean.
+
+    ``scheduler=True`` (default) runs the whole thing through the
+    streaming bucket scheduler (ops.schedule): the columnar encode walk
+    chunks into groups that overlap device dispatch, exact-W buckets
+    consolidate into few W classes, and verdicts land incrementally as
+    chunks decode. ``scheduler=False`` keeps the fully-encoded
+    exact-W flow — the parity oracle the streamed path is tested
+    against.
     """
     from ..checkers.linearizable import wgl_check
     from ..history.columnar import columnar_to_ops
@@ -674,11 +870,10 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
     space = enumerate_statespace(model, cols.kinds, MAX_PACKED_STATES)
     eff_slots = max_slots + (device_frontier_capacity()
                              if max_slots >= DATA_MAX_SLOTS else 0)
-    buckets, failures = encode_columnar(space, cols, max_slots=eff_slots)
     valid = np.ones(cols.batch, bool)
     bad = np.full(cols.batch, INT32_MAX, np.int32)
     results: List[Optional[dict]] = [None] * cols.batch if details else None
-    failures = list(failures)
+    failures: List[Tuple[int, str]] = []
     host_fallback = host_fallback or wgl_check
     # Wide-tail shortcut: measured per-row device cost doubles per W
     # while the native engine's grows far more slowly — on one chip the
@@ -688,42 +883,41 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
     # otherwise-idle CPU UNDER the device window (the bench's overlap
     # discipline). Verdict-only and lazy-details callers only;
     # full-details mode keeps every row on device so valid rows'
-    # config samples stay device-derived.
-    tail_future = None
+    # config samples stay device-derived. Without the native engine the
+    # wide rows must STAY on device: the host fallback's cost grows
+    # exponentially in W, while the device check stays bounded.
+    tail = None
     if min_device_batch > 1 and details in (False, "invalid"):
-        # Without the native engine the wide rows must STAY on device:
-        # the host fallback's cost grows exponentially in W, while the
-        # device check stays bounded.
         try:
-            from ..native import check_batch_native
+            from ..native import check_batch_native  # noqa: F401
+            tail = _NativeTailWorker(model, cols)
         except Exception:
-            check_batch_native = None
-        small = ([b for b in buckets
-                  if b.W >= 16 and 0 < b.batch < min_device_batch]
-                 if check_batch_native is not None else [])
-        small_ids = {id(b) for b in small}
-        buckets = [b for b in buckets if id(b) not in small_ids]
-
-        def run_tail():
-            out = []          # (row, result-or-None)
+            tail = None
+    if scheduler:
+        from .schedule import (DIVERTED, BucketScheduler,
+                               iter_columnar_groups)
+        groups = iter_columnar_groups(space, cols, max_slots=eff_slots,
+                                      failures=failures)
+        sch = BucketScheduler(
+            return_frontier=details,
+            min_device_rows=min_device_batch if tail is not None else 0)
+        stream = sch.run(groups)
+    else:
+        DIVERTED = object()       # never yielded by the threaded path
+        buckets, fails = encode_columnar(space, cols, max_slots=eff_slots)
+        failures.extend(fails)
+        if tail is not None:
+            small = [b for b in buckets
+                     if b.W >= 16 and 0 < b.batch < min_device_batch]
+            small_ids = {id(b) for b in small}
+            buckets = [b for b in buckets if id(b) not in small_ids]
             for b in small:
-                try:
-                    rs = check_batch_native(
-                        model,
-                        [columnar_to_ops(cols, i) for i in b.indices])
-                except Exception:
-                    out.extend((i, None) for i in b.indices)
-                    continue
-                out.extend(zip(b.indices, rs))
-            return out
-
-        if small:
-            from concurrent.futures import ThreadPoolExecutor
-            _tail_ex = ThreadPoolExecutor(1)
-            tail_future = _tail_ex.submit(run_tail)
-            _tail_ex.shutdown(wait=False)
-    for batch, out in run_buckets_threaded(buckets,
-                                           return_frontier=details):
+                tail.add(b.indices)
+        stream = run_buckets_threaded(buckets, return_frontier=details)
+    for batch, out in stream:
+        if out is DIVERTED:
+            tail.add(batch.indices)
+            continue
         if isinstance(out, WindowOverflow):
             failures.extend((i, str(out)) for i in batch.indices)
             continue
@@ -750,8 +944,8 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
                     space, ops, bool(v[bi]), int(b[bi]),
                     int(bad[row]) if not bool(v[bi]) else -1, front[bi],
                     predropped=True)
-    if tail_future is not None:
-        for i, r in tail_future.result():
+    if tail is not None:
+        for i, r in tail.finish():
             if r is None:                    # native engine unavailable
                 failures.append((i, "small bucket"))
                 continue
@@ -780,7 +974,8 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
 def check_batch_columnar(model: Model, histories: Sequence[List[Op]], *,
                          max_slots: int = 16, max_states: int = 64,
                          host_fallback=None, details=True,
-                         min_device_batch: int = 1) -> List[dict]:
+                         min_device_batch: int = 1,
+                         scheduler: bool = True) -> List[dict]:
     """Check recorded Op-list histories through the columnar fast path:
     one fused conversion walk (history.columnar.ops_to_columnar), one
     vectorized encode, one device dispatch per cost bucket. Falls back
@@ -801,8 +996,10 @@ def check_batch_columnar(model: Model, histories: Sequence[List[Op]], *,
         return check_batch_tpu(model, histories, max_states=max_states,
                                max_slots=max_slots,
                                host_fallback=host_fallback,
-                               min_device_batch=min_device_batch)
+                               min_device_batch=min_device_batch,
+                               scheduler=scheduler)
     assert details in (True, "invalid"), details   # contract: List[dict]
     return check_columnar(model, cols, max_slots=max_slots, details=details,
                           host_fallback=host_fallback,
-                          min_device_batch=min_device_batch)
+                          min_device_batch=min_device_batch,
+                          scheduler=scheduler)

@@ -152,14 +152,21 @@ def make_frontier_kernel(V: int, W: int, D: int,
         F0 = (Fz[0].at[0].set(jnp.where(ax == 0, jnp.uint32(1),
                                         jnp.uint32(0))),) + Fz[1:]
         # The scan consumes data-sharded events, so its carry is varying
-        # over "data" — widen the initial carry's type to match.
+        # over "data" — widen the initial carry's type to match. jax
+        # without varying types (< pcast) skips the cast: the shard_map
+        # below runs unreplicated (check_rep=False) there, so carry
+        # types need no widening.
         extra = tuple(a for a in sync_axes if a != "frontier")
-        pcast = lambda x: lax.pcast(x, extra, to="varying")  # noqa: E731
+        if hasattr(lax, "pcast"):
+            pcast = lambda x: lax.pcast(x, extra, to="varying")  # noqa: E731
+            pcast_all = lambda x: lax.pcast(  # noqa: E731
+                x, tuple(sync_axes), to="varying")
+        else:
+            pcast = pcast_all = lambda x: x  # noqa: E731
         # Fbad is written from Fc (varying over EVERY mesh axis — F0
         # derives from axis_index), so its initial value must be too.
         carry = (tuple(pcast(f) for f in F0),
-                 tuple(lax.pcast(f, tuple(sync_axes), to="varying")
-                       for f in Fz),
+                 tuple(pcast_all(f) for f in Fz),
                  pcast(jnp.bool_(True)), pcast(jnp.int32(INT32_MAX)))
         (F, Fbad, valid, bad), _ = lax.scan(
             step, carry, (ev_type, ev_slot, ev_slots,
@@ -189,8 +196,14 @@ def frontier_sharded_kernel(V: int, W: int, mesh: Mesh,
                     in_axes=(0, 0, 0, None if shared_target else 0))
     ev = P("data", None)
     tgt = P(None, None) if shared_target else P("data", None, None)
+    kw = {}
+    if not hasattr(lax, "pcast"):
+        # Pre-varying-types jax: the replication checker can't see
+        # through the axis_index-seeded carry + collective while_loop;
+        # the out_specs still pin the sharding contract.
+        kw["check_rep"] = False
     sharded = shard_map(kern, mesh=mesh,
                         in_specs=(ev, ev, P("data", None, None), tgt),
                         out_specs=(P("data"), P("data"),
-                                   P("data", None, "frontier")))
+                                   P("data", None, "frontier")), **kw)
     return jax.jit(sharded)

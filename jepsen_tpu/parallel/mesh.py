@@ -69,14 +69,22 @@ def _batch_axes(mesh: Mesh) -> Tuple[str, ...]:
 
 
 def data_sharded_kernel(V: int, W: int, mesh: Mesh,
-                        shared_target: bool = False):
+                        shared_target: bool = False,
+                        donate: bool = False):
     """Compile the batched checker with the batch axis sharded over the
     mesh's batch axes (("data"), or ("dcn", "data") on a multi-host
     mesh). Returns check(ev_type [B,N], ev_slot [B,N],
     ev_slots [B,N,W], target [B,K+1,V]) -> (valid [B], bad [B],
     frontier [B, words(V), 2^W]); B must divide by the batch-axis size.
     ``shared_target``: target is one replicated [K+1, V] table instead
-    of a per-row batch (one transfer, not B)."""
+    of a per-row batch (one transfer, not B). ``donate``: the event
+    buffers are donated to the call (the chunk path ships each exactly
+    once).
+
+    Production dispatch resolves this builder through the process-wide
+    kernel registry (ops.linearize.get_kernel) — one cache for the
+    single-device, data-sharded, and frontier-sharded variants, so
+    compile accounting and pre-warming see a single kernel set."""
     axes = _batch_axes(mesh)
     batch_spec = NamedSharding(mesh, P(axes))
     out_spec = NamedSharding(mesh, P(axes))
@@ -85,7 +93,8 @@ def data_sharded_kernel(V: int, W: int, mesh: Mesh,
                     in_axes=(0, 0, 0, None if shared_target else 0))
     return jax.jit(kern,
                    in_shardings=(batch_spec,) * 3 + (tgt_spec,),
-                   out_shardings=(out_spec, out_spec, out_spec))
+                   out_shardings=(out_spec, out_spec, out_spec),
+                   donate_argnums=(0, 1, 2) if donate else ())
 
 
 def summarize_verdicts(valid: jnp.ndarray) -> dict:

@@ -418,9 +418,18 @@ def run_seeds(builder: Callable[[int], dict], seeds,
                 store_mod.attach(t)
             # Record the handle BEFORE running: a mid-batch crash must
             # still detach this run's log handler in the finally below.
-            if t.get("store_handle") is not None:
-                handles.append(t["store_handle"])
-            tests.append(run(t, analyze=False))
+            h = t.get("store_handle")
+            if h is not None:
+                handles.append(h)
+            try:
+                tests.append(run(t, analyze=False))
+            finally:
+                # Detach THIS run's handler as soon as its execution
+                # completes — handlers stack on the root logger, so
+                # leaving it attached would duplicate every later
+                # seed's lines into this run's run.log.
+                if h is not None:
+                    h.stop_logging()
 
         assert all(t.get("model") == tests[0].get("model")
                    for t in tests), \
@@ -451,8 +460,18 @@ def run_seeds(builder: Callable[[int], dict], seeds,
             log.info("Pooled linearizability dispatch: %d units across "
                      "%d seeded runs", len(units), len(tests))
         for t in tests:
-            analyze_run(t)
+            # Re-attach the run's own handler for its analysis phase so
+            # analysis lines land in the right run.log and nowhere else.
+            h = t.get("store_handle")
+            if h is not None:
+                h.start_logging()
+            try:
+                analyze_run(t)
+            finally:
+                if h is not None:
+                    h.stop_logging()
     finally:
+        # Safety net for mid-batch crashes (stop_logging is idempotent).
         for handle in handles:
             handle.stop_logging()
     return tests

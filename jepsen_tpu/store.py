@@ -361,27 +361,42 @@ class Store:
         kind = np.full((B, n), -1, np.int32)
         index = np.full((B, n), -1, np.int32)
         for r, (_, m, kjson, raw, off) in enumerate(rows):
-            lut = lut_cache.get(kjson)
-            if lut is None:
-                ks = _kinds_from_json(kjson)
-                # Slot -1 keeps non-invoke lines' -1 (negative
-                # indexing hits it).
-                lut = np.empty(len(ks) + 1, np.int32)
-                for i, k in enumerate(ks):
-                    j = vocab.get(k)
-                    if j is None:
-                        j = vocab[k] = len(kinds)
-                        kinds.append(k)
-                    lut[i] = j
-                lut[-1] = -1
-                lut_cache[kjson] = lut
-            type_[r, :m] = np.frombuffer(raw, np.int8, m, off)
-            off += m
-            process[r, :m] = np.frombuffer(raw, np.int16, m, off)
-            off += 2 * m
-            kind[r, :m] = lut[np.frombuffer(raw, np.int32, m, off)]
-            off += 4 * m
-            index[r, :m] = np.frombuffer(raw, np.int32, m, off)
+            # Same all-or-nothing discipline as the header checks: a
+            # sidecar that passes magic/length/model but carries a
+            # corrupt kinds vocabulary or out-of-range kind indices
+            # must send the batch to the text path, not crash recheck
+            # (IndexError) or silently alias into wrong kinds (negative
+            # indices in [-len(lut), -2]) — wrong verdicts.
+            try:
+                lut = lut_cache.get(kjson)
+                if lut is None:
+                    ks = _kinds_from_json(kjson)
+                    # Slot -1 keeps non-invoke lines' -1 (negative
+                    # indexing hits it).
+                    lut = np.empty(len(ks) + 1, np.int32)
+                    for i, k in enumerate(ks):
+                        j = vocab.get(k)
+                        if j is None:
+                            j = vocab[k] = len(kinds)
+                            kinds.append(k)
+                        lut[i] = j
+                    lut[-1] = -1
+                    lut_cache[kjson] = lut
+                type_[r, :m] = np.frombuffer(raw, np.int8, m, off)
+                off += m
+                process[r, :m] = np.frombuffer(raw, np.int16, m, off)
+                off += 2 * m
+                kraw = np.frombuffer(raw, np.int32, m, off)
+                # Valid kind indices are exactly [-1, len(ks)): -1 is
+                # the non-invoke sentinel (lut's last slot).
+                if kraw.size and (int(kraw.min()) < -1
+                                  or int(kraw.max()) >= lut.size - 1):
+                    return None
+                kind[r, :m] = lut[kraw]
+                off += 4 * m
+                index[r, :m] = np.frombuffer(raw, np.int32, m, off)
+            except Exception:
+                return None
         cols = ColumnarOps(type=type_, process=process, kind=kind,
                            kinds=kinds, index=index)
         return cols, [(t, None) for t, _, _, _, _ in rows]

@@ -142,6 +142,14 @@ class CasdDB(DB):
         if not cu.exists(f"{d}/casd"):
             c.exec_("g++", "-O2", "-std=c++17", "-o", f"{d}/casd",
                     f"{d}/casd.cpp", "-lpthread")
+        # Stale harness bookkeeping from a run that crashed before
+        # teardown must not leak into this one: casd-wipe.state records
+        # "the seeded wipe already fired", so loading a leftover copy
+        # silently disarms a deterministic seeded-violation test (the
+        # run shapes like a pass). A stale pidfile can likewise confuse
+        # start-stop-daemon. The WAL is left alone — persist=True means
+        # surviving restarts is the point.
+        c.exec_("rm", "-f", f"{d}/casd-wipe.state", f"{d}/casd.pid")
         port = test["casd_ports"][node]
         args = ["--port", port]
         if self.persist:

@@ -2,6 +2,15 @@
 multi-chip sharding paths compile and execute without TPU hardware.
 Must run before jax is imported anywhere (jepsen_tpu.provision is
 import-light; device benchmarking lives in bench.py)."""
+import os
+
 from jepsen_tpu.provision import provision_in_process
+
+# The persistent compilation cache trades ~0.6s of serialization per
+# compile for near-zero compiles on repeat processes — right for bench
+# and production, wrong for a suite that compiles hundreds of tiny
+# throwaway kernels in one process. Tests that exercise the cache
+# itself opt back in explicitly.
+os.environ.setdefault("JT_COMPILE_CACHE", "0")
 
 provision_in_process(8)

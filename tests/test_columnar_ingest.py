@@ -516,3 +516,31 @@ def test_machine_form_torn_sidecar_falls_back(tmp_path, model):
     f.write_bytes(b"garbage")                     # not even magic
     rr = store.recheck("torn", model)
     assert rr["runs"]["r0"]["valid"] is False
+
+
+def test_machine_form_corrupt_kind_index_falls_back(tmp_path, model):
+    """A sidecar that passes the magic/length/model header checks but
+    carries out-of-range kind indices must also degrade to the text
+    path: a large index would crash recheck with IndexError, and a
+    negative one in [-len(lut), -2] would silently ALIAS into a wrong
+    kind — wrong verdicts, the worse failure."""
+    import json as _json
+
+    from jepsen_tpu.store import Store
+
+    h = index_history([invoke_op(0, "write", 1), ok_op(0, "write", 1),
+                       invoke_op(1, "read", None), ok_op(1, "read", 2)])
+    store = Store(base=tmp_path)
+    store.create("alias", ts="r0").save_history(h, model=model)
+    f = store.run_dir("alias", "r0") / "history.cols.bin"
+    raw = f.read_bytes()
+    hlen = int.from_bytes(raw[8:12], "little")
+    n = int(_json.loads(raw[12:12 + hlen])["n"])
+    kind_off = 12 + hlen + n + 2 * n      # past int8 type + int16 proc
+    for bad in (10_000, -5):
+        patched = bytearray(raw)
+        patched[kind_off:kind_off + 4] = int(bad).to_bytes(
+            4, "little", signed=True)
+        f.write_bytes(bytes(patched))
+        rr = store.recheck("alias", model)
+        assert rr["runs"]["r0"]["valid"] is False  # text path verdict

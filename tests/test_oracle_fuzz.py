@@ -179,6 +179,25 @@ def test_fuzz_tpu_engine_matches_oracle(blind_corpus, oracle_verdicts):
     assert bad == [], bad[:5]
 
 
+def test_fuzz_streamed_scheduler_matches_exact_path(blind_corpus):
+    """The streamed bucket scheduler (ops.schedule) vs the exact-W flow
+    on the full blind corpus, field-for-field: valid, bad op index, and
+    counterexample configs must all match. (The streamed path is also
+    pinned to the brute oracle corpus-wide: check_batch_tpu defaults to
+    scheduler=True, so test_fuzz_tpu_engine_matches_oracle runs it.)"""
+    from jepsen_tpu.ops.linearize import check_batch_tpu
+    for family, (model, hists) in sorted(blind_corpus.items()):
+        streamed = check_batch_tpu(model, hists, max_states=24,
+                                   scheduler=True)
+        exact = check_batch_tpu(model, hists, max_states=24,
+                                scheduler=False)
+        for i, (s, e) in enumerate(zip(streamed, exact, strict=True)):
+            assert s["valid"] == e["valid"], (family, i)
+            if s["valid"] is False:
+                assert s["op"]["index"] == e["op"]["index"], (family, i)
+            assert s.get("configs") == e.get("configs"), (family, i)
+
+
 def test_fuzz_competition_engine_matches_oracle(blind_corpus,
                                                 oracle_verdicts):
     """Competition races native vs device per history — per-call cost

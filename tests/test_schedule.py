@@ -1,0 +1,259 @@
+"""Streaming bucket scheduler: consolidation semantics + pipeline parity.
+
+The exact-W flow (``scheduler=False`` / run_buckets_threaded) is the
+parity oracle: the streamed scheduler may re-partition, widen, chunk,
+and reorder dispatch however it likes, but every verdict, bad index,
+and counterexample config sample must come out field-for-field
+identical. Also pinned here: why widening is safe (a W=5 history under
+a W=8 class kernel returns bit-identical results, with the extra mask
+axis provably empty) and the W-class DP's budget/boundary contract.
+"""
+import numpy as np
+
+from jepsen_tpu.checkers.linearizable import prepare_history
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.ops.encode import bucket_encode, merge_batches, widen_batch
+from jepsen_tpu.ops.linearize import (check_batch_tpu, check_columnar,
+                                      run_buckets_threaded,
+                                      run_encoded_batch)
+from jepsen_tpu.ops.schedule import (BucketScheduler, choose_w_classes,
+                                     run_buckets_streamed)
+from jepsen_tpu.workloads.synth import synth_cas_columnar, synth_cas_history
+
+MODEL = cas_register()
+
+
+def mixed_w_histories(n=150, seed0=0):
+    """Histories across a spread of concurrency levels, with invalid
+    and info-heavy rows mixed in — several exact-W buckets per batch.
+    One shared corpus (default args) across the tests here, so the
+    exact-path oracle kernels compile once per event shape."""
+    return [synth_cas_history(seed0 + i, n_procs=2 + i % 7, n_ops=20,
+                              corrupt=0.4 if i % 3 == 0 else 0.0,
+                              p_info=0.25 if i % 4 == 0 else 0.0)
+            for i in range(n)]
+
+
+def mixed_w_buckets():
+    """The shared corpus encoded into its exact-W cost buckets.
+    Deliberately ONE corpus (default args) for every test that needs
+    mixed-W buckets: identical bucket shapes mean each oracle kernel
+    compiles once per process, not once per test."""
+    prepared = [prepare_history(h) for h in mixed_w_histories()]
+    buckets = bucket_encode(MODEL, prepared)
+    assert len({(b.V, b.W) for b in buckets}) >= 3, \
+        "workload must produce genuinely mixed W"
+    return buckets
+
+
+# ----------------------------------------------------- widening semantics
+
+def test_w5_history_under_w8_class_identical():
+    """The ISSUE's consolidation-safety witness: a W=5 bucket checked
+    under a W=8 class kernel returns identical verdicts and bad
+    indices, and the widened frontier is the original embedded in the
+    low 2^5 masks — the padded slots never acquire a bit."""
+    hists = [synth_cas_history(s, n_procs=5, n_ops=25,
+                               corrupt=0.5 if s % 2 else 0.0)
+             for s in range(40)]
+    prepared = [prepare_history(h) for h in hists]
+    b5s = [b for b in bucket_encode(MODEL, prepared, min_w=5) if b.W == 5]
+    assert b5s, "expected at least one W=5 bucket"
+    for b in b5s:
+        v5, bad5, f5 = run_encoded_batch(b, return_frontier=True)
+        w8 = widen_batch(b, 8)
+        assert w8.W == 8 and w8.ev_slots.shape[2] == 8
+        v8, bad8, f8 = run_encoded_batch(w8, return_frontier=True)
+        np.testing.assert_array_equal(np.asarray(v5), np.asarray(v8))
+        np.testing.assert_array_equal(np.asarray(bad5), np.asarray(bad8))
+        f5, f8 = np.asarray(f5), np.asarray(f8)
+        np.testing.assert_array_equal(f5, f8[:, :, :f5.shape[2]])
+        assert not f8[:, :, f5.shape[2]:].any(), \
+            "padded slots must never acquire frontier bits"
+
+
+def test_merge_batches_covers_and_preserves_rows():
+    buckets = mixed_w_buckets()
+    narrow = [b for b in buckets if b.W <= 8]
+    assert len(narrow) >= 2
+    merged = merge_batches(narrow)
+    assert merged.batch == sum(b.batch for b in narrow)
+    assert sorted(merged.indices) == sorted(i for b in narrow
+                                            for i in b.indices)
+    assert merged.W == max(b.W for b in narrow)
+    vm, badm, _ = run_encoded_batch(merged)
+    want = {}
+    for b in narrow:
+        v, bad, _ = run_encoded_batch(b)
+        for r, i in enumerate(b.indices):
+            want[i] = (bool(np.asarray(v)[r]),
+                       int(np.asarray(bad)[r]) if not np.asarray(v)[r]
+                       else None)
+    vm, badm = np.asarray(vm), np.asarray(badm)
+    for r, i in enumerate(merged.indices):
+        got = (bool(vm[r]), int(badm[r]) if not vm[r] else None)
+        assert got == want[i], f"row {i}: merged={got} exact={want[i]}"
+
+
+# ------------------------------------------------------- W-class cost DP
+
+def test_choose_w_classes_budget_boundary_and_shape():
+    # 13 narrow windows (the r05 bench mix's long tail) + one wide.
+    stats = {(8, w): float((17 - w) * 100) for w in range(4, 17)}
+    stats[(8, 18)] = 7.0
+    cls = choose_w_classes(stats, max_classes=5, boundary=16)
+    assert cls[(8, 18)] == 18              # wide windows stay exact
+    narrow = {w: c for (v, w), c in cls.items() if w <= 16}
+    assert set(narrow) == set(range(4, 17))
+    assert len(set(narrow.values())) <= 5  # the compile budget
+    for w, c in narrow.items():
+        assert c >= w                      # only ever widen
+    ws = sorted(narrow)
+    assert [narrow[w] for w in ws] == sorted(narrow[w] for w in ws), \
+        "classes must partition W into contiguous groups"
+    for c in set(narrow.values()):
+        assert narrow[c] == c, "each class is its group's widest member"
+
+
+def test_choose_w_classes_keeps_dominant_window_near_exact():
+    # One window carries ~all the cost: folding it upward multiplies
+    # the dominant term, so the DP must give it its own class.
+    stats = {(8, w): 1.0 for w in range(4, 17)}
+    stats[(8, 12)] = 1e6
+    cls = choose_w_classes(stats, max_classes=3, boundary=16)
+    assert cls[(8, 12)] == 12
+
+
+def test_choose_w_classes_under_budget_is_exact():
+    stats = {(8, 4): 5.0, (8, 7): 3.0, (16, 6): 2.0}
+    cls = choose_w_classes(stats, max_classes=5)
+    assert cls == {(8, 4): 4, (8, 7): 7, (16, 6): 6}
+
+
+def test_late_wide_window_stays_exact():
+    """A wide (W > DATA_MAX_SLOTS) window surfacing in a later
+    streaming group must freeze a new EXACT class, never ride a wider
+    frozen wide class — on the wide route cost is 2^W per row, so the
+    'free compiled kernel' shortcut would multiply the dominant
+    frontier traffic (only narrow windows may ride up)."""
+    from jepsen_tpu.ops.linearize import DATA_MAX_SLOTS
+    sch = BucketScheduler()
+    frozen = {(8, DATA_MAX_SLOTS + 4): DATA_MAX_SLOTS + 4,
+              (8, 6): 8, (8, 8): 8}
+    assert sch._class_of(dict(frozen), 8, DATA_MAX_SLOTS + 1) == \
+        DATA_MAX_SLOTS + 1
+    # Narrow late windows DO ride the next-wider frozen narrow class.
+    assert sch._class_of(dict(frozen), 8, 7) == 8
+    # ... unless consolidation is off: exact-W means exact for EVERY
+    # window, including ones first seen in later streaming groups.
+    exact_sch = BucketScheduler(consolidate=False)
+    assert exact_sch._class_of(dict(frozen), 8, 7) == 7
+
+
+def test_empty_first_group_defers_class_freeze():
+    """An all-failures first encode group must not freeze an empty
+    class plan (which would silently disable consolidation): classes
+    freeze on the first NON-empty group."""
+    buckets = mixed_w_buckets()
+    exact = {(b.V, b.W) for b in buckets}
+    sch = BucketScheduler(max_classes=2, chunk_rows=32)
+    pairs = list(sch.run(iter([[], list(buckets)])))
+    assert sorted(i for b, _ in pairs for i in b.indices) == \
+        sorted(i for b in buckets for i in b.indices)
+    assert len({(b.V, b.W) for b, _ in pairs}) < len(exact), \
+        "consolidation must survive an empty first group"
+
+
+# ------------------------------------------------------- streamed parity
+
+def test_run_buckets_streamed_scatter_parity():
+    """Verdict/bad-index parity with run_buckets_threaded on mixed-W
+    buckets, scattered through indices (the consolidated buckets are
+    NOT the input buckets — positional zips are meaningless)."""
+    buckets = mixed_w_buckets()
+    want_v, want_bad = {}, {}
+    for b, out in run_buckets_threaded(buckets):
+        v, bad, _ = np.asarray(out[0]), np.asarray(out[1]), out[2]
+        for r, i in enumerate(b.indices):
+            want_v[i] = bool(v[r])
+            if not v[r]:
+                want_bad[i] = int(bad[r])
+    got_v, got_bad = {}, {}
+    n_classes = set()
+    for b, out in run_buckets_streamed(list(buckets), max_classes=2,
+                                       chunk_rows=32):
+        n_classes.add((b.V, b.W))
+        v, bad = np.asarray(out[0]), np.asarray(out[1])
+        for r, i in enumerate(b.indices):
+            got_v[i] = bool(v[r])
+            if not v[r]:
+                got_bad[i] = int(bad[r])
+    assert got_v == want_v
+    assert got_bad == want_bad
+    assert len(n_classes) < len({(b.V, b.W) for b in buckets}), \
+        "consolidation must actually reduce the kernel set"
+
+
+def test_scheduler_streams_chunks_and_reports_stats():
+    buckets = mixed_w_buckets()
+    seen = []
+    sch = BucketScheduler(max_classes=2, chunk_rows=32,
+                          on_chunk=lambda b, lo, hi, v, bad, fr:
+                          seen.append((lo, hi, len(v))))
+    pairs = list(sch.run(buckets))
+    covered = sorted(i for b, _ in pairs for i in b.indices)
+    assert covered == sorted(i for b in buckets for i in b.indices)
+    assert len(seen) >= 2, "chunking must actually split the batch"
+    assert all(n == hi - lo for lo, hi, n in seen)
+    # Every row's verdict arrives through exactly one on_chunk call —
+    # pipeline chunks AND whole-bucket sharded dispatches both fire it.
+    assert sum(n for _, _, n in seen) == sum(b.batch for b in buckets)
+    st = sch.stats
+    assert st["chunks"] <= len(seen)
+    assert st["rows"] == sum(b.batch for b in buckets)
+    assert st["t_first_verdict_s"] is not None
+    assert st["t_first_verdict_s"] <= st["wall_s"]
+    assert st["classes"], "frozen class plan must be reported"
+
+
+def test_check_batch_tpu_streamed_field_parity():
+    """check_batch_tpu(scheduler=True) vs the exact-W path: valid, bad
+    op index, AND counterexample config samples all match — the full
+    result-dict contract, not just the verdict bit."""
+    hists = mixed_w_histories()
+    a = check_batch_tpu(MODEL, hists, scheduler=True)
+    b = check_batch_tpu(MODEL, hists, scheduler=False)
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert x["valid"] == y["valid"], f"history {i}"
+        if x["valid"] is False:
+            assert x["op"]["index"] == y["op"]["index"], f"history {i}"
+        assert x.get("configs") == y.get("configs"), f"history {i}"
+
+
+def _shared_cols():
+    # One columnar corpus for both check_columnar parity tests: same
+    # bucket shapes, so the exact-path oracle kernels compile once.
+    return synth_cas_columnar(250, seed=7, corrupt=0.25, p_info=0.1)
+
+
+def test_check_columnar_streamed_parity():
+    cols = _shared_cols()
+    va, ba = check_columnar(MODEL, cols, scheduler=True)
+    vb, bb = check_columnar(MODEL, cols, scheduler=False)
+    np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+    np.testing.assert_array_equal(np.asarray(ba), np.asarray(bb))
+
+
+def test_check_columnar_streamed_details_parity():
+    cols = _shared_cols()
+    ra = check_columnar(MODEL, cols, details="invalid", scheduler=True)
+    rb = check_columnar(MODEL, cols, details="invalid", scheduler=False)
+    assert len(ra) == len(rb) == cols.batch
+    n_invalid = 0
+    for i, (x, y) in enumerate(zip(ra, rb)):
+        assert x["valid"] == y["valid"], f"row {i}"
+        if x["valid"] is False:
+            n_invalid += 1
+            assert x["op"]["index"] == y["op"]["index"], f"row {i}"
+            assert x.get("configs") == y.get("configs"), f"row {i}"
+    assert n_invalid, "corrupt batch must exercise the invalid path"
