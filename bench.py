@@ -22,9 +22,15 @@ per history, default 500 → 1k history lines), JT_BENCH_REPEATS,
 JT_BENCH_STORE_B (runs in the store→recheck figure),
 JT_BENCH_FULL_PARITY=0 (fall back to sampled parity for quick local
 runs), JT_SCHED_CLASSES / JT_SCHED_CHUNK_ROWS / JT_SCHED_ENCODE_ROWS
-(streaming scheduler knobs, see ops/schedule.py). Narrow buckets all
-stay on device now (the scheduler consolidates them into W classes);
-only tiny wide buckets route to the native CPU engine.
+(streaming scheduler knobs, see ops/schedule.py), JT_BENCH_XLONG_B/
+JT_BENCH_XLONG_OPS (the 100-history x 100k-line probe; 0 skips),
+JT_BENCH_VPU_GOPS / JT_BENCH_HBM_PEAK_GBPS (roofline ceilings),
+JT_FUSE_KINDS (event-fusion vocabulary budget, ops/encode.py). Narrow
+buckets all stay on device (the scheduler consolidates them into W
+classes); only tiny wide buckets route to the native CPU engine. The
+encode runs the production shrink passes (event fusion + state
+renumbering); parity stays full because fused-run failures are
+re-derived exactly before comparing.
 """
 import json
 import os
@@ -82,9 +88,15 @@ def main():
     eff_slots = DATA_MAX_SLOTS + device_frontier_capacity()
 
     def encode(c):
+        # Production encode settings: event fusion (single-candidate
+        # runs collapse to EV_FUSED steps) + live-alphabet state
+        # renumbering. The parity section below still compares against
+        # the exact engines, and rows that fail INSIDE a fused run are
+        # re-derived exactly (fused-bad refinement, also timed).
         space = enumerate_statespace(model, c.kinds, 64)
         buckets, failures = encode_columnar(space, c,
-                                            max_slots=DATA_MAX_SLOTS)
+                                            max_slots=DATA_MAX_SLOTS,
+                                            fuse=True, renumber=True)
         if failures and eff_slots > DATA_MAX_SLOTS:
             rows = [i for i, _ in failures]
             sub = type(c)(type=c.type[rows],
@@ -93,7 +105,8 @@ def main():
                           index=(c.index[rows]
                                  if c.index is not None else None))
             wide, failures = encode_columnar(space, sub,
-                                             max_slots=eff_slots)
+                                             max_slots=eff_slots,
+                                             fuse=True, renumber=True)
             for b in wide:
                 b.indices = [rows[i] for i in b.indices]
             failures = [(rows[i], why) for i, why in failures]
@@ -142,6 +155,24 @@ def main():
             rs = [wgl_check(model, h) for h in cpu_hists]
         return sum(1 for r in rs if r["valid"] is not True)
 
+    def refine_fused(pairs):
+        # Rows whose first impossible completion fell inside a fused
+        # run only know the run's first member: re-derive the exact
+        # bad index on the native engine (part of verdict production,
+        # so it stays inside the timed window).
+        from jepsen_tpu.ops.linearize import fused_bad_rows
+        rows = []
+        for b, (v, bd, _) in pairs:
+            rows.extend(b.indices[int(r)]
+                        for r in fused_bad_rows(b, v, bd))
+        if not rows:
+            return {}
+        hs = [columnar_to_ops(cols, i) for i in rows]
+        rs = (check_batch_native(model, hs) if check_batch_native
+              else [wgl_check(model, h) for h in hs])
+        return {i: r["op"]["index"] for i, r in zip(rows, rs)
+                if r["valid"] is False}
+
     def run_all(stats_out=None):
         # Device buckets ride the streaming scheduler (W-class
         # consolidation + chunked double-buffered dispatch); the CPU
@@ -155,20 +186,22 @@ def main():
         with ThreadPoolExecutor(1) as ex:
             tail = ex.submit(cpu_tail)
             pairs = list(sch.run(dev_buckets))
+            refined = refine_fused(pairs)
             n_bad = tail.result()
         if stats_out is not None:
             stats_out.update(sch.stats)
-        return pairs, n_bad
+        return pairs, n_bad, refined
 
     # Warmup / compile. The first run pays every kernel compile this
     # mix needs (persistent cache: near-zero on repeat processes);
     # sched_stats["compiled_shapes"] is the headline compile count.
     sched_stats = {}
     t0 = time.time()
-    pairs, cpu_bad = run_all(stats_out=sched_stats)
+    pairs, cpu_bad, refined = run_all(stats_out=sched_stats)
     t_compile = time.time() - t0
     kernel_compiles = sched_stats.get("compiled_shapes")
     w_classes = sched_stats.get("classes")
+    fusion_ratio = sched_stats.get("fusion_ratio")
 
     # Median-of-N: honest against tunnel jitter in both directions
     # (min-of-N hid slow outliers; a single slow run would lie the
@@ -177,7 +210,7 @@ def main():
     times = []
     for _ in range(repeats):
         t0 = time.time()
-        pairs, cpu_bad = run_all()
+        pairs, cpu_bad, refined = run_all()
         times.append(time.time() - t0)
     t_dev = statistics.median(times)
 
@@ -209,7 +242,8 @@ def main():
             tail = ex.submit(cpu_tail)
             groups = iter_columnar_groups(space_s, cols,
                                           max_slots=eff_slots,
-                                          failures=[])
+                                          failures=[], fuse=True,
+                                          renumber=True)
             for bt, out in sch.run(groups):
                 if out is DIVERTED:
                     diverted.extend(bt.indices)
@@ -242,7 +276,15 @@ def main():
     # beside it. This backs the "bandwidth-competitive" claim with a
     # measured figure instead of an argument — utilization is against
     # the chip's HBM peak (JT_BENCH_HBM_PEAK_GBPS, default 819 = v5e).
+    # Because the dominant buckets' frontiers live in VMEM, the real
+    # ceiling is VPU integer throughput: vpu_util divides the kernel's
+    # analytic lane-op count (ops.linearize.vpu_op_model, fed by the
+    # instrumented kernel's MEASURED closure-iteration totals) by the
+    # chip's assumed VPU peak (JT_BENCH_VPU_GOPS, default 6800 = 8x128
+    # lanes x 4 ALUs x ~1.66 GHz, the v5e derivation in
+    # doc/scaling.md).
     peak_gbps = float(os.environ.get("JT_BENCH_HBM_PEAK_GBPS", "819"))
+    vpu_gops = float(os.environ.get("JT_BENCH_VPU_GOPS", "6800"))
 
     def bucket_traffic(b):
         return b.batch * b.ev_opidx.shape[-1] * b.V * (2 ** b.W) // 8 * 2
@@ -252,6 +294,9 @@ def main():
     disp_buckets = [b for b, _ in pairs]
     traffic = sum(bucket_traffic(b) for b in disp_buckets)
     events = sum(b.batch * b.ev_opidx.shape[-1] for b in disp_buckets)
+    orig_events = sum(
+        int(b.orig_n_events.sum()) if b.orig_n_events is not None
+        else b.batch * b.ev_opidx.shape[-1] for b in disp_buckets)
     # Device-only denominator: t_dev is run_all() wall time, i.e.
     # max(device, overlapped CPU tail) — a slow tail would deflate the
     # published bandwidth figure.
@@ -261,12 +306,59 @@ def main():
         list(BucketScheduler().run(dev_buckets))
         dts.append(time.time() - t0)
     t_dev_only = statistics.median(dts)
+
+    # Measured VPU op count: one instrumented pass over the dispatched
+    # narrow buckets collects total closure while_loop iterations per
+    # row; the analytic per-iteration/per-event lane-op model turns
+    # that into uint32 VPU ops. (Separate pass — the counter output
+    # changes the compiled kernel — so it never pollutes the timings.)
+    from jepsen_tpu.ops.linearize import (MAX_FRONTIER_ELEMENTS,
+                                          get_kernel, n_state_words,
+                                          vpu_op_model)
+    vpu_ops = 0.0
+    iters_total = 0
+    for b in disp_buckets:
+        if b.W > DATA_MAX_SLOTS or not b.batch:
+            continue
+        kern = get_kernel(b.V, b.W, shared_target=b.shared_target,
+                          w_live=b.eff_w_live, instrument=True)
+        per_hist = n_state_words(b.V) << b.W
+        chunk = max(1, MAX_FRONTIER_ELEMENTS // per_hist)
+        iters = 0
+        for lo in range(0, b.batch, chunk):
+            hi = min(lo + chunk, b.batch)
+            out = kern(b.ev_type[lo:hi], b.ev_slot[lo:hi],
+                       b.ev_slots[lo:hi],
+                       b.target[0] if b.shared_target
+                       else b.target[lo:hi])
+            iters += int(np.asarray(out[3]).sum())
+        m = vpu_op_model(b.V, b.W, b.eff_w_live)
+        vpu_ops += (iters * m["per_iteration"]
+                    + b.batch * b.ev_opidx.shape[-1] * m["per_event"])
+        iters_total += iters
+    vpu_util = vpu_ops / t_dev_only / (vpu_gops * 1e9)
+
+    # Mean live pending slots per dispatched scan step — the closure's
+    # real work bound (w_live kernels unroll only this neighborhood).
+    live_sum = ev_n = 0
+    for b in dev_buckets:
+        sent = b.target.shape[1] - 1
+        real = b.ev_type != 0                     # != EV_PAD
+        live_sum += int(((b.ev_slots != sent).sum(axis=2) * real).sum())
+        ev_n += int(real.sum())
+    mean_live_slots = round(live_sum / max(ev_n, 1), 3)
+
     roofline = {
         "traffic_gb": round(traffic / 1e9, 2),
         "achieved_gbps": round(traffic / t_dev_only / 1e9, 2),
         "events_per_s": round(events / t_dev_only, 1),
+        "source_events_per_s": round(orig_events / t_dev_only, 1),
         "hbm_util": round(traffic / t_dev_only / (peak_gbps * 1e9), 4),
         "peak_gbps_assumed": peak_gbps,
+        "vpu_util": round(vpu_util, 4),
+        "vpu_ops_e12": round(vpu_ops / 1e12, 4),
+        "vpu_gops_assumed": vpu_gops,
+        "closure_iters_total": iters_total,
         "device_only_time_s": round(t_dev_only, 3),
         "dominant_buckets": [
             [b.V, b.W, b.batch]
@@ -284,6 +376,8 @@ def main():
         iv = idx[~np.asarray(v)]
         dev_bad[iv] = b.ev_opidx[np.nonzero(~np.asarray(v))[0],
                                  np.asarray(bd)[~np.asarray(v)]]
+    for i, op_idx in refined.items():        # exact fused-run bad ops
+        dev_bad[i] = op_idx
     skip = set(cpu_rows)                     # rows the device never saw
     row_w = np.zeros(B, np.int32)
     for b in disp_buckets:
@@ -359,7 +453,8 @@ def main():
         ccols = ops_to_columnar(model, conv_hists[:C])
         space_c = enumerate_statespace(model, ccols.kinds, 64)
         cbuckets, cfails = encode_columnar(space_c, ccols,
-                                           max_slots=eff_slots)
+                                           max_slots=eff_slots,
+                                           fuse=True, renumber=True)
         cdev, ccpu = route(cbuckets, cfails)
         cvalid = np.ones(C, bool)
 
@@ -466,41 +561,56 @@ def main():
     # (doc/scaling.md "History length").
     LB = int(os.environ.get("JT_BENCH_LONG_B", "1000"))
     LOPS = int(os.environ.get("JT_BENCH_LONG_OPS", "5000"))
-    long_stats = None
-    if LB:
-        # p_info=0: pinned info slots accumulate with history LENGTH
-        # (1% of 5k pairs ~ 50 pinned slots >> any window), which is
-        # the W axis, not the op axis. The probe measures op-axis
-        # scaling; info-density costs are the headline run's domain.
-        def probe(n_ops, seed):
-            c = synth_cas_columnar(LB, seed=seed, n_procs=5,
-                                   n_ops=n_ops, n_values=5,
-                                   corrupt=0.1, p_info=0.0)
-            t0 = time.time()
-            bkts, fails = encode(c)
-            t_enc = time.time() - t0
-            dev, cpu = route(bkts, fails)
-            list(BucketScheduler().run(dev))          # warm compile
-            ts = []
-            for _ in range(max(2, repeats)):
-                t0 = time.time()
-                outs_p = [o for _, o in BucketScheduler().run(dev)]
-                ts.append(time.time() - t0)
-            t = statistics.median(ts)
-            n = sum(b.batch for b in dev)
-            ev = sum(b.batch * b.ev_opidx.shape[-1] for b in dev)
-            bad = int(sum(int((~v).sum()) for v, _, _ in outs_p))
-            return {"histories": n, "rate": round(n / (t_enc + t), 2),
-                    "events_per_s": round(ev / t, 1),
-                    "encode_s": round(t_enc, 3),
-                    "device_s": round(t, 3),
-                    "cpu_routed": len(cpu), "invalid": bad}
+    XB = int(os.environ.get("JT_BENCH_XLONG_B", "100"))
+    XOPS = int(os.environ.get("JT_BENCH_XLONG_OPS", "50000"))
+    long_stats = xlong_stats = None
 
+    # p_info=0: pinned info slots accumulate with history LENGTH
+    # (1% of 5k pairs ~ 50 pinned slots >> any window), which is
+    # the W axis, not the op axis. The probe measures op-axis
+    # scaling; info-density costs are the headline run's domain.
+    def probe(n_hist, n_ops, seed, keep_dev=None):
+        c = synth_cas_columnar(n_hist, seed=seed, n_procs=5,
+                               n_ops=n_ops, n_values=5,
+                               corrupt=0.1, p_info=0.0)
+        t0 = time.time()
+        bkts, fails = encode(c)
+        t_enc = time.time() - t0
+        dev, cpu = route(bkts, fails)
+        if keep_dev is not None:
+            keep_dev.extend(dev)
+        list(BucketScheduler().run(dev))          # warm compile
+        ts = []
+        for _ in range(max(2, repeats)):
+            t0 = time.time()
+            outs_p = [o for _, o in BucketScheduler().run(dev)]
+            ts.append(time.time() - t0)
+        t = statistics.median(ts)
+        n = sum(b.batch for b in dev)
+        ev = sum(b.batch * b.ev_opidx.shape[-1] for b in dev)
+        # fusion_ratio is original events per REAL scan step — padding
+        # is not (anti-)fusion, so count ev_type != EV_PAD, not the
+        # padded event axis (which events_per_s deliberately keeps for
+        # continuity with earlier rounds' dispatched-steps figure).
+        real_ev = sum(int((b.ev_type != 0).sum()) for b in dev)
+        oev = sum(int(b.orig_n_events.sum())
+                  if b.orig_n_events is not None
+                  else int((b.ev_type != 0).sum()) for b in dev)
+        bad = int(sum(int((~v).sum()) for v, _, _ in outs_p))
+        return {"histories": n, "rate": round(n / (t_enc + t), 2),
+                "events_per_s": round(ev / t, 1),
+                "source_events_per_s": round(oev / t, 1),
+                "fusion_ratio": round(oev / max(real_ev, 1), 4),
+                "encode_s": round(t_enc, 3),
+                "device_s": round(t, 3),
+                "cpu_routed": len(cpu), "invalid": bad}
+
+    if LB:
         # Same W profile (p_info=0) at both lengths, so events/s is an
         # apples-to-apples per-event cost — the op-axis ratio should
         # hold near (or above, amortized dispatch) 1.0.
-        short = probe(n_ops, seed=3)
-        long_ = probe(LOPS, seed=2)
+        short = probe(LB, n_ops, seed=3)
+        long_ = probe(LB, LOPS, seed=2)
         long_stats = {
             "ops_per_history": LOPS * 2,
             "long": long_,
@@ -509,6 +619,36 @@ def main():
                 long_["events_per_s"]
                 / max(short["events_per_s"], 1e-9), 3),
         }
+
+    if XB:
+        # 100k-op probe: where does the time go when one history is 100
+        # thousand lines — encode walk or device scan? encode_s vs
+        # device_s is the breakdown VERDICT round 5 asked for. The
+        # event axis can also dispatch in carried chunks
+        # (run_event_chunked, double-buffered by jax's async dispatch);
+        # JT_BENCH_EVENT_CHUNK > 0 measures that path too so a scan-
+        # length stall would show up as chunking winning.
+        xdev = []
+        xlong_stats = {"ops_per_history": XOPS * 2,
+                       **probe(XB, XOPS, seed=4, keep_dev=xdev)}
+        echunk = int(os.environ.get("JT_BENCH_EVENT_CHUNK", "8192"))
+        if echunk:
+            from jepsen_tpu.ops.linearize import run_event_chunked
+            dev = [b for b in xdev if b.W <= DATA_MAX_SLOTS]
+            for b in dev:                         # warm the compiles
+                run_event_chunked(b, echunk)
+            ts = []
+            for _ in range(max(2, repeats)):
+                t0 = time.time()
+                for b in dev:
+                    run_event_chunked(b, echunk)
+                ts.append(time.time() - t0)
+            ev = sum(b.batch * b.ev_opidx.shape[-1] for b in dev)
+            t = statistics.median(ts)
+            xlong_stats["event_chunked"] = {
+                "chunk_events": echunk,
+                "device_s": round(t, 3),
+                "events_per_s": round(ev / t, 1)}
 
     print(json.dumps({
         "metric": "linearizability_check_throughput_1kop_cas_e2e",
@@ -538,6 +678,9 @@ def main():
         "fold_total_queue_rate": round(fold_rate, 2),
         "fold_histories": FB,
         "fold_invalid": fold_invalid,
+        "fusion_ratio": fusion_ratio,
+        "mean_live_slots": mean_live_slots,
+        "fused_bad_refined": len(refined),
         "scheduler": {
             # Compile count for the standard mix: distinct kernel
             # shapes the headline run dispatched (acceptance: <= 5,
@@ -557,6 +700,7 @@ def main():
         },
         "roofline": roofline,
         "long_history": long_stats,
+        "xlong_history": xlong_stats,
         "device_rate": round(n_checked / t_dev, 2),
         "device_time_s": round(t_dev, 3),
         "encode_time_s": round(t_encode, 3),

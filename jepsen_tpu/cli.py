@@ -439,12 +439,24 @@ def recheck_cmd() -> dict:
                        help="Stored test name (store/<name>/...)")
         p.add_argument("--model", default="cas-absent",
                        choices=list(FAMILY_NAMES))
-        p.add_argument("--independent", action="store_true",
-                       help="Strain per-key subhistories first")
-        p.add_argument("--accounts", type=int, default=5,
-                       help="bank: expected account count")
-        p.add_argument("--balance", type=int, default=10,
-                       help="bank: expected per-account start balance")
+        # Invariant constants default from the stored run's test.json
+        # (its serialized "invariants" entry) — flags only OVERRIDE
+        # what the run recorded, and a contradiction logs a warning
+        # (jepsen_tpu.recheck._resolve_constant).
+        p.add_argument("--independent",
+                       action=argparse.BooleanOptionalAction,
+                       default=None,
+                       help="Strain per-key subhistories first "
+                            "(default: what the stored run recorded; "
+                            "--no-independent forces whole-history "
+                            "units)")
+        p.add_argument("--accounts", type=int, default=None,
+                       help="bank: expected account count (default: "
+                            "the stored run's invariants, else 5)")
+        p.add_argument("--balance", type=int, default=None,
+                       help="bank: expected per-account start balance "
+                            "(default: the stored run's invariants, "
+                            "else 10)")
 
     def run(opts):
         import json as _json

@@ -60,7 +60,7 @@ import numpy as np
 
 from ..history.ops import Op
 from ..models.core import Model
-from .encode import (EV_CLOSE, EV_OK, EncodedBatch,
+from .encode import (EV_CLOSE, EV_FUSED, EV_OK, EncodedBatch,
                      batch_encode, bucket_encode, encode_history,
                      slot_ops_at_event)
 
@@ -155,7 +155,8 @@ def _changed(Fa, Fb) -> jnp.ndarray:
     return acc
 
 
-def make_kernel(V: int, W: int):
+def make_kernel(V: int, W: int, *, w_live: Optional[int] = None,
+                instrument: bool = False, resume: bool = False):
     """Build the single-history checker for static bounds (V, W).
 
     Returns ``check(ev_type, ev_slot, ev_slots, target) ->
@@ -165,60 +166,99 @@ def make_kernel(V: int, W: int):
     when invalid, the final config set when valid (counterexample /
     result decoding: ``decode_frontier``). vmap/shard over a leading
     batch axis.
+
+    ``w_live`` (<= W) bounds the closure/completion slot unroll to the
+    rows' real peak-live window: a batch widened to a consolidated W
+    class (ops.schedule) carries provably-empty upper slots whose
+    applications are no-ops — skipping them statically cuts the VPU
+    work per closure iteration by w_live/W while the mask axis keeps
+    the class shape. ``instrument=True`` appends a fourth output: total
+    closure while_loop iterations per row, the measured input to the
+    VPU op-count roofline (vpu_op_model). ``resume=True`` builds the
+    event-chunked variant instead: ``check(ev_type, ev_slot, ev_slots,
+    target, F, Fbad, valid, bad) -> (valid, bad, F, Fbad)`` with the
+    packed carry ([words, 2^W] uint32 per row) flowing between
+    dispatches — see run_event_chunked.
     """
     assert V <= MAX_PACKED_STATES, "packed kernel bound; use host fallback"
     M = 1 << W
     NW = n_state_words(V)
+    WL = W if w_live is None else max(1, min(int(w_live), W))
 
     def closure(F, slots_row, rows):
-        tgt = tuple(r[slots_row] for r in rows)  # [W, V] per word; empty
-                                                 # slots gather zero rows.
+        # [WL, V] per word; empty slots gather zero rows. Slots >= WL
+        # are empty in EVERY snapshot of the batch (encoder invariant:
+        # lowest-free-first allocation keeps indices < peak-live), so
+        # the static slice drops only no-op applications.
+        tgt = tuple(r[slots_row[:WL]] for r in rows)
 
         def body(carry):
-            F0, _ = carry
+            F0, _, n = carry
             Fn = F0
-            for i in range(W):
+            for i in range(WL):
                 Fn = _apply_slot(Fn, i, tuple(t[i] for t in tgt), V, M)
-            return Fn, _changed(Fn, F0)
+            return Fn, _changed(Fn, F0), n + 1
 
-        F, _ = lax.while_loop(lambda c: c[1], body, (F, jnp.bool_(True)))
-        return F
+        F, _, n = lax.while_loop(lambda c: c[1], body,
+                                 (F, jnp.bool_(True), jnp.int32(0)))
+        return F, n
 
-    def check(ev_type, ev_slot, ev_slots, target):
-        # Event arrays arrive narrow (int8 — transfer bytes are a real
-        # cost off-chip); widen for gathers/switch on device.
-        ev_type = ev_type.astype(jnp.int32)
-        ev_slot = ev_slot.astype(jnp.int32)
-        ev_slots = ev_slots.astype(jnp.int32)
-        rows = pack_rows(target, V)
-
+    def step_fn(rows):
         def step(carry, ev):
-            F, Fbad, valid, bad = carry
+            F, Fbad, valid, bad, iters = carry
             typ, slot, slots_row, idx = ev
-            is_ok = typ == EV_OK
+            is_ok = (typ == EV_OK) | (typ == EV_FUSED)
             is_close = typ == EV_CLOSE  # final flush: keep the closure
-            Fc = closure(F, slots_row, rows)
-            F_ok = _complete_slot(Fc, slot, M, W)
+            Fc, n = closure(F, slots_row, rows)
+            F_ok = _complete_slot(Fc, slot, M, WL)
             empty = is_ok & ~(_union(F_ok) != 0).any()
             first = empty & valid
             F2 = tuple(jnp.where(is_ok, a, jnp.where(is_close, c, b))
                        for a, c, b in zip(F_ok, Fc, F))
             Fb2 = tuple(jnp.where(first, c, b) for c, b in zip(Fc, Fbad))
             return (F2, Fb2, valid & ~empty,
-                    jnp.minimum(bad, jnp.where(empty, idx, INT32_MAX))), None
+                    jnp.minimum(bad, jnp.where(empty, idx, INT32_MAX)),
+                    iters + n), None
+        return step
 
+    def widen(ev_type, ev_slot, ev_slots):
+        # Event arrays arrive narrow (int8 — transfer bytes are a real
+        # cost off-chip); widen for gathers/switch on device.
+        return (ev_type.astype(jnp.int32), ev_slot.astype(jnp.int32),
+                ev_slots.astype(jnp.int32))
+
+    def check(ev_type, ev_slot, ev_slots, target):
+        ev_type, ev_slot, ev_slots = widen(ev_type, ev_slot, ev_slots)
+        rows = pack_rows(target, V)
         N = ev_type.shape[0]
         Fz = tuple(jnp.zeros((M,), jnp.uint32) for _ in range(NW))
         F0 = (Fz[0].at[0].set(jnp.uint32(1)),) + Fz[1:]
-        carry = (F0, Fz, jnp.bool_(True), jnp.int32(INT32_MAX))
-        (F, Fbad, valid, bad), _ = lax.scan(
-            step, carry, (ev_type, ev_slot, ev_slots,
-                          jnp.arange(N, dtype=jnp.int32)))
+        carry = (F0, Fz, jnp.bool_(True), jnp.int32(INT32_MAX),
+                 jnp.int32(0))
+        (F, Fbad, valid, bad, iters), _ = lax.scan(
+            step_fn(rows), carry, (ev_type, ev_slot, ev_slots,
+                                   jnp.arange(N, dtype=jnp.int32)))
         frontier = jnp.stack(
             [jnp.where(valid, a, b) for a, b in zip(F, Fbad)])
+        if instrument:
+            return valid, bad, frontier, iters
         return valid, bad, frontier
 
-    return check
+    def check_resume(ev_type, ev_slot, ev_slots, target, idx0, F_in,
+                     Fb_in, valid_in, bad_in):
+        ev_type, ev_slot, ev_slots = widen(ev_type, ev_slot, ev_slots)
+        rows = pack_rows(target, V)
+        N = ev_type.shape[0]
+        carry = (tuple(F_in[i] for i in range(NW)),
+                 tuple(Fb_in[i] for i in range(NW)),
+                 valid_in, bad_in, jnp.int32(0))
+        (F, Fbad, valid, bad, _), _ = lax.scan(
+            step_fn(rows), carry,
+            (ev_type, ev_slot, ev_slots,
+             idx0 + jnp.arange(N, dtype=jnp.int32)))
+        return valid, bad, jnp.stack(F), jnp.stack(Fbad)
+
+    return check_resume if resume else check
 
 
 # ------------------------------------------------------ kernel registry
@@ -253,7 +293,9 @@ def _silence_donation_warning() -> None:
 
 
 def get_kernel(V: int, W: int, *, kind: str = "data1", mesh=None,
-               shared_target: bool = False, donate: bool = False):
+               shared_target: bool = False, donate: bool = False,
+               w_live: Optional[int] = None, instrument: bool = False,
+               resume: bool = False):
     """Resolve (build + cache) a compiled checker kernel.
 
     kind "data1" is the single-device vmapped kernel; "data" shards the
@@ -263,28 +305,47 @@ def get_kernel(V: int, W: int, *, kind: str = "data1", mesh=None,
     The frontier variant does not support donation (its shard_map
     carries the event arrays through a collective scan), so ``donate``
     is normalized off there rather than cached under a key that lies.
+
+    ``w_live`` bounds the slot unroll to the batch's real peak-live
+    window (make_kernel); normalized to W when it wouldn't shrink the
+    unroll so equivalent requests share one compile. ``instrument`` and
+    ``resume`` are single-device (data1) variants only.
     """
     if kind == "frontier":
         donate = False
+    if w_live is None or w_live >= W or kind == "frontier":
+        w_live = W
     key = (kind, V, W, id(mesh) if mesh is not None else None,
-           shared_target, donate)
+           shared_target, donate, w_live, instrument, resume)
     k = _KERNEL_REGISTRY.get(key)
     if k is None:
         donate_argnums = (0, 1, 2) if donate else ()
         if donate:
             _silence_donation_warning()
         if kind == "data1":
-            k = jax.jit(jax.vmap(make_kernel(V, W),
-                                 in_axes=(0, 0, 0,
-                                          None if shared_target else 0)),
-                        donate_argnums=donate_argnums)
+            assert not (instrument and resume)
+            kern = make_kernel(V, W, w_live=w_live,
+                               instrument=instrument, resume=resume)
+            if resume:
+                # idx0 is a shared scalar; carry arrays batch like the
+                # event tables.
+                k = jax.jit(jax.vmap(
+                    kern, in_axes=(0, 0, 0,
+                                   None if shared_target else 0,
+                                   None, 0, 0, 0, 0)))
+            else:
+                k = jax.jit(jax.vmap(kern,
+                                     in_axes=(0, 0, 0,
+                                              None if shared_target
+                                              else 0)),
+                            donate_argnums=donate_argnums)
         elif kind == "frontier":
             from ..parallel.frontier import frontier_sharded_kernel
             k = frontier_sharded_kernel(V, W, mesh, shared_target)
         elif kind == "data":
             from ..parallel.mesh import data_sharded_kernel
             k = data_sharded_kernel(V, W, mesh, shared_target,
-                                    donate=donate)
+                                    donate=donate, w_live=w_live)
         else:
             raise ValueError(f"unknown kernel kind {kind!r}")
         _KERNEL_REGISTRY[key] = k
@@ -292,16 +353,19 @@ def get_kernel(V: int, W: int, *, kind: str = "data1", mesh=None,
 
 
 def log_kernel_shapes(V: int, W: int, kind: str, shared_target: bool,
-                      donate: bool, B: int, N: int) -> None:
+                      donate: bool, B: int, N: int,
+                      w_live: Optional[int] = None) -> None:
     """Record a dispatch shape (one registry entry per XLA compile)."""
-    KERNEL_SHAPE_LOG.add((kind, V, W, shared_target, donate, B, N))
+    KERNEL_SHAPE_LOG.add((kind, V, W, shared_target, donate, B, N,
+                          w_live if w_live and w_live < W else W))
 
 
-def batch_kernel(V: int, W: int, shared_target: bool = False):
+def batch_kernel(V: int, W: int, shared_target: bool = False,
+                 w_live: Optional[int] = None):
     """``shared_target``: every row uses one transition table — the
     table is passed unbatched ([K+1, V]) and broadcast on device,
     saving the per-row transfer."""
-    return get_kernel(V, W, shared_target=shared_target)
+    return get_kernel(V, W, shared_target=shared_target, w_live=w_live)
 
 
 # Frontier-words budget per device dispatch: B * words(V) * 2^W uint32.
@@ -371,9 +435,11 @@ def production_mesh(n_frontier: int = 1):
 
 
 def _sharded_kernel(kind: str, V: int, W: int, mesh,
-                    shared_target: bool = False):
+                    shared_target: bool = False,
+                    w_live: Optional[int] = None):
     return get_kernel(V, W, kind="frontier" if kind == "frontier"
-                      else "data", mesh=mesh, shared_target=shared_target)
+                      else "data", mesh=mesh, shared_target=shared_target,
+                      w_live=w_live)
 
 
 def _pad_rows(batch: EncodedBatch, bp: int) -> Tuple[np.ndarray, ...]:
@@ -481,7 +547,8 @@ def _data1_dispatch(batch: EncodedBatch, return_frontier: bool,
     """Single-device vmapped dispatch, batch-chunked so the in-flight
     frontier words stay inside MAX_FRONTIER_ELEMENTS (wide windows get
     proportionally smaller chunks)."""
-    kern = batch_kernel(batch.V, batch.W, batch.shared_target)
+    kern = batch_kernel(batch.V, batch.W, batch.shared_target,
+                        w_live=batch.eff_w_live)
     per_hist = n_state_words(batch.V) << batch.W
     chunk = max(1, MAX_FRONTIER_ELEMENTS // per_hist)
     DISPATCH_LOG.append((label, batch.V, batch.W, batch.batch))
@@ -489,7 +556,8 @@ def _data1_dispatch(batch: EncodedBatch, return_frontier: bool,
     for lo in range(0, batch.batch, chunk):
         hi = min(lo + chunk, batch.batch)
         log_kernel_shapes(batch.V, batch.W, "data1", batch.shared_target,
-                          False, hi - lo, batch.n_events)
+                          False, hi - lo, batch.n_events,
+                          batch.eff_w_live)
         valid, bad, front = kern(
             batch.ev_type[lo:hi], batch.ev_slot[lo:hi],
             batch.ev_slots[lo:hi],
@@ -499,6 +567,105 @@ def _data1_dispatch(batch: EncodedBatch, return_frontier: bool,
                         front if return_frontier else None,
                         hi - lo))
     return pending
+
+
+def run_event_chunked(batch: EncodedBatch, events_per_chunk: int,
+                      return_frontier: bool = False):
+    """Single-device dispatch with the EVENT axis chunked: the packed
+    frontier carry ([words, 2^W] per row) flows between dispatches, so
+    a 100k-op history never materializes one 100k-step scan. Chunks are
+    double-buffered for free — jax dispatch is async, so chunk k+1's
+    (narrow int8) event upload overlaps chunk k's device scan; rows
+    whose frontier already emptied are closed early in the only sense
+    that matters on a converged scan: every further step is an
+    idempotent no-op on an all-zero carry. Same (valid, bad, frontier)
+    contract as run_encoded_batch; parity-tested against the one-shot
+    scan (tests/test_fusion.py)."""
+    assert batch.W <= DATA_MAX_SLOTS + SINGLE_DEVICE_EXTRA_SLOTS
+    B, N = batch.batch, batch.n_events
+    NW, M = n_state_words(batch.V), 1 << batch.W
+    if B == 0:
+        return (np.zeros((0,), bool), np.zeros((0,), np.int32),
+                np.zeros((0, NW, M), np.uint32) if return_frontier
+                else None)
+    kern = get_kernel(batch.V, batch.W, shared_target=batch.shared_target,
+                      w_live=batch.eff_w_live, resume=True)
+    C = max(8, int(events_per_chunk))
+    F = np.zeros((B, NW, M), np.uint32)
+    F[:, 0, 0] = 1                      # (initial state, empty mask)
+    Fb = np.zeros((B, NW, M), np.uint32)
+    valid = np.ones(B, bool)
+    bad = np.full(B, INT32_MAX, np.int32)
+    tgt = (np.ascontiguousarray(batch.target[0]) if batch.shared_target
+           else batch.target)
+    out = (valid, bad, F, Fb)
+    for lo in range(0, N, C):
+        hi = min(lo + C, N)
+        if hi - lo == C:
+            # Full chunks pass slices straight through; only the final
+            # ragged chunk pads (EV_PAD steps are no-ops), keeping one
+            # compiled shape without copying every chunk.
+            ev_t = batch.ev_type[:, lo:hi]
+            ev_s = batch.ev_slot[:, lo:hi]
+            ev_ss = batch.ev_slots[:, lo:hi]
+        else:
+            ev_t = np.zeros((B, C), batch.ev_type.dtype)
+            ev_s = np.zeros((B, C), batch.ev_slot.dtype)
+            ev_ss = np.full((B, C, batch.ev_slots.shape[2]),
+                            batch.target.shape[1] - 1,
+                            batch.ev_slots.dtype)
+            ev_t[:, :hi - lo] = batch.ev_type[:, lo:hi]
+            ev_s[:, :hi - lo] = batch.ev_slot[:, lo:hi]
+            ev_ss[:, :hi - lo] = batch.ev_slots[:, lo:hi]
+        log_kernel_shapes(batch.V, batch.W, "data1ev",
+                          batch.shared_target, False, B, C,
+                          batch.eff_w_live)
+        out = kern(ev_t, ev_s, ev_ss, tgt, np.int32(lo), out[2], out[3],
+                   out[0], out[1])
+    valid = np.asarray(out[0])
+    bad = np.asarray(out[1])
+    frontier = None
+    if return_frontier:
+        F, Fb = np.asarray(out[2]), np.asarray(out[3])
+        frontier = np.where(valid[:, None, None], F, Fb)
+    return valid, bad, frontier
+
+
+def fused_bad_rows(batch: EncodedBatch, valid, bad) -> np.ndarray:
+    """Row positions (within ``batch``) whose first impossible
+    completion landed on an EV_FUSED step. The device only knows such
+    a run's FIRST member, so every consumer — check_batch_tpu,
+    check_columnar, bench parity — re-derives these rows' exact bad
+    op/counterexample through a host-side engine; this is the one
+    shared detector so the invariant can't drift between them."""
+    v = np.asarray(valid)
+    b = np.asarray(bad)
+    inv = np.nonzero(~v)[0]
+    return inv[batch.ev_type[inv, b[inv]] == EV_FUSED]
+
+
+def vpu_op_model(V: int, W: int, w_live: Optional[int] = None) -> dict:
+    """Analytic uint32 VPU lane-op counts for the packed kernel — the
+    op-count basis behind the bench's measured ``vpu_util`` roofline.
+
+    Per closure ITERATION (one while_loop body pass): each of the
+    ``w_live`` slot applications walks V states, paying 2 lane-ops to
+    extract the state bit and, per packed word, a multiply + OR over
+    the M/2 spawned-mask lanes, plus the OR-merge back into the mask
+    halves; the convergence check compares + reduces every frontier
+    word. Per EVENT on top: the completion shift-half, the emptiness
+    union/any, and the three latch selects, all over full [NW, M]
+    words. Host-side constants only — the measured input (iterations
+    per row) comes from the instrumented kernel (make_kernel
+    ``instrument=True``)."""
+    NW = n_state_words(V)
+    M = 1 << W
+    WL = W if w_live is None else max(1, min(int(w_live), W))
+    per_apply = (M // 2) * (V * (2 + 2 * NW) + NW)
+    per_iteration = WL * per_apply + 2 * NW * M
+    per_event = 5 * NW * M
+    return {"per_iteration": per_iteration, "per_event": per_event,
+            "words": NW, "masks": M, "w_live": WL}
 
 
 class WindowOverflow(Exception):
@@ -606,7 +773,8 @@ def _dispatch_sharded(kind: str, batch: EncodedBatch, mesh,
     the data-axis multiple and chunking to bound per-device memory."""
     n_data = mesh.shape["data"]
     kern = _sharded_kernel("frontier" if kind == "frontier" else "data",
-                           batch.V, batch.W, mesh, batch.shared_target)
+                           batch.V, batch.W, mesh, batch.shared_target,
+                           w_live=batch.eff_w_live)
     # Per-device budget: (chunk / n_data) rows x (per_hist / n_frontier)
     # words <= MAX_FRONTIER_ELEMENTS  =>  chunk <= MAX * size / per_hist.
     per_hist = n_state_words(batch.V) << batch.W
@@ -626,7 +794,9 @@ def _dispatch_sharded(kind: str, batch: EncodedBatch, mesh,
             indices=[], failures=[], shared_target=batch.shared_target)
         ev_type, ev_slot, ev_slots, target = _pad_rows(sub, bp)
         log_kernel_shapes(batch.V, batch.W, kind, batch.shared_target,
-                          False, bp, batch.n_events)
+                          False, bp, batch.n_events,
+                          batch.eff_w_live if kind != "frontier"
+                          else batch.W)
         valid, bad, front = kern(
             ev_type, ev_slot, ev_slots,
             batch.target[0] if batch.shared_target else target)
@@ -660,7 +830,7 @@ def decode_frontier(frontier: np.ndarray, space, slot_to_op: Dict[int, int],
     return configs[:n]
 
 
-def _decode_result(space, ops: List[Op], valid: bool, ev: int,
+def _decode_result(space, ops: List[Op], valid: bool,
                    op_index: int, frontier_row,
                    predropped: bool = False) -> dict:
     """Host-shaped result dict from a kernel verdict: {"valid"} plus, on
@@ -680,7 +850,11 @@ def _decode_result(space, ops: List[Op], valid: bool, ev: int,
     out = {"valid": False,
            "op": op.to_dict() if op is not None else {"index": op_index}}
     if space is not None:
-        table = slot_ops_at_event(space, ops, ev, predropped=predropped)
+        # Locate the pending table by the bad op's history index, not
+        # the device event ordinal — fusion compacts the event axis, so
+        # ordinals no longer line up with the unfused walk.
+        table = slot_ops_at_event(space, ops, None, predropped=predropped,
+                                  op_index=op_index)
         out["configs"] = decode_frontier(frontier_row, space, table)
     return out
 
@@ -691,7 +865,7 @@ def _result_for(row: int, batch: EncodedBatch, valid: np.ndarray,
     space = batch.spaces[row] if batch.spaces else None
     ev = int(bad[row])
     op_index = int(batch.ev_opidx[row, ev]) if not bool(valid[row]) else -1
-    return _decode_result(space, prepared, bool(valid[row]), ev, op_index,
+    return _decode_result(space, prepared, bool(valid[row]), op_index,
                           frontier[row])
 
 
@@ -732,9 +906,11 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
     # mesh can shard their mask axis (the frontier path).
     eff_slots = max_slots + (device_frontier_capacity()
                              if max_slots >= DATA_MAX_SLOTS else 0)
+    # The streamed path encodes fused (single-candidate runs collapse
+    # into EV_FUSED steps); the exact path stays the unfused oracle.
     buckets = bucket_encode(model, prepared,
                             max_states=min(max_states, MAX_PACKED_STATES),
-                            max_slots=eff_slots)
+                            max_slots=eff_slots, fuse=scheduler)
 
     results: List[Optional[dict]] = [None] * len(histories)
     device_batches = []
@@ -772,7 +948,17 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
                 results[i] = r
             continue
         valid, bad, front = out
+        valid, bad = np.asarray(valid), np.asarray(bad)
+        fused = set(fused_bad_rows(batch, valid, bad).tolist())
         for row, i in enumerate(batch.indices):
+            if row in fused:
+                # The first impossible completion fell inside a fused
+                # run: the device only knows the run's first member.
+                # Re-derive the exact bad op + counterexample on the
+                # host — rare (invalid rows failing in a sequential
+                # stretch), and the host engine is the parity shape.
+                results[i] = host_fallback(model, histories[i])
+                continue
             results[i] = _result_for(row, batch, valid, bad, front,
                                      model, prepared[i])
     return results
@@ -874,6 +1060,7 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
     bad = np.full(cols.batch, INT32_MAX, np.int32)
     results: List[Optional[dict]] = [None] * cols.batch if details else None
     failures: List[Tuple[int, str]] = []
+    fused_refine: List[int] = []
     host_fallback = host_fallback or wgl_check
     # Wide-tail shortcut: measured per-row device cost doubles per W
     # while the native engine's grows far more slowly — on one chip the
@@ -897,7 +1084,8 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
         from .schedule import (DIVERTED, BucketScheduler,
                                iter_columnar_groups)
         groups = iter_columnar_groups(space, cols, max_slots=eff_slots,
-                                      failures=failures)
+                                      failures=failures, fuse=True,
+                                      renumber=True)
         sch = BucketScheduler(
             return_frontier=details,
             min_device_rows=min_device_batch if tail is not None else 0)
@@ -924,24 +1112,35 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
         v, b, front = out
         idx = np.asarray(batch.indices)
         valid[idx] = v
+        inv = np.nonzero(~v)[0]
         bad_rows = idx[~v]
-        bad_lines = batch.ev_opidx[np.nonzero(~v)[0], b[~v]]
+        bad_lines = batch.ev_opidx[inv, b[~v]]
         bad[bad_rows] = (cols.index[bad_rows, bad_lines]
                          if cols.index is not None else bad_lines)
+        # Rows whose first impossible completion fell inside a fused
+        # run only know the run's FIRST member: re-derive exactly on
+        # the host after the stream drains (fused_refine).
+        fb = fused_bad_rows(batch, v, b)
+        fused_refine.extend(int(idx[x]) for x in fb)
+        fused_local = set(fb.tolist())
         if details:
             for bi, row in enumerate(batch.indices):
                 if details == "invalid" and bool(v[bi]):
                     results[row] = {"valid": True}
                     continue
+                if bi in fused_local:
+                    continue               # refined below
                 # The columnar form already applied the prepared-history
                 # contract (value propagation + identity drop) at
                 # conversion: reconstruct with propagated invokes and
                 # skip both complete() and the per-op drop recompute —
                 # the decode walk still sees exactly the encoder's op
-                # kinds and slot assignment.
+                # kinds and slot assignment. Renumbered rows decode
+                # against their own sub-space (batch.spaces).
                 ops = columnar_to_ops(cols, row, propagated=True)
+                sp = batch.spaces[bi] if batch.spaces else space
                 results[row] = _decode_result(
-                    space, ops, bool(v[bi]), int(b[bi]),
+                    sp, ops, bool(v[bi]),
                     int(bad[row]) if not bool(v[bi]) else -1, front[bi],
                     predropped=True)
     if tail is not None:
@@ -958,6 +1157,27 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
                 results[i] = ({"valid": True} if r["valid"] is True
                               else host_fallback(
                                   model, columnar_to_ops(cols, i)))
+    if fused_refine:
+        # Exact bad-index/counterexample recovery for rows that failed
+        # inside a fused run. Verdict-only callers ride the native
+        # batch engine when it exists; details callers take the host
+        # engine's full result (the parity shape).
+        hs = [columnar_to_ops(cols, i) for i in fused_refine]
+        rs = None
+        if not details:
+            try:
+                from ..native import check_batch_native
+                rs = check_batch_native(model, hs)
+            except Exception:
+                rs = None
+        if rs is None:
+            rs = [host_fallback(model, h) for h in hs]
+        for i, r in zip(fused_refine, rs):
+            valid[i] = r["valid"] is True
+            if r["valid"] is False:
+                bad[i] = r["op"].get("index", -1)
+            if details:
+                results[i] = r
     for row, reason in failures:
         r = host_fallback(model, columnar_to_ops(cols, row))
         valid[row] = r["valid"] is True

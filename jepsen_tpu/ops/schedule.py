@@ -218,18 +218,21 @@ _AOT_INFLIGHT: Dict[Tuple, threading.Event] = {}
 _AOT_LOCK = threading.Lock()
 
 
-def _aot_key(V, W, shared, donate, Bp, Np, slot_dtype, K1):
-    return (V, W, shared, donate, Bp, Np, np.dtype(slot_dtype).str, K1)
+def _aot_key(V, W, w_live, shared, donate, Bp, Np, slot_dtype, K1):
+    return (V, W, w_live, shared, donate, Bp, Np,
+            np.dtype(slot_dtype).str, K1)
 
 
-def _compile_spec(V, W, shared, donate, Bp, Np, slot_dtype, K1) -> None:
+def _compile_spec(V, W, w_live, shared, donate, Bp, Np, slot_dtype,
+                  K1) -> None:
     """AOT-lower + compile one kernel shape and park the executable for
     dispatch to pick up. Runs on a daemon thread; any failure just
     leaves dispatch on the plain jit path."""
-    key = _aot_key(V, W, shared, donate, Bp, Np, slot_dtype, K1)
+    key = _aot_key(V, W, w_live, shared, donate, Bp, Np, slot_dtype, K1)
     try:
         import jax
-        kern = get_kernel(V, W, shared_target=shared, donate=donate)
+        kern = get_kernel(V, W, shared_target=shared, donate=donate,
+                          w_live=w_live)
         ev = jax.ShapeDtypeStruct((Bp, Np), np.int8)
         slots = jax.ShapeDtypeStruct((Bp, Np, W), np.dtype(slot_dtype))
         tgt = jax.ShapeDtypeStruct((K1, V) if shared else (Bp, K1, V),
@@ -247,7 +250,7 @@ def _compile_spec(V, W, shared, donate, Bp, Np, slot_dtype, K1) -> None:
 
 def prewarm_kernels(specs: Iterable[Tuple]) -> List[threading.Thread]:
     """Compile kernel shapes on background daemon threads (one each).
-    ``specs``: (V, W, shared, donate, Bp, Np, slot_dtype, K1) tuples —
+    ``specs``: (V, W, w_live, shared, donate, Bp, Np, slot_dtype, K1) —
     what BucketScheduler derives from the consolidated class set.
     Dispatch coordinates through _AOT_INFLIGHT: a chunk that reaches
     the device first WAITS for the in-flight compile instead of
@@ -364,6 +367,7 @@ class BucketScheduler:
             "t_first_verdict_s": None, "wall_s": None,
             "encode_busy_s": 0.0, "dispatch_busy_s": 0.0,
             "device_wait_s": 0.0, "overlap_ratio": None,
+            "events": 0, "orig_events": 0, "fusion_ratio": None,
         }
         self._t0 = None
         self._first_dispatch_t = None
@@ -403,7 +407,8 @@ class BucketScheduler:
         return ev_type, ev_slot, ev_slots, target
 
     def _resolve(self, batch: EncodedBatch, Bp: int, Np: int):
-        key = _aot_key(batch.V, batch.W, batch.shared_target, self.donate,
+        key = _aot_key(batch.V, batch.W, batch.eff_w_live,
+                       batch.shared_target, self.donate,
                        Bp, Np, batch.ev_slots.dtype,
                        batch.target.shape[1])
         with _AOT_LOCK:
@@ -422,7 +427,8 @@ class BucketScheduler:
                 compiled = _AOT.get(key)
         return compiled or get_kernel(batch.V, batch.W,
                                       shared_target=batch.shared_target,
-                                      donate=self.donate)
+                                      donate=self.donate,
+                                      w_live=batch.eff_w_live)
 
     def _dispatch(self, run: _Run, lo: int, hi: int, Bp: int):
         batch = run.batch
@@ -432,7 +438,7 @@ class BucketScheduler:
             batch, lo, hi, Bp, Np)
         kern = self._resolve(batch, Bp, Np)
         log_kernel_shapes(batch.V, batch.W, "data1", batch.shared_target,
-                          self.donate, Bp, Np)
+                          self.donate, Bp, Np, batch.eff_w_live)
         DISPATCH_LOG.append(("data1", batch.V, batch.W, hi - lo))
         out = kern(ev_type, ev_slot, ev_slots,
                    np.ascontiguousarray(batch.target[0])
@@ -545,6 +551,11 @@ class BucketScheduler:
                     and 0 < mb.batch < self.min_device_rows):
                 yield mb, DIVERTED
                 return
+            ev = int((mb.ev_type != 0).sum())        # != EV_PAD
+            self.stats["events"] += ev
+            self.stats["orig_events"] += (
+                int(mb.orig_n_events.sum())
+                if mb.orig_n_events is not None else ev)
             if wide or (mesh is not None and mb.batch >=
                         mesh.shape["data"] * MIN_ROWS_PER_DEVICE):
                 # Wide/frontier/sharded routes keep their own dispatch
@@ -566,7 +577,8 @@ class BucketScheduler:
                 return
             Bp, chunks = self._chunk_plan(mb)
             if self.prewarm and mb.W <= DATA_MAX_SLOTS:
-                spec = (mb.V, mb.W, mb.shared_target, self.donate, Bp,
+                spec = (mb.V, mb.W, mb.eff_w_live, mb.shared_target,
+                        self.donate, Bp,
                         _round_up(mb.n_events, EVENT_QUANTUM),
                         mb.ev_slots.dtype, mb.target.shape[1])
                 skey = _aot_key(*spec)
@@ -619,6 +631,11 @@ class BucketScheduler:
         wall = time.monotonic() - self._t0
         self.stats["wall_s"] = round(wall, 4)
         self.stats["compiled_shapes"] = len(KERNEL_SHAPE_LOG) - shapes0
+        if self.stats["events"]:
+            # Scan steps saved by event fusion: original (unfused)
+            # events per dispatched scan step, >= 1.0.
+            self.stats["fusion_ratio"] = round(
+                self.stats["orig_events"] / self.stats["events"], 4)
         if class_map:
             seen = {}
             for (v, w), c in class_map.items():
@@ -647,7 +664,9 @@ def _slice_rows(b: EncodedBatch, lo: int, hi: int) -> EncodedBatch:
         V=b.V, W=b.W, indices=list(b.indices[lo:hi]),
         failures=list(b.failures) if lo == 0 else [],
         spaces=(b.spaces[lo:hi] if b.spaces else b.spaces),
-        shared_target=b.shared_target)
+        shared_target=b.shared_target, w_live=b.w_live,
+        orig_n_events=(b.orig_n_events[lo:hi]
+                       if b.orig_n_events is not None else None))
 
 
 def run_buckets_streamed(batches, return_frontier=False, **kw):
@@ -661,23 +680,33 @@ def run_buckets_streamed(batches, return_frontier=False, **kw):
 
 def iter_columnar_groups(space, cols, *, max_slots: int = 16,
                          encode_rows: Optional[int] = None,
-                         failures: Optional[list] = None):
+                         failures: Optional[list] = None,
+                         fuse: bool = False, renumber: bool = False):
     """Chunked columnar encode: yield bucket groups of ``encode_rows``
     rows each, with indices/failures remapped to the full batch — the
     streaming source for BucketScheduler.run, so the native/numpy slot
     walk of group k+1 runs while the device still chews group k.
-    Overflow failures append to ``failures`` as (row, reason)."""
+    Overflow failures append to ``failures`` as (row, reason).
+    ``fuse``/``renumber`` enable the encode-side shrink passes
+    (ops.encode: event fusion + live-alphabet state renumbering) — the
+    streamed production setting; the exact oracle leaves them off."""
     from .encode import encode_columnar
     rows = cols.batch
     encode_rows = encode_rows or int(
         os.environ.get("JT_SCHED_ENCODE_ROWS", "4096"))
+    # One composed-kind registry across all groups: stable fused ids
+    # with append-only table content, so the scheduler can merge
+    # buckets from different groups under ONE shared target table.
+    fuse_registry = {} if fuse else None
     for lo in range(0, rows, encode_rows):
         hi = min(lo + encode_rows, rows)
         sub = type(cols)(
             type=cols.type[lo:hi], process=cols.process[lo:hi],
             kind=cols.kind[lo:hi], kinds=cols.kinds,
             index=cols.index[lo:hi] if cols.index is not None else None)
-        buckets, fails = encode_columnar(space, sub, max_slots=max_slots)
+        buckets, fails = encode_columnar(space, sub, max_slots=max_slots,
+                                         fuse=fuse, renumber=renumber,
+                                         fuse_registry=fuse_registry)
         for b in buckets:
             b.indices = [i + lo for i in b.indices]
             b.failures = []

@@ -166,3 +166,31 @@ def history_kinds(prepared: List[Op]) -> List[Tuple]:
         if op.type == INVOKE:
             seen.setdefault(op_kind(op), None)
     return list(seen.keys())
+
+
+def restrict_statespace(space: StateSpace, kind_idx) -> Tuple[StateSpace,
+                                                              np.ndarray]:
+    """Re-enumerate ``space`` under a subset of its kind vocabulary —
+    the *state renumbering* behind the per-history live-alphabet
+    shrink: a history that only ever applies ``kind_idx`` kinds can
+    never leave the sub-reachable space, so its frontier fits in
+    ``sub.n_states`` packed states instead of the batch vocabulary's
+    full reachable set (fewer packed words = less VPU work per
+    transition and a smaller VMEM working set).
+
+    Returns ``(sub, lut)`` where ``lut`` maps full-space kind indices
+    to sub-space indices (-1 for kinds outside the subset). The
+    verdict is unchanged by construction: every state the restricted
+    history can reach is reachable under the subset BFS (same initial
+    state, same transition semantics), and target rows restricted to
+    substates stay within substates. Memoized through
+    ``enumerate_statespace`` (the initial model is ``space.states[0]``).
+    """
+    kind_idx = sorted(int(k) for k in kind_idx)
+    sub_kinds = [space.kinds[i] for i in kind_idx]
+    sub = enumerate_statespace(space.states[0], sub_kinds,
+                               len(space.states) + 1)
+    lut = np.full(space.n_kinds + 1, -1, np.int32)
+    for j, i in enumerate(kind_idx):
+        lut[i] = j
+    return sub, lut

@@ -35,7 +35,7 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
-from ..ops.encode import EV_CLOSE, EV_OK
+from ..ops.encode import EV_CLOSE, EV_FUSED, EV_OK
 from ..ops.linearize import (INT32_MAX, MAX_PACKED_STATES, _apply_slot,
                              _complete_slot, _changed, _union,
                              n_state_words, pack_rows, transition)
@@ -133,7 +133,7 @@ def make_frontier_kernel(V: int, W: int, D: int,
         def step(carry, ev):
             F, Fbad, valid, bad = carry
             typ, slot, slots_row, idx = ev
-            is_ok = typ == EV_OK
+            is_ok = (typ == EV_OK) | (typ == EV_FUSED)
             is_close = typ == EV_CLOSE
             Fc = closure(F, slots_row, rows)
             F_ok = complete(Fc, slot)

@@ -70,7 +70,8 @@ def _batch_axes(mesh: Mesh) -> Tuple[str, ...]:
 
 def data_sharded_kernel(V: int, W: int, mesh: Mesh,
                         shared_target: bool = False,
-                        donate: bool = False):
+                        donate: bool = False,
+                        w_live: Optional[int] = None):
     """Compile the batched checker with the batch axis sharded over the
     mesh's batch axes (("data"), or ("dcn", "data") on a multi-host
     mesh). Returns check(ev_type [B,N], ev_slot [B,N],
@@ -79,7 +80,8 @@ def data_sharded_kernel(V: int, W: int, mesh: Mesh,
     ``shared_target``: target is one replicated [K+1, V] table instead
     of a per-row batch (one transfer, not B). ``donate``: the event
     buffers are donated to the call (the chunk path ships each exactly
-    once).
+    once). ``w_live`` bounds the kernel's slot unroll to the batch's
+    real peak-live window (ops.linearize.make_kernel).
 
     Production dispatch resolves this builder through the process-wide
     kernel registry (ops.linearize.get_kernel) — one cache for the
@@ -89,7 +91,7 @@ def data_sharded_kernel(V: int, W: int, mesh: Mesh,
     batch_spec = NamedSharding(mesh, P(axes))
     out_spec = NamedSharding(mesh, P(axes))
     tgt_spec = NamedSharding(mesh, P()) if shared_target else batch_spec
-    kern = jax.vmap(make_kernel(V, W),
+    kern = jax.vmap(make_kernel(V, W, w_live=w_live),
                     in_axes=(0, 0, 0, None if shared_target else 0))
     return jax.jit(kern,
                    in_shardings=(batch_spec,) * 3 + (tgt_spec,),

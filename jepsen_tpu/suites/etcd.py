@@ -275,7 +275,15 @@ def workload(test_opts: dict) -> dict:
         "perf": perf(),
     })
     return {"generator": generator, "checker": checker,
-            "model": cas_register(ABSENT)}
+            "model": cas_register(ABSENT),
+            # Serializable record of the workload's analysis constants:
+            # the replay seam (cli recheck / jepsen_tpu.recheck) reads
+            # these from the stored test.json instead of trusting
+            # operator flags.
+            "invariants": {"independent": True,
+                           "threads_per_key": threads,
+                           "ops_per_key": per_key,
+                           "n_values": test_opts.get("n_values", 5)}}
 
 
 def _with_nemesis(test: dict, nemesis_gen, time_limit: float) -> None:

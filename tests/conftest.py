@@ -14,3 +14,10 @@ from jepsen_tpu.provision import provision_in_process
 os.environ.setdefault("JT_COMPILE_CACHE", "0")
 
 provision_in_process(8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 CPU gate")
+    config.addinivalue_line(
+        "markers", "fast: cheap contract checks (host-purity etc.)")

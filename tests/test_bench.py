@@ -20,7 +20,9 @@ def test_bench_emits_contract_json():
                JT_BENCH_B="200", JT_BENCH_OPS="100",
                JT_BENCH_REPEATS="1", JT_BENCH_FOLD_B="50",
                JT_BENCH_STORE_B="20", JT_BENCH_CONVERTED="200",
-               JT_BENCH_FULL_PARITY="0")
+               JT_BENCH_FULL_PARITY="0",
+               JT_BENCH_LONG_B="50", JT_BENCH_LONG_OPS="500",
+               JT_BENCH_XLONG_B="8", JT_BENCH_XLONG_OPS="2000")
     r = subprocess.run([sys.executable, str(REPO / "bench.py")],
                        capture_output=True, text=True, env=env,
                        cwd=REPO, timeout=900)
@@ -40,3 +42,13 @@ def test_bench_emits_contract_json():
     assert d["store_recheck_runs"] == 20
     assert d["store_recheck_rate"] > 0
     assert d["fold_histories"] == 50
+    # Fused/renumbered-scan instrumentation (ISSUE 2 acceptance).
+    assert d["fusion_ratio"] >= 1.0
+    assert d["mean_live_slots"] > 0
+    assert d["roofline"]["vpu_util"] >= 0
+    assert d["roofline"]["closure_iters_total"] > 0
+    assert d["roofline"]["source_events_per_s"] > 0
+    x = d["xlong_history"]
+    assert x["histories"] > 0 and x["events_per_s"] > 0
+    assert x["encode_s"] >= 0 and x["device_s"] > 0   # the breakdown
+    assert x["event_chunked"]["events_per_s"] > 0

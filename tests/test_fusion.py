@@ -1,0 +1,356 @@
+"""Event fusion, live-slot kernel bounds, and state renumbering.
+
+The fused/renumbered encode is the streaming paths' fast encoding; the
+unfused exact-W flow stays the parity oracle. Pinned here:
+
+  * fusion semantics — which runs may fuse (single-candidate events,
+    history-start inclusion), composition correctness, and the
+    EV_FUSED device contract (verdict/bad/frontier identical to the
+    unfused scan, with fused-run failures re-derived exactly);
+  * the fusion precompute is a pure host-side function: no jax import,
+    no jit — tier-1 CPU runs must never pay a device trip for it;
+  * w_live-bounded kernels (closure/completion unroll only the live
+    window) return bit-identical results on class-widened batches;
+  * the event-chunked resume kernel (run_event_chunked) matches the
+    one-shot scan field-for-field;
+  * state renumbering shrinks multi-word vocabularies to the row's
+    live alphabet without changing a verdict.
+"""
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.linearizable import prepare_history, wgl_check
+from jepsen_tpu.history.core import index
+from jepsen_tpu.history.ops import info_op, invoke_op, ok_op
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.ops import encode as enc
+from jepsen_tpu.ops.encode import (EV_CLOSE, EV_FUSED, EV_OK,
+                                   bucket_encode, encode_columnar,
+                                   encode_history, fuse_walked,
+                                   widen_batch)
+from jepsen_tpu.ops.linearize import (check_batch_tpu, run_encoded_batch,
+                                      run_event_chunked, vpu_op_model)
+from jepsen_tpu.ops.statespace import (enumerate_statespace,
+                                       restrict_statespace)
+from jepsen_tpu.workloads.synth import synth_cas_columnar
+
+MODEL = cas_register()
+
+
+def seq_history(vals=(1, 2, 1), read_each=True):
+    """Fully sequential writes (+reads): every completion is
+    single-candidate, so everything from history start fuses."""
+    h = []
+    for v in vals:
+        h += [invoke_op(0, "write", v), ok_op(0, "write", v)]
+        if read_each:
+            h += [invoke_op(0, "read", None), ok_op(0, "read", v)]
+    return index(h)
+
+
+# ------------------------------------------------------------- semantics
+
+def test_sequential_history_fuses_to_two_events():
+    e = encode_history(MODEL, prepare_history(seq_history()), fuse=True)
+    assert list(e.ev_type) == [EV_FUSED, EV_CLOSE]
+    assert e.orig_events == 7                  # 6 completions + close
+    assert e.fused_rows is not None and len(e.fused_rows) == 1
+    # Composed map: every state lands on write(1);read(1);... = state 1.
+    sp = e.space
+    final = sp.states.index(cas_register(1))
+    assert all(t == final for t in e.fused_rows[0][:sp.n_states])
+
+
+def test_fusion_keeps_verdicts_and_configs():
+    # Valid and invalid sequential histories through the fused device
+    # path vs the host oracle, full result shape.
+    good = seq_history()
+    bad = index([invoke_op(0, "write", 1), ok_op(0, "write", 1),
+                 invoke_op(0, "read", None), ok_op(0, "read", 2)])
+    rs = check_batch_tpu(MODEL, [good, bad], scheduler=True)
+    hs = [wgl_check(MODEL, good), wgl_check(MODEL, bad)]
+    for r, h in zip(rs, hs):
+        assert r["valid"] == h["valid"]
+        if r["valid"] is False:
+            assert r["op"]["index"] == h["op"]["index"]
+        assert r.get("configs") == h.get("configs")
+
+
+def test_fused_run_failure_reports_exact_member():
+    # The run fails at its SECOND member (read 2 from state 1): the
+    # device only knows the run's first op; the refinement must still
+    # report index 3 (the bad read), not index 1.
+    h = index([invoke_op(0, "write", 1), ok_op(0, "write", 1),
+               invoke_op(0, "read", None), ok_op(0, "read", 2),
+               invoke_op(0, "write", 2), ok_op(0, "write", 2)])
+    r = check_batch_tpu(MODEL, [h], scheduler=True)[0]
+    want = wgl_check(MODEL, h)
+    assert r["valid"] is False and want["valid"] is False
+    assert r["op"]["index"] == want["op"]["index"] == 3
+    assert r["configs"] == want["configs"]
+
+
+def test_mid_history_run_keeps_first_event_unfused():
+    # Concurrency, then a sequential stretch. The stretch's first
+    # single-candidate completion (ok w2 — its snapshot holds only w2,
+    # w1's slot freed) enters with possibly non-empty masks, so it must
+    # stay a plain event; everything after it fuses.
+    h = index([invoke_op(0, "write", 1), invoke_op(1, "write", 2),
+               ok_op(0, "write", 1), ok_op(1, "write", 2),
+               invoke_op(0, "write", 3), ok_op(0, "write", 3),
+               invoke_op(0, "read", None), ok_op(0, "read", 3),
+               invoke_op(0, "write", 1), ok_op(0, "write", 1)])
+    e = encode_history(MODEL, prepare_history(h), fuse=True)
+    # events: w1 (live 2) | w2 (single-candidate RUN START: unfused) |
+    # fused (w3, read3, w1) | close
+    assert list(e.ev_type) == [EV_OK, EV_OK, EV_FUSED, EV_CLOSE]
+    assert e.orig_events == 6
+    v, bad, _ = run_encoded_batch(
+        bucket_encode(MODEL, [prepare_history(h)], fuse=True)[0])
+    assert bool(np.asarray(v)[0]) is True
+
+
+def test_info_pinned_slot_blocks_fusion():
+    # A pinned indeterminate op keeps live >= 2 forever after: nothing
+    # downstream may fuse ("info-free stretches").
+    h = index([invoke_op(1, "write", 9), info_op(1, "write", 9,
+                                                 error="timeout"),
+               invoke_op(0, "write", 1), ok_op(0, "write", 1),
+               invoke_op(0, "read", None), ok_op(0, "read", 1)])
+    e = encode_history(MODEL, prepare_history(h), fuse=True)
+    assert EV_FUSED not in list(e.ev_type)
+
+
+def test_fuse_walked_respects_kind_budget():
+    cols = synth_cas_columnar(64, seed=11, n_procs=1, n_ops=30,
+                              n_values=5, corrupt=0.0)
+    space = enumerate_statespace(MODEL, cols.kinds, 64)
+    buckets, _ = encode_columnar(space, cols, fuse=True)
+    for b in buckets:
+        K1 = b.target.shape[1]
+        assert int(b.ev_slots.max()) < K1
+        assert b.ev_slots.dtype == np.int8 or K1 - 1 >= 127
+
+
+# --------------------------------------------------- host-purity (no jit)
+
+@pytest.mark.fast
+def test_fusion_precompute_is_pure_host_side():
+    """The fusion precompute (and the whole fused columnar encode) must
+    run without jax even importable — it is host-side numpy by
+    contract, so tier-1 CPU runs never pay a device round trip or a
+    jit trace for it."""
+    code = r"""
+import sys
+
+class _Block:
+    def find_spec(self, name, path=None, target=None):
+        if name == "jax" or name.startswith("jax."):
+            raise ImportError("jax import blocked: fusion must be host-side")
+        return None
+
+sys.meta_path.insert(0, _Block())
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.ops.encode import EV_FUSED, encode_columnar
+from jepsen_tpu.ops.statespace import enumerate_statespace
+from jepsen_tpu.workloads.synth import synth_cas_columnar
+
+cols = synth_cas_columnar(16, seed=5, n_procs=1, n_ops=20, n_values=3)
+space = enumerate_statespace(cas_register(), cols.kinds, 64)
+buckets, fails = encode_columnar(space, cols, fuse=True, renumber=True)
+assert buckets and not fails
+assert sum(int((b.ev_type == EV_FUSED).sum()) for b in buckets) > 0
+assert "jax" not in sys.modules
+print("HOST-PURE")
+"""
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True,
+                       cwd=Path(__file__).resolve().parent.parent,
+                       timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "HOST-PURE" in r.stdout
+
+
+# ------------------------------------------------------- w_live kernels
+
+def test_w_live_bounded_kernel_bit_identical():
+    cols = synth_cas_columnar(40, seed=3, n_procs=4, n_ops=25,
+                              corrupt=0.4)
+    space = enumerate_statespace(MODEL, cols.kinds, 64)
+    buckets, _ = encode_columnar(space, cols)
+    b = max(buckets, key=lambda x: x.batch)
+    wide = widen_batch(b, b.W + 3)
+    assert wide.eff_w_live == b.W
+    v1, bad1, f1 = run_encoded_batch(b, return_frontier=True)
+    v2, bad2, f2 = run_encoded_batch(wide, return_frontier=True)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(bad1), np.asarray(bad2))
+    f1, f2 = np.asarray(f1), np.asarray(f2)
+    np.testing.assert_array_equal(f1, f2[:, :, :f1.shape[2]])
+    assert not f2[:, :, f1.shape[2]:].any()
+
+
+def test_vpu_op_model_scales_with_w_live():
+    full = vpu_op_model(8, 12)
+    live = vpu_op_model(8, 12, w_live=8)
+    assert live["per_iteration"] < full["per_iteration"]
+    assert live["per_event"] == full["per_event"]
+    assert full["masks"] == 1 << 12 and full["words"] == 1
+
+
+# -------------------------------------------------- event-chunked resume
+
+def test_event_chunked_scan_matches_one_shot():
+    cols = synth_cas_columnar(24, seed=9, n_procs=4, n_ops=40,
+                              corrupt=0.5, p_info=0.05)
+    space = enumerate_statespace(MODEL, cols.kinds, 64)
+    buckets, _ = encode_columnar(space, cols, fuse=True)
+    for b in buckets:
+        v1, bad1, f1 = run_encoded_batch(b, return_frontier=True)
+        v2, bad2, f2 = run_event_chunked(b, 16, return_frontier=True)
+        np.testing.assert_array_equal(np.asarray(v1), v2)
+        np.testing.assert_array_equal(np.asarray(bad1), bad2)
+        np.testing.assert_array_equal(np.asarray(f1), f2)
+
+
+# ---------------------------------------------------- state renumbering
+
+def _word_heavy_corpus(n=24):
+    """Histories over a >32-state shared vocabulary where most rows
+    only touch a narrow value band — the renumbering target shape."""
+    from jepsen_tpu.history.columnar import ops_to_columnar
+    hists = []
+    for s in range(n):
+        lo = (s % 3) * 2
+        vals = [lo, lo + 1, lo, lo + 1]
+        if s == 0:
+            vals = list(range(36, 70))      # one row forces V > 32
+        h = []
+        for i, v in enumerate(vals):
+            p = i % 2
+            h += [invoke_op(p, "write", v % 70), ok_op(p, "write", v % 70)]
+        hists.append(index(h))
+    return ops_to_columnar(MODEL, hists, max_states=128), hists
+
+
+def test_renumbering_shrinks_packed_words_and_keeps_verdicts():
+    from jepsen_tpu.ops.linearize import check_columnar
+    cols, hists = _word_heavy_corpus()
+    space = enumerate_statespace(MODEL, cols.kinds, 128)
+    assert space.n_states > 32                 # two packed words full
+    plain, _ = encode_columnar(space, cols, min_v=8)
+    ren, _ = encode_columnar(space, cols, min_v=8, renumber=True)
+    assert all(b.V > 32 for b in plain)
+    assert min(b.V for b in ren) <= 32, \
+        "narrow-alphabet rows must drop to one packed word"
+    va, ba = check_columnar(MODEL, cols, scheduler=True)
+    want = [wgl_check(MODEL, h)["valid"] is True for h in hists]
+    assert list(va) == want
+
+
+def test_merge_never_unions_tables_across_sub_spaces():
+    """Regression: two renumbered sub-spaces can produce same-shape
+    shared tables where one row is all -1 because the kind is
+    legitimately DEAD in that sub-alphabet (an unreachable read) — not
+    because it is an undiscovered fused row. merge_batches must not
+    graft the other space's live row into it: that rewrites the kind's
+    semantics and accepts invalid histories."""
+    from jepsen_tpu.history.columnar import ops_to_columnar
+    from jepsen_tpu.ops.linearize import check_columnar
+
+    filler = index([op for i in range(36, 70)
+                    for op in (invoke_op(0, "write", i),
+                               ok_op(0, "write", i))])
+    invalid = index([invoke_op(0, "write", 1), invoke_op(1, "read", None),
+                     ok_op(0, "write", 1), ok_op(1, "read", 5)])
+    valid = index([invoke_op(0, "write", 1), invoke_op(1, "read", None),
+                   ok_op(0, "write", 1), ok_op(1, "read", 1)])
+    hists = [filler, invalid, valid]
+    cols = ops_to_columnar(MODEL, hists, max_states=64)
+    va, _ = check_columnar(MODEL, cols, scheduler=True)
+    want = [wgl_check(MODEL, h)["valid"] for h in hists]
+    assert list(va) == want == [True, False, True]
+
+
+def test_restrict_statespace_lut_roundtrip():
+    kinds = [("write", 0), ("write", 1), ("write", 5), ("read", None)]
+    space = enumerate_statespace(MODEL, kinds, 64)
+    sub, lut = restrict_statespace(space, [0, 3])
+    assert sub.n_states <= space.n_states
+    assert lut[0] == 0 and lut[3] == 1 and lut[1] == -1
+    # Sub target rows agree with the full space's on shared states.
+    for full_k, sub_k in ((0, 0), (3, 1)):
+        for si, st in enumerate(sub.states):
+            t_sub = sub.target[sub_k, si]
+            t_full = space.target[full_k, space.states.index(st)]
+            if t_sub < 0:
+                assert t_full < 0
+            else:
+                assert space.states.index(sub.states[t_sub]) == t_full
+
+
+# ------------------------------------------------------ mutation killers
+
+def test_fusion_map_corruption_is_killed(monkeypatch):
+    """Seeded fusion bug: the composed map drops the run's last member.
+    The streamed-vs-exact parity net (the same comparison
+    tests/test_oracle_fuzz.py runs corpus-wide) MUST catch it — an
+    invalid history whose violation sits in the dropped member would
+    otherwise pass."""
+    real = enc._compose_rows
+
+    def corrupted(target, ks):
+        return real(target, ks[:-1]) if len(ks) > 1 else real(target, ks)
+
+    monkeypatch.setattr(enc, "_compose_rows", corrupted)
+    # Invalid history whose violation sits in the run's LAST member: a
+    # stale read (1 after write 2). Dropping that member makes the
+    # corrupted engine accept it — valid=True — so no fused-failure
+    # refinement ever runs; only the parity comparison can notice.
+    bad = index([invoke_op(0, "write", 1), ok_op(0, "write", 1),
+                 invoke_op(0, "write", 2), ok_op(0, "write", 2),
+                 invoke_op(0, "read", None), ok_op(0, "read", 1)])
+    streamed = check_batch_tpu(MODEL, [seq_history(), bad],
+                               scheduler=True)
+    exact = check_batch_tpu(MODEL, [seq_history(), bad],
+                            scheduler=False)
+    assert any(s["valid"] != e["valid"]
+               for s, e in zip(streamed, exact)), \
+        "corrupted fusion map escaped the parity net"
+
+
+def test_fuse_walked_does_not_mutate_inputs():
+    cols = synth_cas_columnar(8, seed=2, n_procs=1, n_ops=10)
+    space = enumerate_statespace(MODEL, cols.kinds, 64)
+    plain, _ = encode_columnar(space, cols)
+    before = [b.ev_slots.copy() for b in plain]
+    encode_columnar(space, cols, fuse=True)
+    for b, want in zip(plain, before):
+        np.testing.assert_array_equal(b.ev_slots, want)
+
+
+def test_fuse_walked_direct_contract():
+    # One row, three sequential completions, close: [f..b] from start.
+    space = enumerate_statespace(
+        MODEL, [("write", 0), ("write", 1)], 64)
+    K = space.n_kinds
+    ev_slot = np.zeros((1, 4), np.int8)
+    ev_slots = np.full((1, 4, 2), K, np.int8)
+    for e, k in enumerate((0, 1, 0)):
+        ev_slots[0, e, 0] = k
+    ev_opidx = np.array([[1, 3, 5, -1]], np.int32)
+    n_events = np.array([4], np.int32)
+    s1, ss1, op1, nev1, mask, rows, _ = fuse_walked(
+        ev_slot, ev_slots, ev_opidx, n_events, space.target,
+        sentinel=K, fused_start=K + 1)
+    assert int(nev1[0]) == 2 and len(rows) == 1
+    assert bool(mask[0, 0]) and not mask[0, 1:].any()
+    assert op1[0, 0] == 1                      # first member anchors
+    assert ss1[0, 0, 0] == K + 1               # composed kind id
+    # write0;write1;write0 composes to the constant write0 map.
+    np.testing.assert_array_equal(rows[0][:space.n_states],
+                                  space.target[0])

@@ -182,11 +182,23 @@ def test_fuzz_tpu_engine_matches_oracle(blind_corpus, oracle_verdicts):
 def test_fuzz_streamed_scheduler_matches_exact_path(blind_corpus):
     """The streamed bucket scheduler (ops.schedule) vs the exact-W flow
     on the full blind corpus, field-for-field: valid, bad op index, and
-    counterexample configs must all match. (The streamed path is also
-    pinned to the brute oracle corpus-wide: check_batch_tpu defaults to
-    scheduler=True, so test_fuzz_tpu_engine_matches_oracle runs it.)"""
+    counterexample configs must all match. The streamed path encodes
+    FUSED (single-candidate runs collapse into EV_FUSED steps,
+    ops.encode.fuse_walked) — this is the fused kernel's corpus-wide
+    parity gate, so first prove fusion actually engages on the corpus.
+    (The streamed path is also pinned to the brute oracle corpus-wide:
+    check_batch_tpu defaults to scheduler=True, so
+    test_fuzz_tpu_engine_matches_oracle runs it.)"""
+    from jepsen_tpu.checkers.linearizable import prepare_history
+    from jepsen_tpu.ops.encode import EV_FUSED, bucket_encode
     from jepsen_tpu.ops.linearize import check_batch_tpu
+    n_fused = 0
     for family, (model, hists) in sorted(blind_corpus.items()):
+        buckets = bucket_encode(model, [prepare_history(h)
+                                        for h in hists],
+                                max_states=24, fuse=True)
+        n_fused += sum(int((b.ev_type == EV_FUSED).sum())
+                       for b in buckets)
         streamed = check_batch_tpu(model, hists, max_states=24,
                                    scheduler=True)
         exact = check_batch_tpu(model, hists, max_states=24,
@@ -196,6 +208,8 @@ def test_fuzz_streamed_scheduler_matches_exact_path(blind_corpus):
             if s["valid"] is False:
                 assert s["op"]["index"] == e["op"]["index"], (family, i)
             assert s.get("configs") == e.get("configs"), (family, i)
+    assert n_fused > 0, \
+        "fusion never engaged: the parity gate would be vacuous"
 
 
 def test_fuzz_competition_engine_matches_oracle(blind_corpus,
@@ -351,6 +365,37 @@ def test_mutation_info_forced_ok_is_caught(mutation_corpus):
     cases, oracle = mutation_corpus
     _, _, bad = fuzz_against_oracle(cases, mutated_engine, oracle=oracle)
     assert len(bad) >= 1, "mutated engine escaped the fuzz net"
+
+
+def test_mutation_fusion_map_corruption_is_caught(monkeypatch,
+                                                  mutation_corpus):
+    """Seeded device-path bug: the event-fusion composition drops each
+    run's last member (ops.encode._compose_rows). The streamed-vs-exact
+    parity comparison — the same net
+    test_fuzz_streamed_scheduler_matches_exact_path runs corpus-wide —
+    MUST notice: a violation sitting in a dropped member makes the
+    fused engine accept an invalid history."""
+    from jepsen_tpu.ops import encode as enc_mod
+    from jepsen_tpu.ops.linearize import check_batch_tpu
+
+    real = enc_mod._compose_rows
+
+    def corrupted(target, ks):
+        return real(target, ks[:-1]) if len(ks) > 1 else real(target, ks)
+
+    monkeypatch.setattr(enc_mod, "_compose_rows", corrupted)
+    cases, _ = mutation_corpus
+    disagreements = 0
+    for family, (model, hists) in sorted(cases.items()):
+        streamed = check_batch_tpu(model, hists, max_states=24,
+                                   scheduler=True)
+        exact = check_batch_tpu(model, hists, max_states=24,
+                                scheduler=False)
+        disagreements += sum(
+            1 for s, e in zip(streamed, exact, strict=True)
+            if s["valid"] != e["valid"])
+    assert disagreements >= 1, \
+        "corrupted fusion map escaped the streamed-vs-exact parity net"
 
 
 def test_oracle_refuses_big_histories():

@@ -260,3 +260,67 @@ def test_recheck_family_names_cover_registry():
     from jepsen_tpu.cli import recheck_cmd
     from jepsen_tpu.recheck import FAMILY_NAMES, registry
     assert set(FAMILY_NAMES) == set(registry())
+
+
+def test_recheck_bank_reads_invariants_from_stored_run(tmp_path,
+                                                       monkeypatch,
+                                                       caplog):
+    """A bank run with NON-default constants (3 accounts x 20): with no
+    flags, recheck must re-derive the stored invariant from test.json
+    and reproduce the original verdict — the 5/10 hardcode would
+    condemn this valid run. An explicit contradicting flag wins but
+    warns (VERDICT r5 weak #6)."""
+    import logging
+
+    from jepsen_tpu.recheck import recheck_family
+    from jepsen_tpu.suites.cockroachdb import bank_workload
+
+    h = [invoke_op(0, "read", None),
+         ok_op(0, "read", {a: 20 for a in range(3)})]
+    # A second run of the SAME test under different constants (7x4):
+    # each run must recheck against its OWN recorded invariant, not the
+    # newest run's.
+    h2 = [invoke_op(0, "read", None),
+          ok_op(0, "read", {a: 4 for a in range(7)})]
+    store = _store_runs(tmp_path, monkeypatch, "bank3", [h, h2])
+    for ts, acc, bal in (("r0", 3, 20), ("r1", 7, 4)):
+        store.create("bank3", ts=ts).save_test(
+            {"name": "bank3",
+             **{k: v for k, v in bank_workload(
+                 {"accounts": acc, "balance": bal}).items()
+                if k == "invariants"}})
+
+    out = recheck_family(store, "bank3", "bank")
+    assert out["valid"] is True, out        # per-run constants applied
+    assert out["runs"]["r0"]["valid"] is True
+    assert out["runs"]["r1"]["valid"] is True
+    # The old hardcoded default must reject the same run.
+    assert recheck_family(store, "bank3", "bank",
+                          accounts=5, balance=10)["valid"] is False
+    # ... and contradicting the stored run logs a warning.
+    with caplog.at_level(logging.WARNING, logger="jepsen.recheck"):
+        recheck_family(store, "bank3", "bank", accounts=5)
+    assert any("contradicts the stored run" in r.message
+               for r in caplog.records)
+
+
+def test_recheck_defaults_independent_from_stored_run(tmp_path,
+                                                      monkeypatch):
+    """A stored independent-keys run (the etcd/register shape) rechecks
+    with per-key straining by default once its test.json records
+    independent=True — no --independent flag needed."""
+    from jepsen_tpu import independent
+    from jepsen_tpu.recheck import recheck_family, stored_invariants
+
+    h = [invoke_op(0, "write", independent.tuple_(1, 1)),
+         ok_op(0, "write", independent.tuple_(1, 1)),
+         invoke_op(1, "read", independent.tuple_(2, None)),
+         ok_op(1, "read", independent.tuple_(2, 0))]
+    store = _store_runs(tmp_path, monkeypatch, "ind", [h])
+    store.create("ind", ts="r0").save_test(
+        {"name": "ind", "invariants": {"independent": True}})
+    assert stored_invariants(store, "ind")["independent"] is True
+    out = recheck_family(store, "ind", "cas")
+    run = out["runs"]["r0"]
+    assert set(run["results"]) == {1, 2}, \
+        "stored independent=True must strain per-key units"
