@@ -697,6 +697,19 @@ def main():
             "chunks": streamed_stats.get("chunks"),
             "pad_rows": streamed_stats.get("pad_rows"),
             "input_buckets": streamed_stats.get("input_buckets"),
+            # Resilience counters (ops.faults / doc/resilience.md):
+            # all zero on a healthy run — future BENCH_*.json track
+            # fallback/retry rates, so a regression that starts
+            # leaning on the degradation ladder is visible even while
+            # verdicts stay correct. Summed over the headline +
+            # streamed runs.
+            "resilience": {
+                k: (sched_stats.get(k, 0) or 0)
+                + (streamed_stats.get(k, 0) or 0)
+                for k in ("retries", "bisections", "watchdog_fired",
+                          "oom_events", "corrupt_chunks",
+                          "quarantined_rows", "prewarm_wedged",
+                          "abandoned_buckets", "faults_injected")},
         },
         "roofline": roofline,
         "long_history": long_stats,

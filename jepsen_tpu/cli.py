@@ -457,6 +457,11 @@ def recheck_cmd() -> dict:
                        help="bank: expected per-account start balance "
                             "(default: the stored run's invariants, "
                             "else 10)")
+        p.add_argument("--resume", action="store_true", default=False,
+                       help="Continue an interrupted recheck from its "
+                            "durable chunk journal: rows with "
+                            "journaled verdicts are never "
+                            "re-dispatched (doc/resilience.md)")
 
     def run(opts):
         import json as _json
@@ -466,11 +471,14 @@ def recheck_cmd() -> dict:
         out = recheck_family(DEFAULT, opts.test, opts.model,
                              independent=opts.independent,
                              accounts=opts.accounts,
-                             balance=opts.balance)
-        print(_json.dumps(
-            {"valid": out["valid"],
-             "runs": {ts: r["valid"] for ts, r in out["runs"].items()}},
-            default=str))
+                             balance=opts.balance,
+                             resume=opts.resume)
+        line = {"valid": out["valid"],
+                "runs": {ts: r["valid"]
+                         for ts, r in out["runs"].items()}}
+        if "resume_hits" in out:
+            line["resume_hits"] = out["resume_hits"]
+        print(_json.dumps(line, default=str))
         return 0 if out["valid"] is True else 1
 
     return {"recheck": {"add_opts": add_opts, "run": run}}

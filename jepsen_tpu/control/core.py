@@ -34,6 +34,18 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+# Transient-failure retry budget: ONE knob for every control-plane
+# retry loop (ssh_run's transport retries and control.util.with_retry's
+# exec-level retries), so suite setup survives a dropped connection
+# without each call site inventing its own policy. $JT_SSH_RETRIES
+# overrides; per-session "retries" in the ssh config still wins.
+SSH_RETRIES = int(os.environ.get("JT_SSH_RETRIES", "3"))
+
+# Exponential backoff base between transient retries (doubles per
+# attempt, capped at SSH_BACKOFF_CAP_S, plus jitter).
+SSH_BACKOFF_S = float(os.environ.get("JT_SSH_BACKOFF_S", "0.5"))
+SSH_BACKOFF_CAP_S = 8.0
+
 DEFAULT_SSH = {
     "username": "root",
     "password": None,
@@ -41,7 +53,7 @@ DEFAULT_SSH = {
     "private_key_path": None,
     "strict_host_key_checking": False,
     "dummy": False,
-    "retries": 5,
+    "retries": SSH_RETRIES,
 }
 
 
@@ -353,20 +365,39 @@ def _wrap(cmd: str, stdin: Optional[str]) -> Tuple[str, Optional[str]]:
     return cmd, stdin
 
 
+def backoff_delay(attempt: int, base: float = SSH_BACKOFF_S,
+                  cap: float = SSH_BACKOFF_CAP_S) -> float:
+    """Jittered exponential backoff: base·2^attempt capped, plus up to
+    half the base of jitter so a whole node fleet retrying a dropped
+    switch doesn't re-stampede in lockstep."""
+    return min(cap, base * (2 ** attempt)) + random.random() * base / 2
+
+
 def ssh_run(cmd: str, stdin: Optional[str] = None) -> Tuple[str, str, int]:
     """Run a raw (already-wrapped) command with transient-failure retry
-    (control.clj:140-160; exit 255 = OpenSSH transport failure)."""
+    (control.clj:140-160; exit 255 = OpenSSH transport failure, which
+    also covers failures to CONNECT — a dead master socket, a refused
+    TCP connect). Retries use jittered exponential backoff up to the
+    session's budget (the single SSH_RETRIES knob). OS-level transport
+    errors (the ssh/scp subprocess itself failing to spawn or being
+    torn down mid-call) are normalized to exit 255 so one retry policy
+    covers every transient shape."""
     s = _ctx.session
     if s is None:
         raise RuntimeError(
             f"No SSH session bound for this thread (host={_ctx.host!r}); "
             f"run inside with_session/on/on_nodes")
     tries = s.retries
+    attempt = 0
     while True:
-        out, err, code = s.transport.run(cmd, stdin)
+        try:
+            out, err, code = s.transport.run(cmd, stdin)
+        except OSError as e:
+            out, err, code = "", f"transport error: {e}", 255
         if code == 255 and tries > 0:
             tries -= 1
-            time.sleep(1 + random.random())
+            time.sleep(backoff_delay(attempt))
+            attempt += 1
             continue
         return out, err, code
 

@@ -12,12 +12,17 @@ import random
 import re
 from typing import List, Optional
 
-from .core import (RemoteError, cd, exec_, exec_star, expand_path,
-                   lit, su, _ctx)
+from .core import (SSH_RETRIES, RemoteError, backoff_delay, cd, exec_,
+                   exec_star, expand_path, lit, su, _ctx)
 
 log = logging.getLogger("jepsen.control.util")
 
 TMP_DIR_BASE = "/tmp/jepsen"
+
+# Remote exit codes that mean the TRANSPORT (not the command) failed:
+# 255 = OpenSSH connect/exec failure, 124 = the transport-level command
+# deadline fired (core's TimeoutExpired normalization).
+TRANSIENT_EXITS = (255, 124)
 
 
 def meh(f, *args, **kw):
@@ -26,6 +31,39 @@ def meh(f, *args, **kw):
         return f(*args, **kw)
     except RemoteError:
         return None
+
+
+def is_transient(e: BaseException) -> bool:
+    """Did this remote failure come from the transport rather than the
+    command? Only those are safe to blindly retry — a nonzero exit from
+    the command itself usually isn't idempotent to repeat."""
+    return isinstance(e, RemoteError) and e.exit in TRANSIENT_EXITS
+
+
+def with_retry(f, *args, attempts: int = None, on_retry=None, **kw):
+    """Run a control-plane step with bounded retry-with-backoff on
+    TRANSIENT remote failures (is_transient) — the setup-level
+    companion to ssh_run's per-command transport retry, for multi-
+    command steps (install_archive, daemon starts, readiness probes)
+    where one dropped connection mid-step must not abort the whole
+    suite run. ``attempts`` defaults to the single SSH_RETRIES knob
+    ($JT_SSH_RETRIES, default 3 — extra attempts beyond the first);
+    non-transient failures propagate immediately."""
+    attempts = SSH_RETRIES if attempts is None else max(0, int(attempts))
+    for attempt in range(attempts + 1):
+        try:
+            return f(*args, **kw)
+        except RemoteError as e:
+            if not is_transient(e) or attempt == attempts:
+                raise
+            log.warning("transient remote failure on %s (attempt %s/%s"
+                        "): %s; retrying", _ctx.host, attempt + 1,
+                        attempts + 1, str(e).splitlines()[0])
+            if on_retry is not None:
+                on_retry(attempt, e)
+            import time
+            time.sleep(backoff_delay(attempt))
+    raise AssertionError("unreachable")
 
 
 def exists(filename: str) -> bool:
@@ -82,7 +120,10 @@ def install_archive(url: str, dest: str, force: bool = False) -> str:
         local_file = None
         exec_("mkdir", "-p", TMP_DIR_BASE)
         with cd(TMP_DIR_BASE):
-            file = expand_path(wget(url, force))
+            # Downloads are idempotent (wget skips the cached file), so
+            # a dropped connection mid-fetch retries instead of
+            # aborting the node's whole setup.
+            file = expand_path(with_retry(wget, url, force))
     tmpdir = tmp_dir()
     dest = expand_path(dest)
 

@@ -12,6 +12,10 @@ Modules:
   encode     — history → event tensor lowering (slot assignment, batching)
   linearize  — dense-frontier WGL linearizability kernel (vmapped, sharded)
   folds      — vmapped single-pass checkers (set/counter/unique-ids/queue)
+  schedule   — streaming bucket scheduler + the degradation ladder
+               (watchdog, retry, OOM bisection, poison-row quarantine)
+  faults     — the checker nemesis: deterministic fault injection at the
+               encode/dispatch/decode boundaries (doc/resilience.md)
 
 (The device mesh / sharding helpers live in jepsen_tpu.parallel.)
 """

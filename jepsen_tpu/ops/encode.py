@@ -851,6 +851,28 @@ def _bucket_encoded(space, ev_slot, ev_slots, ev_opidx, max_live,
     return out, failures
 
 
+def take_rows(batch: EncodedBatch, rows: Sequence[int]) -> EncodedBatch:
+    """Row-subset of a batch at arbitrary positions — the journal-resume
+    filter: completed rows drop out of a batch before dispatch without
+    disturbing the survivors' encoding or their caller-level indices."""
+    rows = list(rows)
+    if len(rows) == batch.batch:
+        return batch
+    r = np.asarray(rows, np.int64)
+    return EncodedBatch(
+        ev_type=batch.ev_type[r], ev_slot=batch.ev_slot[r],
+        ev_slots=batch.ev_slots[r], ev_opidx=batch.ev_opidx[r],
+        target=batch.target if batch.shared_target else batch.target[r],
+        V=batch.V, W=batch.W,
+        indices=[batch.indices[i] for i in rows],
+        failures=list(batch.failures),
+        spaces=([batch.spaces[i] for i in rows] if batch.spaces
+                else batch.spaces),
+        shared_target=batch.shared_target, w_live=batch.w_live,
+        orig_n_events=(batch.orig_n_events[r]
+                       if batch.orig_n_events is not None else None))
+
+
 def widen_batch(batch: EncodedBatch, W: int) -> EncodedBatch:
     """Re-target an encoded batch at a wider W class (W >= batch.W).
 

@@ -869,10 +869,29 @@ def _result_for(row: int, batch: EncodedBatch, valid: np.ndarray,
                           frontier[row])
 
 
+def _rehydrate_verdict(valid: bool, bad: Optional[int],
+                       prov: str) -> dict:
+    """Result dict for a row decided by a previous interrupted run
+    (chunk journal). Bare — no config sample; the journal records
+    verdicts, not frontiers — and marked ``resumed``."""
+    out: dict = {"valid": valid, "provenance": prov, "resumed": True}
+    if valid is False:
+        out["op"] = {"index": bad}
+    return out
+
+
+def _journal_result(journal, i: int, r: dict) -> None:
+    """Journal one host-decided row's final verdict (no-op without a
+    journal). One translation for both checkers: _sink_verdict."""
+    if journal is not None:
+        _sink_verdict(journal.record, i, r)
+
+
 def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
                     max_states: int = MAX_PACKED_STATES, max_slots: int = 16,
                     host_fallback=None, min_device_batch: int = 1,
-                    scheduler: bool = True) -> List[dict]:
+                    scheduler: bool = True, faults=None, journal=None,
+                    scheduler_opts: Optional[dict] = None) -> List[dict]:
     """Check many raw histories on device; per-history result dicts.
 
     Histories the encoder cannot bound (state-space explosion, pending
@@ -889,9 +908,21 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
     ``min_device_batch`` CPU route only applies to *wide*
     (W >= DATA_MAX_SLOTS) stragglers. ``scheduler=False`` keeps the
     one-kernel-per-exact-W flow (the parity oracle for the scheduler).
+
+    On the scheduler path every result carries a ``provenance`` tag —
+    ``device`` / ``device-retried`` / ``host-fallback`` (which engine,
+    and how hard the ladder had to work, decided the row; see
+    doc/resilience.md). Rows the scheduler quarantines after its
+    degradation ladder are re-decided by ``host_fallback`` (the exact
+    parity oracle), so every history gets a verdict under any fault
+    schedule. ``faults`` injects a checker nemesis (ops.faults);
+    ``journal`` (store.ChunkJournal) makes retired chunk verdicts
+    durable and resumes from them; ``scheduler_opts`` forwards knobs to
+    BucketScheduler (chunk_rows, max_classes, ...).
     """
     from ..checkers.linearizable import prepare_history, wgl_check
     from ..history.core import index as index_history
+    from .encode import take_rows
     if host_fallback is None:
         _cache: dict = {}
 
@@ -913,8 +944,19 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
                             max_slots=eff_slots, fuse=scheduler)
 
     results: List[Optional[dict]] = [None] * len(histories)
+    decided: dict = {}
+    if journal is not None and scheduler:
+        decided = {i: d for i, d in journal.decided().items()
+                   if 0 <= i < len(histories)}
+        for i, (vl, bd, pv) in decided.items():
+            results[i] = _rehydrate_verdict(vl, bd, pv)
     device_batches = []
     for batch in buckets:
+        if decided:
+            # Resume: rows with journaled verdicts never re-dispatch.
+            batch = take_rows(batch, [r for r, i in
+                                      enumerate(batch.indices)
+                                      if i not in decided])
         if 0 < batch.batch < min_device_batch and \
                 (not scheduler or batch.W >= DATA_MAX_SLOTS):
             # Small-bucket CPU route. Under the scheduler, narrow small
@@ -928,16 +970,29 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
                 rs = [host_fallback(model, histories[i])
                       for i in batch.indices]
             for i, r in zip(batch.indices, rs):
+                if scheduler:
+                    r.setdefault("provenance", "host-fallback")
+                    _journal_result(journal, i, r)
                 results[i] = r
-        else:
+        elif batch.batch:
             device_batches.append(batch)
         for i, reason in batch.failures:
+            if i in decided:
+                continue
             r = host_fallback(model, histories[i])
             r.setdefault("fallback", reason)
+            if scheduler:
+                r.setdefault("provenance", "host-fallback")
+                _journal_result(journal, i, r)
             results[i] = r
+    sch = None
     if scheduler:
-        from .schedule import run_buckets_streamed
-        stream = run_buckets_streamed(device_batches, return_frontier=True)
+        from .schedule import BucketScheduler
+        sch = BucketScheduler(return_frontier=True, faults=faults,
+                              **(scheduler_opts or {}))
+        if journal is not None:
+            sch.on_chunk = _batch_chunk_recorder(sch, journal)
+        stream = sch.run(device_batches)
     else:
         stream = run_buckets_threaded(device_batches, return_frontier=True)
     for batch, out in stream:
@@ -945,12 +1000,17 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
             for i in batch.indices:
                 r = host_fallback(model, histories[i])
                 r.setdefault("fallback", str(out))
+                if scheduler:
+                    r.setdefault("provenance", "host-fallback")
+                    _journal_result(journal, i, r)
                 results[i] = r
             continue
         valid, bad, front = out
         valid, bad = np.asarray(valid), np.asarray(bad)
         fused = set(fused_bad_rows(batch, valid, bad).tolist())
         for row, i in enumerate(batch.indices):
+            if sch is not None and i in sch.quarantined:
+                continue           # placeholder; re-decided below
             if row in fused:
                 # The first impossible completion fell inside a fused
                 # run: the device only knows the run's first member.
@@ -958,10 +1018,53 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
                 # host — rare (invalid rows failing in a sequential
                 # stretch), and the host engine is the parity shape.
                 results[i] = host_fallback(model, histories[i])
+                if scheduler:
+                    results[i].setdefault("provenance", "host-fallback")
+                    _journal_result(journal, i, results[i])
                 continue
             results[i] = _result_for(row, batch, valid, bad, front,
                                      model, prepared[i])
+            if sch is not None:
+                results[i]["provenance"] = sch.row_provenance.get(
+                    i, "device")
+    if sch is not None:
+        # Quarantined rows: the degradation ladder gave up on device —
+        # the exact host oracle decides them, so every history still
+        # gets a verdict under any fault schedule.
+        for i, why in sch.quarantined.items():
+            r = host_fallback(model, histories[i])
+            r.setdefault("fallback", f"quarantined: {why}")
+            r["provenance"] = "host-fallback"
+            _journal_result(journal, i, r)
+            results[i] = r
     return results
+
+
+def _batch_chunk_recorder(sch, journal):
+    """on_chunk hook journaling device chunk verdicts as they retire
+    (check_batch_tpu shape: bad is the history-op index). Rows that
+    need host re-derivation — fused-run failures, quarantined rows —
+    are skipped here and journaled when their final verdict lands."""
+    def on_chunk(b, lo, hi, v, bad, fr):
+        rows, vals, bads, provs = [], [], [], []
+        for k in range(hi - lo):
+            rp = lo + k
+            i = b.indices[rp]
+            if i in sch.quarantined:
+                continue
+            vk = bool(v[k])
+            bd = None
+            if not vk:
+                ev = int(bad[k])
+                if b.ev_type[rp, ev] == EV_FUSED:
+                    continue
+                bd = int(b.ev_opidx[rp, ev])
+            rows.append(i)
+            vals.append(vk)
+            bads.append(bd)
+            provs.append(sch.row_provenance.get(i, "device"))
+        journal.record(rows, vals, bads, provs)
+    return on_chunk
 
 
 def check_one_tpu(model: Model, history: List[Op], **kw) -> dict:
@@ -1013,9 +1116,20 @@ class _NativeTailWorker:
             out.extend(zip(idxs, rs))
 
 
+def _cols_take(cols, rows):
+    """Row-subset of a ColumnarOps batch (the journal-resume filter)."""
+    r = np.asarray(rows, np.int64)
+    return type(cols)(
+        type=cols.type[r], process=cols.process[r], kind=cols.kind[r],
+        kinds=cols.kinds,
+        index=cols.index[r] if cols.index is not None else None)
+
+
 def check_columnar(model: Model, cols, *, max_slots: int = 16,
                    host_fallback=None, details=False,
-                   min_device_batch: int = 1, scheduler: bool = True):
+                   min_device_batch: int = 1, scheduler: bool = True,
+                   faults=None, journal=None,
+                   scheduler_opts: Optional[dict] = None):
     """Device-check a ColumnarOps batch end-to-end at tensor speed.
 
     Returns (valid [B] bool, bad [B] int32) — ``bad`` is the op index of
@@ -1046,7 +1160,70 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
     chunks decode. ``scheduler=False`` keeps the fully-encoded
     exact-W flow — the parity oracle the streamed path is tested
     against.
+
+    Fault tolerance (scheduler path; doc/resilience.md): chunks run
+    under the degradation ladder — watchdog + retry, OOM bisection,
+    poison-row quarantine to ``host_fallback`` — so every row gets a
+    verdict under any single fault. ``faults`` injects the checker
+    nemesis (ops.faults). ``journal`` (store.ChunkJournal) makes
+    retired chunk verdicts durable: rows the journal already holds are
+    sliced out BEFORE encoding and never re-dispatched, and fresh
+    verdicts append as chunks retire — the kill-and-resume seam.
+    Resumed rows' detail dicts are bare verdicts (no config sample)
+    marked ``resumed``. ``scheduler_opts`` forwards BucketScheduler
+    knobs (chunk_rows, max_classes, ...).
     """
+    if journal is None or not scheduler:
+        return _check_columnar_impl(
+            model, cols, max_slots=max_slots, host_fallback=host_fallback,
+            details=details, min_device_batch=min_device_batch,
+            scheduler=scheduler, faults=faults,
+            scheduler_opts=scheduler_opts, sink=None)
+    decided = {r: d for r, d in journal.decided().items()
+               if 0 <= r < cols.batch}
+    keep = [r for r in range(cols.batch) if r not in decided]
+    if len(keep) == cols.batch:
+        sub = cols
+
+        def sink(rows, valid, bad, prov):
+            journal.record(rows, valid, bad, prov)
+    else:
+        sub = _cols_take(cols, keep)
+
+        def sink(rows, valid, bad, prov):
+            journal.record([keep[int(r)] for r in rows], valid, bad,
+                           prov)
+    inner = _check_columnar_impl(
+        model, sub, max_slots=max_slots, host_fallback=host_fallback,
+        details=details, min_device_batch=min_device_batch,
+        scheduler=True, faults=faults, scheduler_opts=scheduler_opts,
+        sink=sink)
+    if not decided:
+        return inner
+    if details:
+        results: List[Optional[dict]] = [None] * cols.batch
+        for r, (vl, bd, pv) in decided.items():
+            results[r] = _rehydrate_verdict(vl, bd, pv)
+        for j, r in enumerate(keep):
+            results[r] = inner[j]
+        return results
+    valid = np.ones(cols.batch, bool)
+    bad = np.full(cols.batch, INT32_MAX, np.int32)
+    for r, (vl, bd, pv) in decided.items():
+        valid[r] = vl
+        if vl is False and bd is not None:
+            bad[r] = bd
+    if keep:
+        k = np.asarray(keep)
+        iv, ib = inner
+        valid[k] = iv
+        bad[k] = ib
+    return valid, bad
+
+
+def _check_columnar_impl(model: Model, cols, *, max_slots, host_fallback,
+                         details, min_device_batch, scheduler, faults,
+                         scheduler_opts, sink):
     from ..checkers.linearizable import wgl_check
     from ..history.columnar import columnar_to_ops
     from .encode import encode_columnar
@@ -1080,6 +1257,7 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
             tail = _NativeTailWorker(model, cols)
         except Exception:
             tail = None
+    sch = None
     if scheduler:
         from .schedule import (DIVERTED, BucketScheduler,
                                iter_columnar_groups)
@@ -1088,7 +1266,10 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
                                       renumber=True)
         sch = BucketScheduler(
             return_frontier=details,
-            min_device_rows=min_device_batch if tail is not None else 0)
+            min_device_rows=min_device_batch if tail is not None else 0,
+            faults=faults, **(scheduler_opts or {}))
+        if sink is not None:
+            sch.on_chunk = _columnar_chunk_recorder(sch, cols, sink)
         stream = sch.run(groups)
     else:
         DIVERTED = object()       # never yielded by the threaded path
@@ -1125,8 +1306,16 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
         fused_local = set(fb.tolist())
         if details:
             for bi, row in enumerate(batch.indices):
+                if sch is not None and row in sch.quarantined:
+                    continue       # placeholder; host-decided below
                 if details == "invalid" and bool(v[bi]):
+                    # Lazy mode's valid rows stay the bare contract
+                    # dict; provenance appears only when it carries
+                    # information (the row left the happy path).
                     results[row] = {"valid": True}
+                    if sch is not None and row in sch.row_provenance:
+                        results[row]["provenance"] = \
+                            sch.row_provenance[row]
                     continue
                 if bi in fused_local:
                     continue               # refined below
@@ -1143,6 +1332,16 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
                     sp, ops, bool(v[bi]),
                     int(bad[row]) if not bool(v[bi]) else -1, front[bi],
                     predropped=True)
+                if sch is not None:
+                    results[row]["provenance"] = \
+                        sch.row_provenance.get(row, "device")
+    if sch is not None:
+        # Rows the degradation ladder quarantined carry inert
+        # placeholder verdicts in the stream: re-decide each through
+        # the host engine (the failures path below), so every row gets
+        # a real verdict under any fault schedule.
+        failures.extend((i, f"quarantined: {why}")
+                        for i, why in sch.quarantined.items())
     if tail is not None:
         for i, r in tail.finish():
             if r is None:                    # native engine unavailable
@@ -1157,6 +1356,9 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
                 results[i] = ({"valid": True} if r["valid"] is True
                               else host_fallback(
                                   model, columnar_to_ops(cols, i)))
+                results[i].setdefault("provenance", "host-fallback")
+            if sink is not None:
+                _sink_verdict(sink, i, r)
     if fused_refine:
         # Exact bad-index/counterexample recovery for rows that failed
         # inside a fused run. Verdict-only callers ride the native
@@ -1177,7 +1379,10 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
             if r["valid"] is False:
                 bad[i] = r["op"].get("index", -1)
             if details:
+                r.setdefault("provenance", "host-fallback")
                 results[i] = r
+            if sink is not None:
+                _sink_verdict(sink, i, r)
     for row, reason in failures:
         r = host_fallback(model, columnar_to_ops(cols, row))
         valid[row] = r["valid"] is True
@@ -1185,17 +1390,66 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
             bad[row] = r["op"].get("index", -1)
         if details:
             r.setdefault("fallback", reason)
+            r.setdefault("provenance", "host-fallback")
             results[row] = r
+        if sink is not None:
+            _sink_verdict(sink, row, r)
     if details:
         return results
     return valid, bad
+
+
+def _sink_verdict(sink, row: int, r: dict) -> None:
+    """Journal one host-decided row's final verdict through a write
+    callable (a check_columnar sink that remaps sub-batch rows, or
+    ChunkJournal.record directly) — the ONE result-dict→journal-record
+    translation, so the two checkers' journal shapes cannot drift.
+    Non-boolean verdicts ("unknown") are not journaled — a resumed run
+    re-derives them."""
+    if r.get("valid") is True:
+        sink([row], [True], [None], ["host-fallback"])
+    elif r.get("valid") is False:
+        sink([row], [False], [r.get("op", {}).get("index")],
+             ["host-fallback"])
+
+
+def _columnar_chunk_recorder(sch, cols, sink):
+    """on_chunk hook journaling device chunk verdicts as they retire
+    (check_columnar shape: bad is the caller-level op index, mapped
+    through cols.index). Fused-run failures and quarantined rows are
+    skipped — they journal when their host-derived verdict lands."""
+    def on_chunk(b, lo, hi, v, bad, fr):
+        rows, vals, bads, provs = [], [], [], []
+        for k in range(hi - lo):
+            rp = lo + k
+            i = b.indices[rp]
+            if i in sch.quarantined:
+                continue
+            vk = bool(v[k])
+            bd = None
+            if not vk:
+                ev = int(bad[k])
+                if b.ev_type[rp, ev] == EV_FUSED:
+                    continue
+                line = int(b.ev_opidx[rp, ev])
+                bd = (int(cols.index[i, line])
+                      if cols.index is not None else line)
+            rows.append(i)
+            vals.append(vk)
+            bads.append(bd)
+            provs.append(sch.row_provenance.get(i, "device"))
+        sink(rows, vals, bads, provs)
+    return on_chunk
 
 
 def check_batch_columnar(model: Model, histories: Sequence[List[Op]], *,
                          max_slots: int = 16, max_states: int = 64,
                          host_fallback=None, details=True,
                          min_device_batch: int = 1,
-                         scheduler: bool = True) -> List[dict]:
+                         scheduler: bool = True, faults=None,
+                         journal=None,
+                         scheduler_opts: Optional[dict] = None
+                         ) -> List[dict]:
     """Check recorded Op-list histories through the columnar fast path:
     one fused conversion walk (history.columnar.ops_to_columnar), one
     vectorized encode, one device dispatch per cost bucket. Falls back
@@ -1217,9 +1471,12 @@ def check_batch_columnar(model: Model, histories: Sequence[List[Op]], *,
                                max_slots=max_slots,
                                host_fallback=host_fallback,
                                min_device_batch=min_device_batch,
-                               scheduler=scheduler)
+                               scheduler=scheduler, faults=faults,
+                               journal=journal,
+                               scheduler_opts=scheduler_opts)
     assert details in (True, "invalid"), details   # contract: List[dict]
     return check_columnar(model, cols, max_slots=max_slots, details=details,
                           host_fallback=host_fallback,
                           min_device_batch=min_device_batch,
-                          scheduler=scheduler)
+                          scheduler=scheduler, faults=faults,
+                          journal=journal, scheduler_opts=scheduler_opts)

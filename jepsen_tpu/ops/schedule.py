@@ -58,9 +58,22 @@ all stream through here):
     the full W distribution) or an iterator of bucket *groups* (the
     streaming-encode path, e.g. iter_columnar_groups): classes freeze
     after the first group and later groups ride the same kernel set.
+
+The scheduler also owns the pipeline's own fault model (ops.faults):
+every chunk decodes under a watchdog deadline derived from the VPU op
+model, classified runtime failures walk a degradation ladder — bounded
+retry with exponential backoff, RESOURCE_EXHAUSTED bisection of the
+dispatch row count (the learned safe chunk size sticks per W class,
+then the event-chunked resume kernel), and a binary search that
+quarantines poison rows to the caller's host engine — so a single bad
+chunk degrades instead of aborting a multi-thousand-history check.
+Quarantined rows surface in ``quarantined`` (callers MUST re-decide
+them host-side; the in-band verdict is an inert placeholder) and every
+off-happy-path row is tagged in ``row_provenance``.
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -70,10 +83,15 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .encode import EncodedBatch, merge_batches
-from .linearize import (DATA_MAX_SLOTS, DISPATCH_LOG, KERNEL_SHAPE_LOG,
-                        MAX_FRONTIER_ELEMENTS, MIN_ROWS_PER_DEVICE,
-                        WindowOverflow, get_kernel, log_kernel_shapes,
-                        n_state_words, production_mesh, run_encoded_batch)
+from .faults import (CorruptOutput, FaultInjector, WatchdogExpired,
+                     classify_failure, corrupt_arrays, validate_decoded)
+from .linearize import (DATA_MAX_SLOTS, DISPATCH_LOG, INT32_MAX,
+                        KERNEL_SHAPE_LOG, MAX_FRONTIER_ELEMENTS,
+                        MIN_ROWS_PER_DEVICE, WindowOverflow, get_kernel,
+                        log_kernel_shapes, n_state_words, production_mesh,
+                        run_encoded_batch, run_event_chunked, vpu_op_model)
+
+log = logging.getLogger("jepsen.schedule")
 
 # Small wide buckets the caller asked to divert (min_device_rows) are
 # yielded with this sentinel instead of a device result.
@@ -96,6 +114,56 @@ PIPELINE_DEPTH = 2
 # cache hit on reruns and rechecks.
 EVENT_QUANTUM = 64
 ROW_QUANTUM = 64
+
+# ---- degradation-ladder knobs (ops.faults documents the fault model)
+
+# Retries per failing dispatch beyond the first attempt.
+RETRY_MAX = int(os.environ.get("JT_RETRY_MAX", "3"))
+
+# Exponential backoff base between retries (doubles per attempt).
+RETRY_BACKOFF_S = float(os.environ.get("JT_RETRY_BACKOFF_S", "0.25"))
+
+# Watchdog floor: no chunk deadline below this, however small the
+# chunk — transient host stalls must not masquerade as wedges.
+WATCHDOG_MIN_S = float(os.environ.get("JT_WATCHDOG_MIN_S", "120"))
+
+# Assumed worst-case sustained VPU throughput (lane-ops/s) for the
+# deadline estimate; deliberately pessimistic — the watchdog exists to
+# catch wedges, not to police slow chunks.
+WATCHDOG_LANE_OPS_PER_S = float(
+    os.environ.get("JT_WATCHDOG_LANE_OPS_PER_S", "1e8"))
+
+# Safety multiplier over the op-model estimate.
+WATCHDOG_FACTOR = float(os.environ.get("JT_WATCHDOG_FACTOR", "32"))
+
+# Extra allowance the FIRST wait on a kernel shape gets: a cold
+# dispatch may be paying an XLA compile, not running.
+WATCHDOG_COMPILE_GRACE_S = float(
+    os.environ.get("JT_WATCHDOG_COMPILE_GRACE_S", "900"))
+
+# OOM bisection floor: below this many rows per dispatch, stop halving
+# and switch to the event-chunked resume kernel (run_event_chunked).
+BISECT_FLOOR_ROWS = int(os.environ.get("JT_BISECT_FLOOR_ROWS", "16"))
+
+# Event-axis chunk for the post-floor fallback dispatch.
+EVENT_CHUNK = int(os.environ.get("JT_EVENT_CHUNK", "2048"))
+
+# Pre-warm wait bound (see _resolve): far past any legitimate compile.
+PREWARM_WAIT_S = float(os.environ.get("JT_PREWARM_WAIT_S", "600"))
+
+
+class ChunkAbandoned(WindowOverflow):
+    """A bucket the ladder could not decide on device (wide-route
+    persistent failure): subclassing WindowOverflow reuses the callers'
+    existing route-to-host-engine handling."""
+
+
+class _ChunkFailed(Exception):
+    """Internal: a dispatch range exhausted its retry budget."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
 
 
 def _round_up(x: int, m: int) -> int:
@@ -342,7 +410,10 @@ class BucketScheduler:
                  donate: bool = True,
                  min_device_rows: int = 0,
                  on_chunk=None,
-                 compilation_cache: bool = True):
+                 compilation_cache: bool = True,
+                 faults: Optional[FaultInjector] = None,
+                 max_retries: Optional[int] = None,
+                 backoff_s: Optional[float] = None):
         self.return_frontier = return_frontier
         self.max_classes = (DEFAULT_MAX_CLASSES if max_classes is None
                             else max_classes)
@@ -361,6 +432,28 @@ class BucketScheduler:
         self.on_chunk = on_chunk
         if compilation_cache:
             enable_compilation_cache()
+        # The checker nemesis (ops.faults): explicit injector, else the
+        # ambient $JT_FAULT_PLAN schedule, else no faults.
+        self.faults = faults if faults is not None \
+            else FaultInjector.from_env()
+        self.max_retries = RETRY_MAX if max_retries is None \
+            else max(0, int(max_retries))
+        if backoff_s is None:
+            backoff_s = (self.faults.backoff_s
+                         if self.faults is not None else None)
+        self.backoff_s = RETRY_BACKOFF_S if backoff_s is None \
+            else float(backoff_s)
+        # Degradation-ladder state: caller-level indices of rows the
+        # device could not decide (callers MUST re-decide them through
+        # their host engine — the in-band verdict is an inert
+        # placeholder), provenance tags for every off-happy-path row
+        # ("device-retried" / "host-fallback"; untagged rows are plain
+        # "device"), and the learned safe rows-per-dispatch per
+        # (V, W class) after an OOM bisection.
+        self.quarantined: Dict[int, str] = {}
+        self.row_provenance: Dict[int, str] = {}
+        self._safe_bp: Dict[Tuple[int, int], int] = {}
+        self._awaited_shapes: set = set()
         self.stats: dict = {
             "input_buckets": 0, "classes": [], "chunks": 0,
             "rows": 0, "pad_rows": 0, "compiled_shapes": 0,
@@ -368,6 +461,10 @@ class BucketScheduler:
             "encode_busy_s": 0.0, "dispatch_busy_s": 0.0,
             "device_wait_s": 0.0, "overlap_ratio": None,
             "events": 0, "orig_events": 0, "fusion_ratio": None,
+            "retries": 0, "bisections": 0, "watchdog_fired": 0,
+            "oom_events": 0, "corrupt_chunks": 0, "quarantined_rows": 0,
+            "prewarm_wedged": 0, "abandoned_buckets": 0,
+            "faults_injected": 0,
         }
         self._t0 = None
         self._first_dispatch_t = None
@@ -376,8 +473,13 @@ class BucketScheduler:
     # ------------------------------------------------------------ plumbing
     def _class_chunk(self, V: int, W: int) -> int:
         per_hist = n_state_words(V) << W
-        return max(1, min(self.chunk_rows,
-                          MAX_FRONTIER_ELEMENTS // per_hist))
+        chunk = max(1, min(self.chunk_rows,
+                           MAX_FRONTIER_ELEMENTS // per_hist))
+        # An OOM bisection taught us this class's real memory wall:
+        # plan every later chunk under it instead of re-OOMing at the
+        # full size and paying the ladder once per chunk.
+        cap = self._safe_bp.get((V, W))
+        return min(chunk, cap) if cap else chunk
 
     def _chunk_plan(self, batch: EncodedBatch) -> Tuple[int, List[Tuple]]:
         """(padded_rows_per_dispatch, [(lo, hi), ...])."""
@@ -422,50 +524,326 @@ class BucketScheduler:
             # threat model), and a duplicate compile beats hanging the
             # whole check — the timeout is far past any legitimate
             # compile, so it only fires on a wedged runtime.
-            waiting.wait(timeout=600)
+            done = waiting.wait(timeout=PREWARM_WAIT_S)
             with _AOT_LOCK:
                 compiled = _AOT.get(key)
+            if not done and compiled is None:
+                # A wedged pre-warm is a real runtime fault, not
+                # routine: say so and make it stats-visible before
+                # paying the duplicate compile.
+                log.warning(
+                    "pre-warm compile for kernel shape %s wedged past "
+                    "%.0fs; falling back to a duplicate jit compile",
+                    key, PREWARM_WAIT_S)
+                self.stats["prewarm_wedged"] += 1
         return compiled or get_kernel(batch.V, batch.W,
                                       shared_target=batch.shared_target,
                                       donate=self.donate,
                                       w_live=batch.eff_w_live)
 
-    def _dispatch(self, run: _Run, lo: int, hi: int, Bp: int):
-        batch = run.batch
-        t0 = time.monotonic()
-        Np = _round_up(batch.n_events, EVENT_QUANTUM)
+    def _ship(self, batch: EncodedBatch, lo: int, hi: int, Bp: int,
+              Np: int, tag: str):
+        """The ONE dispatch sequence both the pipelined path and the
+        ladder's synchronous re-dispatches run — fault hooks, pad,
+        kernel launch (async) — so the retried path can never drift
+        from the path it is retrying. Returns (lazy out, decode
+        delay)."""
+        if self.faults is not None:
+            self.faults.fire("encode")
         ev_type, ev_slot, ev_slots, target = self._pad_chunk(
             batch, lo, hi, Bp, Np)
+        delay = 0.0
+        if self.faults is not None:
+            delay = self.faults.sleep_for(self.faults.fire("dispatch"))
         kern = self._resolve(batch, Bp, Np)
         log_kernel_shapes(batch.V, batch.W, "data1", batch.shared_target,
                           self.donate, Bp, Np, batch.eff_w_live)
-        DISPATCH_LOG.append(("data1", batch.V, batch.W, hi - lo))
+        DISPATCH_LOG.append((tag, batch.V, batch.W, hi - lo))
         out = kern(ev_type, ev_slot, ev_slots,
                    np.ascontiguousarray(batch.target[0])
                    if batch.shared_target else target)
+        return out, delay
+
+    def _dispatch(self, run: _Run, lo: int, hi: int, Bp: int):
+        """Pipelined (async) dispatch of one chunk. Failures the fault
+        classifier recognizes are carried to retire time as the ``out``
+        payload instead of raised, so the pipeline keeps streaming and
+        the degradation ladder (_recover) runs when the chunk's turn to
+        decode comes."""
+        batch = run.batch
+        t0 = time.monotonic()
+        Np = _round_up(batch.n_events, EVENT_QUANTUM)
+        try:
+            out, delay = self._ship(batch, lo, hi, Bp, Np, "data1")
+        except Exception as e:
+            if classify_failure(e) is None:
+                raise
+            out, delay = e, 0.0
         if self._first_dispatch_t is None:
             self._first_dispatch_t = time.monotonic()
         self.stats["chunks"] += 1
         self.stats["pad_rows"] += Bp - (hi - lo)
         self.stats["dispatch_busy_s"] += time.monotonic() - t0
-        return (run, lo, hi, out)
+        return (run, lo, hi, out, Bp, delay)
 
-    def _retire(self, item) -> None:
-        run, lo, hi, (valid, bad, front) = item
-        nb = hi - lo
-        t0 = time.monotonic()
-        v = np.asarray(valid)[:nb]
-        b = np.asarray(bad)[:nb]
-        fr = None
+    # ------------------------------------------------ watchdog + ladder
+    def _deadline(self, batch: EncodedBatch, rows: int) -> float:
+        """Per-chunk decode deadline from the VPU op model: estimated
+        lane-ops at a pessimistic sustained rate, a wide safety factor,
+        a hard floor, and a one-time compile grace for shapes this
+        scheduler has not awaited before. An active fault plan
+        overrides it (the nemesis runs on test-scale timings)."""
+        if self.faults is not None and self.faults.deadline_s is not None:
+            return self.faults.deadline_s
+        m = vpu_op_model(batch.V, batch.W, batch.eff_w_live)
+        est = rows * batch.n_events * (
+            m["per_event"] + (m["w_live"] + 1) * m["per_iteration"])
+        d = max(WATCHDOG_MIN_S,
+                est / WATCHDOG_LANE_OPS_PER_S * WATCHDOG_FACTOR)
+        shape = (batch.V, batch.W, batch.eff_w_live, batch.n_events)
+        if shape not in self._awaited_shapes:
+            self._awaited_shapes.add(shape)
+            d += WATCHDOG_COMPILE_GRACE_S
+        return d
+
+    def _await(self, out, nb: int, batch: EncodedBatch,
+               deadline: float, delay: float = 0.0):
+        """Materialize one dispatch's outputs on a daemon thread under
+        the watchdog deadline; decode-stage faults fire on that thread
+        (so the watchdog sees them), decoded verdicts are validated
+        (corrupt output becomes a retryable fault, never a wrong
+        verdict). A blown deadline abandons the worker — daemon, per
+        the DaemonFuture threat model — and raises WatchdogExpired."""
+        import queue
+        q: "queue.Queue" = queue.Queue(1)
+
+        def work():
+            try:
+                if delay:
+                    time.sleep(delay)
+                kind = None
+                if self.faults is not None:
+                    kind = self.faults.fire("decode")
+                    s = self.faults.sleep_for(kind)
+                    if s:
+                        time.sleep(s)
+                valid, bad, front = out
+                v = np.asarray(valid)[:nb]
+                b = np.asarray(bad)[:nb]
+                if kind == "corrupt":
+                    v, b = corrupt_arrays(v, b)
+                validate_decoded(v, b, batch.n_events)
+                fr = None
+                if self.return_frontier is True:
+                    fr = np.asarray(front)[:nb]
+                elif self.return_frontier == "invalid":
+                    fr = {}
+                    rows = np.nonzero(~v)[0]
+                    if rows.size:
+                        sel = np.asarray(front[rows])  # device gather
+                        for i, r in enumerate(rows):
+                            fr[int(r)] = sel[i]
+                q.put(((v, b, fr), None))
+            except BaseException as e:   # noqa: BLE001 — relayed below
+                q.put((None, e))
+
+        threading.Thread(target=work, name="jepsen-retire",
+                         daemon=True).start()
+        try:
+            r, err = q.get(timeout=deadline)
+        except queue.Empty:
+            self.stats["watchdog_fired"] += 1
+            raise WatchdogExpired(
+                f"chunk (V={batch.V}, W={batch.W}, rows={nb}) exceeded "
+                f"its {deadline:.2f}s decode deadline") from None
+        if err is not None:
+            raise err
+        return r
+
+    def _exec_once(self, batch: EncodedBatch, lo: int, hi: int, Bp: int):
+        """One synchronous guarded pass over rows [lo, hi): dispatch in
+        <= Bp-row sub-ranges, each awaited under the watchdog."""
+        Np = _round_up(batch.n_events, EVENT_QUANTUM)
+        pieces = []
+        for s in range(lo, hi, Bp):
+            e = min(s + Bp, hi)
+            out, delay = self._ship(batch, s, e, Bp, Np, "data1retry")
+            pieces.append(
+                (self._await(out, e - s, batch,
+                             self._deadline(batch, Bp), delay), e - s))
+        return _concat_pieces(pieces, self.return_frontier)
+
+    def _exec_retry(self, batch: EncodedBatch, lo: int, hi: int, Bp: int):
+        """Bounded retry with exponential backoff around _exec_once.
+        OOM escapes immediately (it is deterministic under a fixed
+        shape — halving Bp is the cure, not patience); unclassified
+        errors propagate untouched."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self.stats["retries"] += 1
+                time.sleep(self.backoff_s * (2 ** (attempt - 1)))
+            try:
+                return self._exec_once(batch, lo, hi, Bp)
+            except Exception as e:
+                c = classify_failure(e)
+                if c is None or c == "oom":
+                    raise
+                if isinstance(e, CorruptOutput):
+                    self.stats["corrupt_chunks"] += 1
+                last = e
+        raise _ChunkFailed(last)
+
+    def _exec_event_chunked(self, batch: EncodedBatch, lo: int, hi: int):
+        """Post-bisection-floor fallback: the event-chunked resume
+        kernel bounds peak memory by the event axis instead — the last
+        on-device rung before poison-row quarantine."""
+        sub = _slice_rows(batch, lo, hi)
+        v, b, fr = run_event_chunked(sub, EVENT_CHUNK,
+                                     return_frontier=bool(
+                                         self.return_frontier))
+        validate_decoded(v, b, batch.n_events)
+        if self.return_frontier == "invalid":
+            fr = {int(r): fr[r] for r in np.nonzero(~v)[0]}
+        elif not self.return_frontier:
+            fr = None
+        return v, b, fr
+
+    def _placeholder(self, batch: EncodedBatch, n: int):
+        """Inert verdicts for quarantined rows — shaped like a clean
+        chunk so downstream concatenation works, and overwritten by the
+        caller's host engine (the quarantine contract)."""
+        v = np.ones(n, bool)
+        b = np.full(n, INT32_MAX, np.int32)
         if self.return_frontier is True:
-            fr = np.asarray(front)[:nb]
+            fr = np.zeros((n, n_state_words(batch.V), 1 << batch.W),
+                          np.uint32)
         elif self.return_frontier == "invalid":
             fr = {}
-            rows = np.nonzero(~v)[0]
-            if rows.size:
-                sel = np.asarray(front[rows])      # device-side gather
-                for i, r in enumerate(rows):
-                    fr[int(r)] = sel[i]
+        else:
+            fr = None
+        return v, b, fr
+
+    def _quarantine(self, batch: EncodedBatch, row: int,
+                    cause: BaseException):
+        i = batch.indices[row]
+        reason = f"{type(cause).__name__}: {cause}"
+        self.quarantined[i] = reason
+        self.row_provenance[i] = "host-fallback"
+        self.stats["quarantined_rows"] += 1
+        log.warning("quarantining history %s after exhausting the "
+                    "device ladder (%s); the host engine decides it", i,
+                    reason)
+        return self._placeholder(batch, 1)
+
+    def _hunt_poison(self, batch: EncodedBatch, lo: int, hi: int,
+                     Bp: int):
+        """Binary-search a persistently failing range down to the
+        poison row(s). Each level gets ONE attempt (the range already
+        exhausted its retries); rows still failing alone are
+        quarantined for the caller's host engine."""
+        if hi - lo == 1:
+            try:
+                return self._exec_once(batch, lo, hi, min(Bp, ROW_QUANTUM))
+            except Exception as e:
+                if classify_failure(e) is None:
+                    raise
+                return self._quarantine(batch, lo, e)
+        mid = (lo + hi) // 2
+        pieces = []
+        for a, c in ((lo, mid), (mid, hi)):
+            try:
+                piece = self._exec_once(batch, a, c, Bp)
+            except Exception as e:
+                if classify_failure(e) is None:
+                    raise
+                piece = self._hunt_poison(batch, a, c, Bp)
+            pieces.append((piece, c - a))
+        return _concat_pieces(pieces, self.return_frontier)
+
+    def _exec_range(self, batch: EncodedBatch, lo: int, hi: int,
+                    Bp: int, first_cause: Optional[BaseException] = None):
+        """The degradation ladder for rows [lo, hi): retry → OOM
+        Bp-bisection (the learned safe size sticks for the rest of the
+        run) → event-chunked dispatch → poison-row hunt. Always returns
+        a full (valid, bad, frontier) for the range; rows it could not
+        decide are quarantined placeholders."""
+        cls = (batch.V, batch.W)
+        cap = self._safe_bp.get(cls)
+        if cap:
+            Bp = min(Bp, cap)
+        oom = first_cause is not None and \
+            classify_failure(first_cause) == "oom"
+        while True:
+            if not oom:
+                try:
+                    return self._exec_retry(batch, lo, hi, Bp)
+                except _ChunkFailed:
+                    return self._hunt_poison(batch, lo, hi, Bp)
+                except Exception as e:
+                    if classify_failure(e) != "oom":
+                        raise
+                    self.stats["oom_events"] += 1
+                    oom = True
+                    continue
+            if Bp > BISECT_FLOOR_ROWS:
+                # RESOURCE_EXHAUSTED: halve the rows per dispatch and
+                # remember the safe size for this W class — later
+                # chunks of the run start from it instead of
+                # rediscovering the wall.
+                Bp = max(BISECT_FLOOR_ROWS, Bp // 2)
+                self.stats["bisections"] += 1
+                self._safe_bp[cls] = Bp
+                log.warning("OOM on chunk (V=%s, W=%s): bisecting to "
+                            "%s rows/dispatch", batch.V, batch.W, Bp)
+                oom = False
+                continue
+            try:
+                return self._exec_event_chunked(batch, lo, hi)
+            except Exception as e:
+                if classify_failure(e) is None:
+                    raise
+                return self._hunt_poison(batch, lo, hi, Bp)
+
+    def _recover(self, batch: EncodedBatch, lo: int, hi: int, Bp: int,
+                 cause: BaseException):
+        """Entry to the ladder from a failed pipelined chunk; tags the
+        surviving rows device-retried (quarantined rows were already
+        tagged host-fallback)."""
+        c = classify_failure(cause)
+        if c == "oom":
+            self.stats["oom_events"] += 1
+        if isinstance(cause, CorruptOutput):
+            self.stats["corrupt_chunks"] += 1
+        log.warning("chunk (V=%s, W=%s, rows %s:%s) failed in the "
+                    "pipeline (%s: %s); entering the degradation "
+                    "ladder", batch.V, batch.W, lo, hi,
+                    type(cause).__name__, cause)
+        # The ladder's first synchronous pass re-dispatches work the
+        # pipeline already shipped once: that IS a retry, whatever
+        # happens after.
+        self.stats["retries"] += 1
+        out = self._exec_range(batch, lo, hi, Bp, first_cause=cause)
+        for r in range(lo, hi):
+            self.row_provenance.setdefault(batch.indices[r],
+                                           "device-retried")
+        return out
+
+    def _retire(self, item) -> None:
+        run, lo, hi, out, Bp, delay = item
+        nb = hi - lo
+        t0 = time.monotonic()
+        if isinstance(out, BaseException):
+            v, b, fr = self._recover(run.batch, lo, hi, Bp, out)
+        else:
+            try:
+                v, b, fr = self._await(out, nb, run.batch,
+                                       self._deadline(run.batch, nb),
+                                       delay)
+            except Exception as e:
+                if classify_failure(e) is None:
+                    raise
+                v, b, fr = self._recover(run.batch, lo, hi, Bp, e)
         wait = time.monotonic() - t0
         self.stats["device_wait_s"] += wait
         self._last_retire_t = time.monotonic()
@@ -475,6 +853,39 @@ class BucketScheduler:
         if self.on_chunk is not None:
             self.on_chunk(run.batch, lo, hi, v, b, fr)
         run.collect(v, b, fr)
+
+    def _run_wide(self, mb: EncodedBatch):
+        """Blocking wide/frontier/sharded dispatch with bounded retry.
+        Persistent failure returns ChunkAbandoned — a WindowOverflow
+        subclass, so callers' existing host-engine routing re-decides
+        every row (tagged host-fallback)."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self.stats["retries"] += 1
+                time.sleep(self.backoff_s * (2 ** (attempt - 1)))
+            try:
+                out = run_encoded_batch(mb, self.return_frontier)
+                if attempt:
+                    for i in mb.indices:
+                        self.row_provenance.setdefault(i, "device-retried")
+                return out
+            except WindowOverflow as e:
+                return e
+            except Exception as e:
+                if classify_failure(e) is None:
+                    raise
+                last = e
+        self.stats["abandoned_buckets"] += 1
+        for i in mb.indices:
+            self.row_provenance[i] = "host-fallback"
+        log.warning("wide bucket (V=%s, W=%s, %s rows) abandoned after "
+                    "%s attempts (%s); routing its rows to the host "
+                    "engine", mb.V, mb.W, mb.batch, self.max_retries + 1,
+                    last)
+        return ChunkAbandoned(
+            f"device failure persisted across {self.max_retries + 1} "
+            f"attempts: {last}")
 
     # ---------------------------------------------------------- class plan
     def _freeze_classes(self, group: Sequence[EncodedBatch]) -> Dict:
@@ -560,10 +971,13 @@ class BucketScheduler:
                         mesh.shape["data"] * MIN_ROWS_PER_DEVICE):
                 # Wide/frontier/sharded routes keep their own dispatch
                 # logic (run_encoded_batch): drain the pipeline so
-                # yields stay in dispatch order, then run blocking.
+                # yields stay in dispatch order, then run blocking
+                # (with the same bounded-retry discipline — a
+                # persistently failing wide bucket is abandoned to the
+                # caller's host engine, never an aborted check).
                 yield from drain()
-                try:
-                    out = run_encoded_batch(mb, self.return_frontier)
+                out = self._run_wide(mb)
+                if not isinstance(out, WindowOverflow):
                     self._last_retire_t = time.monotonic()
                     if self.stats["t_first_verdict_s"] is None:
                         self.stats["t_first_verdict_s"] = round(
@@ -571,8 +985,6 @@ class BucketScheduler:
                     if self.on_chunk is not None:
                         v, b, fr = out
                         self.on_chunk(mb, 0, mb.batch, v, b, fr)
-                except WindowOverflow as e:
-                    out = e
                 yield mb, out
                 return
             Bp, chunks = self._chunk_plan(mb)
@@ -631,6 +1043,8 @@ class BucketScheduler:
         wall = time.monotonic() - self._t0
         self.stats["wall_s"] = round(wall, 4)
         self.stats["compiled_shapes"] = len(KERNEL_SHAPE_LOG) - shapes0
+        if self.faults is not None:
+            self.stats["faults_injected"] = len(self.faults.log)
         if self.stats["events"]:
             # Scan steps saved by event fusion: original (unfused)
             # events per dispatched scan step, >= 1.0.
@@ -652,6 +1066,29 @@ class BucketScheduler:
                 # pad/decode work. 1.0 = fully pipelined, 0.0 = serial.
                 self.stats["overlap_ratio"] = round(
                     max(0.0, 1.0 - self.stats["device_wait_s"] / span), 4)
+
+
+def _concat_pieces(pieces, return_frontier):
+    """Stitch sub-range (valid, bad, frontier) pieces — each paired
+    with its row count — back into one range result, preserving the
+    frontier mode's shape ("invalid" dicts re-key by range offset)."""
+    vs = [p[0] for p, _ in pieces]
+    bs = [p[1] for p, _ in pieces]
+    valid = np.concatenate(vs) if len(vs) > 1 else vs[0]
+    bad = np.concatenate(bs) if len(bs) > 1 else bs[0]
+    if return_frontier is True:
+        frs = [p[2] for p, _ in pieces]
+        fr = np.concatenate(frs) if len(frs) > 1 else frs[0]
+    elif return_frontier == "invalid":
+        fr = {}
+        off = 0
+        for (_, _, fm), n in pieces:
+            for r, row in fm.items():
+                fr[off + int(r)] = row
+            off += n
+    else:
+        fr = None
+    return valid, bad, fr
 
 
 def _slice_rows(b: EncodedBatch, lo: int, hi: int) -> EncodedBatch:

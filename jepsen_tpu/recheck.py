@@ -100,7 +100,8 @@ def _resolve_constant(name: str, explicit, stored, default):
 def recheck_family(store: Store, test_name: str, family: str, *,
                    independent: Optional[bool] = None,
                    accounts: Optional[int] = None,
-                   balance: Optional[int] = None) -> dict:
+                   balance: Optional[int] = None,
+                   resume: bool = False) -> dict:
     """Re-analyze every stored run of ``test_name`` under ``family``.
 
     Returns the Store.recheck shape: {"valid", "runs": {ts: {"valid",
@@ -113,6 +114,13 @@ def recheck_family(store: Store, test_name: str, family: str, *,
     newest stored run's ``invariants`` (stored_invariants) — pass them
     only to OVERRIDE what the run recorded, which logs a warning on
     mismatch.
+
+    ``resume=True`` continues an interrupted linearizable recheck from
+    its durable chunk journal (store/<test>/recheck.journal.jsonl):
+    rows with journaled verdicts are never re-dispatched
+    (doc/resilience.md). Applies to the columnar device path — the
+    fold/bank families re-derive from scratch (they are one cheap
+    dispatch).
     """
     from .store import group_unit_results
 
@@ -122,7 +130,7 @@ def recheck_family(store: Store, test_name: str, family: str, *,
         "independent", independent, inv.get("independent"), False))
     if spec["kind"] == "linear":
         return store.recheck(test_name, spec["model"](),
-                             independent=independent)
+                             independent=independent, resume=resume)
 
     ts = store.tests().get(test_name, [])
     units, labels = store.strain_units(test_name, ts,

@@ -37,6 +37,9 @@ BASE = Path("store")
 # Machine-form sidecar magic (history.cols.bin, Store._save_machine_form).
 MACHINE_MAGIC = b"JTCOLS1\n"
 
+# Chunk-journal header magic (ChunkJournal).
+JOURNAL_MAGIC = "JTJRNL1"
+
 # Test-map keys that are live objects, never serialized
 # (store.clj:155-163 default-nonserializable-keys).
 NONSERIALIZABLE_KEYS = {
@@ -244,7 +247,8 @@ class Store:
 
     def recheck(self, test_name: str, model,
                 timestamps: Optional[Sequence[str]] = None, *,
-                independent: bool = False) -> dict:
+                independent: bool = False, resume: bool = False,
+                faults=None) -> dict:
         """Re-analyze every stored history of a test on device in one
         batched dispatch — the replay seam (store.clj:165-171) riding
         the columnar fast path (ops.linearize.check_batch_columnar).
@@ -253,6 +257,14 @@ class Store:
         subhistories first (KV-valued workloads) and pools ALL
         (run, key) units into the one batch. Returns
         {"valid", "runs": {ts: {"valid", "results"}}}.
+
+        The columnar path journals retired chunk verdicts to
+        ``store/<test>/recheck.journal.jsonl`` as it streams;
+        ``resume=True`` reloads a prior interrupted run's journal and
+        dispatches only the remaining rows (zero completed chunks
+        re-dispatched — doc/resilience.md). The journal is deleted on
+        successful completion. ``faults`` threads a checker-nemesis
+        injector (ops.faults) into the pipeline — the testing seam.
         """
         from .ops.linearize import check_batch_columnar, check_columnar
         from .ops.statespace import StateSpaceExplosion
@@ -281,11 +293,20 @@ class Store:
                     return {"valid": "unknown", "runs": {},
                             "error":
                             f"no stored histories for {test_name!r}"}
+            journal = None
             try:
                 if machine is not None:
                     cols, labels = machine
                 else:
                     cols = jsonl_to_columnar(model, texts)
+                # Chunk journal: retired verdicts land durably as the
+                # stream runs, keyed to this exact batch, so a crashed
+                # or killed recheck resumes from completed chunks.
+                journal = ChunkJournal(
+                    self.base / test_name / "recheck.journal.jsonl",
+                    {"model": repr(model), "rows": cols.batch,
+                     "digest": columnar_digest(cols)},
+                    resume=resume)
                 # Lazy details: only invalid rows pay the Python replay
                 # decode and the frontier transfer — valid rows stay at
                 # tensor speed, matching the reference's
@@ -293,16 +314,35 @@ class Store:
                 # Tiny tall-W buckets ride the native engine instead of
                 # paying a latency-bound device round trip each.
                 rs = check_columnar(model, cols, details="invalid",
-                                    min_device_batch=64)
+                                    min_device_batch=64,
+                                    journal=journal, faults=faults)
+                resume_hits = journal.resume_hits
+                journal.finish()
+                out = group_unit_results(labels, rs)
+                if resume:
+                    out["resume_hits"] = resume_hits
+                return out
             except StateSpaceExplosion:
                 # Vocabulary too rich for the packed table: degrade to
                 # the Op-list path, whose batch checker falls back to
                 # per-history engines (linearize.py's explosion route).
+                # The journal is keyed to the exploded columnar form —
+                # useless now, so drop it rather than confuse a later
+                # resume.
+                if journal is not None:
+                    journal.finish()
                 units = [loaded["history"] for t in ts
                          if "history" in
                          (loaded := self.load(test_name, t))]
                 rs = check_batch_columnar(model, units,
                                           details="invalid")
+            except BaseException:
+                # Interrupted/failed mid-stream: keep the journal ON
+                # DISK (that is its whole purpose) but release the
+                # handle.
+                if journal is not None:
+                    journal.close()
+                raise
         else:
             units, labels = self.strain_units(test_name, ts,
                                               independent=True)
@@ -432,6 +472,147 @@ class Store:
             (self.base / test_name)
         if target.exists():
             shutil.rmtree(target)
+
+
+class ChunkJournal:
+    """Durable chunk-verdict journal — the checker's write-ahead log.
+
+    The streaming checkers (check_batch_tpu / check_columnar /
+    Store.recheck) append one JSON line per retired chunk as verdicts
+    land: ``{"rows": [...], "valid": [...], "bad": [...], "prov":
+    [...]}`` with ``rows`` caller-level history indices, ``bad`` the
+    final bad-op index (null for valid rows) and ``prov`` the
+    provenance tag per row. Line 1 is a header binding the journal to
+    one exact batch: ``{"journal": "JTJRNL1", "key": {...}}`` — the key
+    carries the model fingerprint, row count, and a content digest, so
+    a stale journal (different store state, different model) is
+    discarded rather than trusted.
+
+    Every record is flushed and fsynced: an interrupted process leaves
+    every retired chunk on disk (a torn final line is tolerated and
+    dropped on load). ``resume=True`` reloads decided rows so the next
+    run dispatches only the remainder; ``record`` REFUSES a row decided
+    twice — the journal is also the enforcement point for the
+    no-chunk-redispatched invariant. ``finish()`` deletes the file: a
+    journal only outlives an interrupted run.
+    """
+
+    def __init__(self, path, key: dict, resume: bool = False):
+        self.path = Path(path)
+        self.key = dict(key)
+        self.resume_hits = 0
+        self._decided: Dict[int, tuple] = {}
+        self._good_end = 0     # byte offset past the last clean line
+        if resume and self.path.exists():
+            self._load()
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        if self._decided:
+            # Drop the torn tail BEFORE appending: writing after a
+            # partial line would weld two records into one unparseable
+            # line, and a later resume would silently discard
+            # everything journaled past it.
+            with open(self.path, "r+b") as f:
+                f.truncate(self._good_end)
+            self._f = open(self.path, "a")
+        else:
+            self._f = open(self.path, "w")
+            self._f.write(json.dumps(
+                {"journal": JOURNAL_MAGIC, "key": self.key}) + "\n")
+            self._flush()
+
+    def _load(self) -> None:
+        try:
+            data = self.path.read_bytes()
+            pos = 0
+            header_seen = False
+            while pos < len(data):
+                nl = data.find(b"\n", pos)
+                if nl < 0:
+                    break          # torn tail from the interruption
+                try:
+                    e = json.loads(data[pos:nl])
+                    if not header_seen:
+                        if e.get("journal") != JOURNAL_MAGIC or \
+                                e.get("key") != self.key:
+                            logging.getLogger("jepsen.store").warning(
+                                "chunk journal %s belongs to a "
+                                "different batch (key mismatch); "
+                                "starting fresh", self.path)
+                            return
+                        header_seen = True
+                    else:
+                        for r, v, b, p in zip(e["rows"], e["valid"],
+                                              e["bad"], e["prov"]):
+                            self._decided[int(r)] = (
+                                bool(v), None if b is None else int(b),
+                                p)
+                except Exception:
+                    break          # malformed line: keep the prefix
+                pos = nl + 1
+                self._good_end = pos
+        except Exception:
+            self._decided = {}
+            self._good_end = 0
+
+    def _flush(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def decided(self) -> Dict[int, tuple]:
+        """{row: (valid, bad-op-index-or-None, provenance)} recovered
+        from a previous interrupted run."""
+        self.resume_hits = len(self._decided)
+        return dict(self._decided)
+
+    def record(self, rows, valid, bad, prov) -> None:
+        rows = [int(r) for r in rows]
+        if not rows:
+            return
+        dup = [r for r in rows if r in self._decided]
+        if dup:
+            raise ValueError(
+                f"chunk journal: rows decided twice (double dispatch): "
+                f"{dup[:5]}")
+        valid = [bool(v) for v in valid]
+        bad = [None if b is None else int(b) for b in bad]
+        prov = [str(p) for p in prov]
+        for r, v, b, p in zip(rows, valid, bad, prov):
+            self._decided[r] = (v, b, p)
+        self._f.write(json.dumps(
+            {"rows": rows, "valid": valid, "bad": bad, "prov": prov})
+            + "\n")
+        self._flush()
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+    def finish(self) -> None:
+        """The run completed: the journal has served its purpose."""
+        self.close()
+        try:
+            self.path.unlink()
+        except FileNotFoundError:
+            pass
+
+
+def columnar_digest(cols) -> str:
+    """Content fingerprint of a ColumnarOps batch — the chunk-journal
+    key component that pins a journal to one exact row set/order."""
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+    for arr in (cols.type, cols.process, cols.kind):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    if cols.index is not None:
+        h.update(np.ascontiguousarray(cols.index).tobytes())
+    h.update(json.dumps(list(map(list, cols.kinds)), default=str)
+             .encode())
+    return h.hexdigest()[:16]
 
 
 def _kinds_from_json(text: str) -> list:

@@ -21,3 +21,6 @@ def pytest_configure(config):
         "markers", "slow: excluded from the tier-1 CPU gate")
     config.addinivalue_line(
         "markers", "fast: cheap contract checks (host-purity etc.)")
+    config.addinivalue_line(
+        "markers", "faults: checker-nemesis fault schedules (fast, "
+                   "deterministic; runs in tier-1)")

@@ -140,3 +140,91 @@ def test_grepkill_pipeline():
     [cmd] = s.transport.commands
     assert "ps aux | grep etcd | grep -v grep" in cmd
     assert "xargs -r kill -9" in cmd
+
+
+# ------------------------------------- transient retry (single knob)
+
+def test_backoff_delay_grows_and_caps():
+    ds = [c.backoff_delay(a, base=1.0, cap=8.0) for a in range(6)]
+    # Exponential up to the cap (jitter adds at most base/2 on top).
+    for a, d in enumerate(ds):
+        assert min(8.0, 2 ** a) <= d <= min(8.0, 2 ** a) + 0.5
+
+
+def test_ssh_run_retries_oserror_as_transient(monkeypatch):
+    """A transport-level OSError (ssh subprocess died / failed to
+    connect) is normalized to exit 255 and retried under the same
+    budget as any dropped connection."""
+    monkeypatch.setattr(c.time, "sleep", lambda s: None)
+    calls = []
+
+    class FlakyTransport(c.DummyTransport):
+        def run(self, cmd, stdin):
+            calls.append(cmd)
+            if len(calls) < 3:
+                raise OSError("connection refused")
+            return "pong\n", "", 0
+
+    s = c.Session(host="n1", transport=FlakyTransport("n1"), retries=3)
+    with with_session("n1", s):
+        assert exec_("ping") == "pong"
+    assert len(calls) == 3
+
+    # Budget exhausted: the normalized 255 surfaces as RemoteError.
+    calls.clear()
+
+    class DeadTransport(c.DummyTransport):
+        def run(self, cmd, stdin):
+            raise OSError("no route to host")
+
+    s = c.Session(host="n1", transport=DeadTransport("n1"), retries=2)
+    with with_session("n1", s):
+        with pytest.raises(RemoteError, match="transport error"):
+            exec_("ping")
+
+
+def test_default_retry_knob():
+    """One knob: sessions default their retry budget to SSH_RETRIES
+    ($JT_SSH_RETRIES, default 3)."""
+    assert c.DEFAULT_SSH["retries"] == c.SSH_RETRIES
+    s = dummy_session()
+    assert s.retries == c.SSH_RETRIES
+
+
+def test_with_retry_retries_only_transient(monkeypatch):
+    from jepsen_tpu.control import util as cu
+    monkeypatch.setattr("time.sleep", lambda s: None)
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RemoteError("cmd", "n1", 255, "", "reset")
+        return "ok"
+
+    assert cu.with_retry(flaky) == "ok"
+    assert len(calls) == 3
+
+    # Non-transient remote failures (the command itself failed)
+    # propagate immediately — blind re-runs aren't idempotent-safe.
+    calls.clear()
+
+    def broken():
+        calls.append(1)
+        raise RemoteError("cmd", "n1", 1, "", "syntax error")
+
+    with pytest.raises(RemoteError):
+        cu.with_retry(broken)
+    assert len(calls) == 1
+
+    # Budget exhausted -> the transient error surfaces.
+    calls.clear()
+
+    def dead():
+        calls.append(1)
+        raise RemoteError("cmd", "n1", 124, "", "timed out")
+
+    with pytest.raises(RemoteError):
+        cu.with_retry(dead, attempts=2)
+    assert len(calls) == 3

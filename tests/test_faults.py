@@ -1,0 +1,394 @@
+"""The checker nemesis turned on the checker (ops.faults + the
+degradation ladder in ops.schedule).
+
+The framework's own premise, applied to itself: under every injected
+single-fault schedule — OOM at each pipeline stage, a deadline-tripping
+timeout, a wedged dispatch, corrupt device output — the pipeline must
+still produce a verdict for 100% of histories, field-for-field
+identical to the fault-free run, with provenance recording which engine
+(and how hard the ladder had to work) decided each row. Also here: the
+durable chunk journal's kill-and-resume contract (zero completed chunks
+re-dispatched), the OOM bisection's learned safe chunk size, poison-row
+quarantine under sticky corruption, and the pre-warm wedge counter.
+
+All schedules are deterministic (seeded by stage ordinal) and run on
+test-scale timings — this suite is tier-1.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.linearizable import prepare_history
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.ops import schedule as sched_mod
+from jepsen_tpu.ops.encode import bucket_encode
+from jepsen_tpu.ops.faults import (FaultInjector, FaultPlan, InjectedKill,
+                                   classify_failure, corrupt_arrays,
+                                   validate_decoded, CorruptOutput,
+                                   single_fault_schedules)
+from jepsen_tpu.ops.linearize import (DISPATCH_LOG, INT32_MAX,
+                                      check_batch_tpu, check_columnar,
+                                      run_buckets_threaded)
+from jepsen_tpu.ops.schedule import BucketScheduler
+from jepsen_tpu.store import ChunkJournal, Store
+from jepsen_tpu.workloads.synth import synth_cas_columnar, synth_cas_history
+
+pytestmark = pytest.mark.faults
+
+MODEL = cas_register()
+
+PROVENANCE_TAGS = {"device", "device-retried", "host-fallback"}
+
+
+def mixed_histories(n=60, seed0=900):
+    return [synth_cas_history(seed0 + i, n_procs=2 + i % 6, n_ops=18,
+                              corrupt=0.4 if i % 3 == 0 else 0.0,
+                              p_info=0.25 if i % 4 == 0 else 0.0)
+            for i in range(n)]
+
+
+def scatter(stream):
+    """{caller index: (valid, bad)} from a (batch, out) stream."""
+    got = {}
+    for b, out in stream:
+        v, bad = np.asarray(out[0]), np.asarray(out[1])
+        for r, i in enumerate(b.indices):
+            got[i] = (bool(v[r]), int(bad[r]) if not v[r] else None)
+    return got
+
+
+# ------------------------------------------------ unit: classification
+
+def test_classify_failure_routes():
+    class XlaRuntimeError(RuntimeError):
+        pass
+
+    assert classify_failure(XlaRuntimeError(
+        "RESOURCE_EXHAUSTED: out of memory")) == "oom"
+    assert classify_failure(XlaRuntimeError("INTERNAL: rpc")) == \
+        "transient"
+    assert classify_failure(CorruptOutput("x")) == "transient"
+    assert classify_failure(InjectedKill("x")) is None
+    assert classify_failure(TypeError("bug")) is None
+
+
+def test_validate_decoded_catches_garbage():
+    v = np.array([True, False])
+    b = np.array([INT32_MAX, 3], np.int32)
+    validate_decoded(v, b, 10)                     # clean passes
+    cv, cb = corrupt_arrays(v, b)
+    with pytest.raises(CorruptOutput):
+        validate_decoded(cv, cb, 10)
+    with pytest.raises(CorruptOutput):             # valid w/o sentinel
+        validate_decoded(np.array([True]), np.array([5], np.int32), 10)
+    with pytest.raises(CorruptOutput):             # bad index out of axis
+        validate_decoded(np.array([False]), np.array([10], np.int32), 10)
+
+
+def test_fault_plan_parse_env_syntax():
+    plan = FaultPlan.parse("dispatch:oom:2, decode:corrupt:*")
+    assert plan.match("dispatch", 2).kind == "oom"
+    assert plan.match("dispatch", 1) is None
+    assert plan.match("decode", 7).kind == "corrupt"   # sticky
+    assert {s for s, _ in single_fault_schedules()} >= \
+        {"oom@encode", "oom@dispatch", "oom@decode", "timeout@dispatch",
+         "wedge@dispatch", "corrupt@decode"}
+
+
+# ------------------------------- satellite: oracle-fuzz under faults
+
+@pytest.fixture(scope="module")
+def fuzz_corpus():
+    from test_oracle_fuzz import corpus
+    return corpus(per_family=40, n_ops=5, seed0=51_000)
+
+
+@pytest.fixture(scope="module")
+def fuzz_baseline(fuzz_corpus):
+    """Fault-free streamed verdicts per family (also warms every kernel
+    shape, so fault runs never trip the watchdog on a compile)."""
+    return {family: check_batch_tpu(model, hists, max_states=24)
+            for family, (model, hists) in sorted(fuzz_corpus.items())}
+
+
+def test_fuzz_corpus_under_every_single_fault_schedule(fuzz_corpus,
+                                                       fuzz_baseline):
+    """The acceptance gate: under every single-fault schedule the
+    pipeline returns a verdict for 100% of histories, field-for-field
+    identical to the fault-free run, each tagged with a legal
+    provenance; the recovery provenance (device-retried/host-fallback)
+    actually appears where the schedule engaged."""
+    for name, plan in single_fault_schedules():
+        inj = FaultInjector(plan)
+        recovered = 0
+        for family, (model, hists) in sorted(fuzz_corpus.items()):
+            got = check_batch_tpu(model, hists, max_states=24,
+                                  faults=inj)
+            want = fuzz_baseline[family]
+            for i, (g, w) in enumerate(zip(got, want, strict=True)):
+                assert g["valid"] == w["valid"], (name, family, i)
+                if g["valid"] is False:
+                    assert g["op"]["index"] == w["op"]["index"], \
+                        (name, family, i)
+                assert g.get("configs") == w.get("configs"), \
+                    (name, family, i)
+                assert g["provenance"] in PROVENANCE_TAGS, \
+                    (name, family, i, g["provenance"])
+                if g["provenance"] != "device":
+                    recovered += 1
+        assert inj.log, f"schedule {name} never engaged"
+        assert recovered >= 1, \
+            f"schedule {name} engaged but no row records a recovery"
+
+
+# ------------------------------------- ladder mechanics (scheduler)
+
+@pytest.fixture(scope="module")
+def mixed_buckets():
+    """ONE encoded corpus for every ladder test (scheduler runs never
+    mutate their input batches), so the exact-path oracle kernels and
+    the chunk shapes compile once for the module."""
+    prepared = [prepare_history(h) for h in mixed_histories()]
+    buckets = bucket_encode(MODEL, prepared)
+    assert len({(b.V, b.W) for b in buckets}) >= 3
+    return buckets
+
+
+@pytest.fixture(scope="module")
+def exact_verdicts(mixed_buckets):
+    return scatter(run_buckets_threaded(mixed_buckets))
+
+
+def test_wedge_trips_watchdog_then_recovers(mixed_buckets,
+                                            exact_verdicts):
+    # deadline 2s < the 3.5s wedge sleep, but roomy enough that a cold
+    # kernel compile on a loaded machine doesn't read as a wedge too.
+    inj = FaultInjector(FaultPlan.single("dispatch", "wedge",
+                                         deadline_s=2.0,
+                                         sleep_wedge_s=3.5))
+    sch = BucketScheduler(chunk_rows=32, faults=inj)
+    got = scatter(sch.run(mixed_buckets))
+    assert got == exact_verdicts
+    assert sch.stats["watchdog_fired"] >= 1
+    assert sch.stats["retries"] >= 1
+    assert sch.stats["faults_injected"] == len(inj.log) >= 1
+    assert "device-retried" in sch.row_provenance.values()
+    assert not sch.quarantined
+
+
+def test_oom_bisects_and_learns_safe_chunk(mixed_buckets,
+                                           exact_verdicts):
+    """Sticky RESOURCE_EXHAUSTED on every dispatch: Bp halves to the
+    floor, the learned safe size sticks per W class, and the
+    event-chunked resume kernel finishes the job — verdicts intact."""
+    inj = FaultInjector(FaultPlan.sticky("dispatch", "oom"))
+    sch = BucketScheduler(chunk_rows=32, faults=inj)
+    got = scatter(sch.run(mixed_buckets))
+    assert got == exact_verdicts
+    assert sch.stats["oom_events"] >= 1
+    assert sch.stats["bisections"] >= 1
+    assert sch._safe_bp, "the safe chunk size must be remembered"
+    assert all(bp <= sched_mod.BISECT_FLOOR_ROWS
+               for bp in sch._safe_bp.values())
+    # The learned wall feeds back into the PLAN: later chunks of the
+    # run dispatch under it instead of re-OOMing at full size.
+    for (V, W), bp in sch._safe_bp.items():
+        assert sch._class_chunk(V, W) <= bp
+    assert not sch.quarantined, \
+        "event-chunked fallback should decide OOM rows on device"
+
+
+def test_sticky_corruption_quarantines_poison_rows(mixed_buckets,
+                                                   exact_verdicts):
+    """Corrupt output on EVERY decode: retries fail, bisection fails,
+    the poison hunt quarantines every row — and the caller-side host
+    oracle still yields field-identical verdicts (proved at the
+    check_batch_tpu level below)."""
+    inj = FaultInjector(FaultPlan.sticky("decode", "corrupt"))
+    sch = BucketScheduler(chunk_rows=32, max_retries=1, faults=inj)
+    got = scatter(sch.run(mixed_buckets))
+    n_rows = len(exact_verdicts)
+    assert len(sch.quarantined) == n_rows
+    assert sch.stats["quarantined_rows"] == n_rows
+    assert sch.stats["corrupt_chunks"] >= 1
+    assert set(sch.row_provenance.values()) == {"host-fallback"}
+    # In-band verdicts are inert placeholders; the caller must
+    # re-decide quarantined rows (checked end-to-end below).
+    assert all(got[i] == (True, None) for i in sch.quarantined)
+
+
+def test_sticky_corruption_end_to_end_host_parity():
+    hists = mixed_histories(n=16, seed0=1500)
+    want = check_batch_tpu(MODEL, hists)
+    inj = FaultInjector(FaultPlan.sticky("decode", "corrupt"))
+    got = check_batch_tpu(MODEL, hists, faults=inj,
+                          scheduler_opts={"chunk_rows": 32,
+                                          "max_retries": 1})
+    for i, (g, w) in enumerate(zip(got, want, strict=True)):
+        assert g["valid"] == w["valid"], i
+        if g["valid"] is False:
+            assert g["op"]["index"] == w["op"]["index"], i
+    assert any(g["provenance"] == "host-fallback" for g in got)
+
+
+def test_prewarm_wedge_is_logged_and_counted(monkeypatch, caplog):
+    """_resolve's bounded pre-warm wait: expiry is no longer silent —
+    it warns and bumps the prewarm_wedged counter before paying the
+    duplicate compile."""
+    b = bucket_encode(MODEL, [prepare_history(mixed_histories(n=1)[0])])[0]
+    sch = BucketScheduler(prewarm=False)
+    Bp, _ = sch._chunk_plan(b)
+    Np = sched_mod._round_up(b.n_events, sched_mod.EVENT_QUANTUM)
+    key = sched_mod._aot_key(b.V, b.W, b.eff_w_live, b.shared_target,
+                             sch.donate, Bp, Np, b.ev_slots.dtype,
+                             b.target.shape[1])
+    monkeypatch.setattr(sched_mod, "PREWARM_WAIT_S", 0.01)
+    with sched_mod._AOT_LOCK:
+        sched_mod._AOT_INFLIGHT[key] = threading.Event()  # never set
+    try:
+        with caplog.at_level("WARNING", logger="jepsen.schedule"):
+            kern = sch._resolve(b, Bp, Np)
+    finally:
+        with sched_mod._AOT_LOCK:
+            sched_mod._AOT_INFLIGHT.pop(key, None)
+    assert kern is not None, "must fall back to a duplicate compile"
+    assert sch.stats["prewarm_wedged"] == 1
+    assert any("wedged" in r.message for r in caplog.records)
+
+
+# --------------------------------------- durable journal + resume
+
+def test_journal_refuses_double_decide(tmp_path):
+    j = ChunkJournal(tmp_path / "j.jsonl", {"k": 1})
+    j.record([0, 1], [True, False], [None, 7], ["device", "device"])
+    with pytest.raises(ValueError, match="decided twice"):
+        j.record([1], [True], [None], ["device"])
+    j.close()
+
+
+def test_journal_key_mismatch_and_torn_tail(tmp_path):
+    p = tmp_path / "j.jsonl"
+    j = ChunkJournal(p, {"digest": "aa"})
+    j.record([0], [True], [None], ["device"])
+    j.close()
+    # Key mismatch: the journal belongs to another batch — start fresh.
+    j2 = ChunkJournal(p, {"digest": "bb"}, resume=True)
+    assert j2.decided() == {}
+    j2.record([0], [False], [3], ["device"])
+    # Torn final line (killed mid-write): decided prefix survives.
+    with open(p, "a") as f:
+        f.write('{"rows": [9], "valid": [tr')
+    j2.close()
+    j3 = ChunkJournal(p, {"digest": "bb"}, resume=True)
+    assert j3.decided() == {0: (False, 3, "device")}
+    # Appending after a torn tail must TRUNCATE it first — otherwise
+    # this record welds onto the partial line and a third resume
+    # silently loses everything journaled after the tear.
+    j3.record([7], [True], [None], ["device"])
+    j3.close()
+    j4 = ChunkJournal(p, {"digest": "bb"}, resume=True)
+    assert j4.decided() == {0: (False, 3, "device"),
+                            7: (True, None, "device")}
+    j4.finish()
+    assert not p.exists()
+
+
+def test_kill_and_resume_redispatches_zero_completed_chunks(tmp_path):
+    """Interrupt a streamed check mid-run, reopen the store journal,
+    resume: rows with journaled verdicts are sliced out before
+    encoding (zero re-dispatches — the journal itself refuses a row
+    decided twice), and final verdicts match the uninterrupted run."""
+    cols = synth_cas_columnar(130, seed=3, n_ops=20, corrupt=0.3,
+                              p_info=0.1)
+    # Same scheduler shape as the fault runs below, so their kernels
+    # are warm — a cold compile under the nemesis's test-scale
+    # watchdog deadline would read as a wedge and shift the fault
+    # ordinals.
+    base_v, base_b = check_columnar(MODEL, cols,
+                                    scheduler_opts={"chunk_rows": 32})
+    key = {"digest": "kill-resume"}
+    j1 = ChunkJournal(tmp_path / "j.jsonl", key)
+    inj = FaultInjector(FaultPlan.single("dispatch", "kill", chunk=3,
+                                         deadline_s=5.0))
+    with pytest.raises(InjectedKill):
+        check_columnar(MODEL, cols, faults=inj, journal=j1,
+                       scheduler_opts={"chunk_rows": 32})
+    j1.close()
+    j2 = ChunkJournal(tmp_path / "j.jsonl", key, resume=True)
+    decided = j2.decided()
+    assert decided, "chunks retired before the kill must be on disk"
+    assert len(decided) < cols.batch
+    DISPATCH_LOG.clear()
+    v, b = check_columnar(MODEL, cols, journal=j2,
+                          scheduler_opts={"chunk_rows": 32})
+    np.testing.assert_array_equal(v, base_v)
+    np.testing.assert_array_equal(b, base_b)
+    assert j2.resume_hits == len(decided)
+    redispatched = sum(n for _, _, _, n in DISPATCH_LOG)
+    assert redispatched <= cols.batch - len(decided), \
+        "completed chunks must not be re-dispatched"
+    j2.finish()
+
+
+def test_kill_and_resume_details_mode(tmp_path):
+    """Resume under details="invalid": journaled rows rehydrate as bare
+    resumed verdicts, fresh rows keep full counterexamples, and the
+    valid bits match the uninterrupted run row-for-row."""
+    cols = synth_cas_columnar(100, seed=11, n_ops=20, corrupt=0.35)
+    # Warm the fault runs' kernel shapes (see the sibling test above).
+    want = check_columnar(MODEL, cols, details="invalid",
+                          scheduler_opts={"chunk_rows": 32})
+    key = {"digest": "kill-details"}
+    j1 = ChunkJournal(tmp_path / "jd.jsonl", key)
+    inj = FaultInjector(FaultPlan.single("dispatch", "kill", chunk=2,
+                                         deadline_s=5.0))
+    with pytest.raises(InjectedKill):
+        check_columnar(MODEL, cols, details="invalid", faults=inj,
+                       journal=j1, scheduler_opts={"chunk_rows": 32})
+    j1.close()
+    j2 = ChunkJournal(tmp_path / "jd.jsonl", key, resume=True)
+    assert j2.decided()
+    got = check_columnar(MODEL, cols, details="invalid", journal=j2,
+                         scheduler_opts={"chunk_rows": 32})
+    n_resumed = 0
+    for i, (g, w) in enumerate(zip(got, want, strict=True)):
+        assert g["valid"] == w["valid"], i
+        if g.get("resumed"):
+            n_resumed += 1
+            assert g["provenance"] in PROVENANCE_TAGS
+            if g["valid"] is False:
+                assert g["op"]["index"] == w["op"]["index"], i
+        elif g["valid"] is False:
+            assert g["op"]["index"] == w["op"]["index"], i
+            assert g.get("configs") == w.get("configs"), i
+    assert n_resumed == j2.resume_hits > 0
+    j2.finish()
+
+
+def test_store_recheck_resume(tmp_path, monkeypatch):
+    """The operator-facing path: an interrupted ``recheck`` resumes via
+    ``--resume`` — journal on disk after the kill, consumed and deleted
+    on the successful resume, verdicts identical to a clean recheck."""
+    hists = mixed_histories(n=32, seed0=4000)
+    store = Store(base=tmp_path)
+    for i, h in enumerate(hists):
+        store.create("rt", ts=f"r{i:03d}").save_history(h, model=MODEL)
+    # Small chunks so the kill lands mid-stream with chunks retired —
+    # patched BEFORE the baseline so the fault runs' kernel shapes are
+    # warm (a cold compile under the nemesis's test-scale deadline
+    # would read as a wedge and shift the fault ordinals).
+    monkeypatch.setattr(sched_mod, "DEFAULT_CHUNK_ROWS", 8)
+    base = store.recheck("rt", MODEL)
+    inj = FaultInjector(FaultPlan.single("dispatch", "kill", chunk=3,
+                                         deadline_s=5.0))
+    with pytest.raises(InjectedKill):
+        store.recheck("rt", MODEL, faults=inj)
+    jpath = tmp_path / "rt" / "recheck.journal.jsonl"
+    assert jpath.exists(), "the journal must survive the kill"
+    out = store.recheck("rt", MODEL, resume=True)
+    assert out["resume_hits"] > 0
+    assert not jpath.exists(), "a finished recheck deletes its journal"
+    assert out["valid"] == base["valid"]
+    assert {ts: r["valid"] for ts, r in out["runs"].items()} == \
+        {ts: r["valid"] for ts, r in base["runs"].items()}
