@@ -24,7 +24,9 @@ JT_BENCH_FULL_PARITY=0 (fall back to sampled parity for quick local
 runs), JT_SCHED_CLASSES / JT_SCHED_CHUNK_ROWS / JT_SCHED_ENCODE_ROWS
 (streaming scheduler knobs, see ops/schedule.py), JT_BENCH_XLONG_B/
 JT_BENCH_XLONG_OPS (the 100-history x 100k-line probe; 0 skips),
-JT_BENCH_VPU_GOPS / JT_BENCH_HBM_PEAK_GBPS (roofline ceilings),
+JT_BENCH_VPU_GOPS / JT_BENCH_HBM_PEAK_GBPS / JT_BENCH_MXU_TMACS
+(roofline ceilings), JT_BENCH_GRAPH_B (dependency-graph cycle-checker
+figure; 0 skips),
 JT_FUSE_KINDS (event-fusion vocabulary budget, ops/encode.py). Narrow
 buckets all stay on device (the scheduler consolidates them into W
 classes); only tiny wide buckets route to the native CPU engine. The
@@ -552,6 +554,61 @@ def main():
     fold_rate = FB / (time.time() - t0)
     fold_invalid = sum(1 for r in fold_rs if r["valid"] is not True)
 
+    # Graph-checker extra: the second device checker family — batched
+    # happens-before cycle detection (ops.graph, doc/graphs.md).
+    # List-append histories lower to typed ww/wr/rw dependency graphs
+    # on the host, pack to [B, 3, V, V/32] bitsets bucketed by vertex
+    # count, and decide G0/G1c/G2 anomalies by vmapped boolean
+    # transitive closure — O(log V) dense matmuls per mask, the MXU's
+    # native shape, where the WGL scan is VPU-bound. mxu_util divides
+    # the dispatched closure's analytic MAC count (GraphScheduler
+    # stats, retries included) by the chip's assumed MXU peak
+    # (JT_BENCH_MXU_TMACS, default 98.5 = v5e: 197 TFLOP/s bf16 at 2
+    # flops/MAC; see doc/graphs.md for the derivation and caveats).
+    GB = int(os.environ.get("JT_BENCH_GRAPH_B", "2000"))
+    graph_section = None
+    if GB:
+        from collections import Counter
+
+        from jepsen_tpu.checkers.cycle import check_graphs_batch
+        from jepsen_tpu.ops.graph import bucket_v, extract_graph
+        from jepsen_tpu.workloads.synth import synth_la_history
+        mxu_tmacs = float(os.environ.get("JT_BENCH_MXU_TMACS", "98.5"))
+        la_hists = [synth_la_history(s, n_ops=30,
+                                     corrupt=1.0 if s % 7 == 0 else 0.0)
+                    for s in range(GB)]
+        t0 = time.time()
+        la_graphs = [extract_graph(h, "list-append") for h in la_hists]
+        t_extract = time.time() - t0
+        check_graphs_batch(la_graphs)            # warm the compiles
+        gtimes, gstats, grs = [], {}, []
+        for _ in range(max(2, repeats)):
+            gstats = {}
+            t0 = time.time()
+            grs = check_graphs_batch(la_graphs, stats_out=gstats)
+            gtimes.append(time.time() - t0)
+        t_graph = statistics.median(gtimes)
+        graph_section = {
+            "graphs_per_s": round(GB / t_graph, 2),
+            "e2e_graphs_per_s": round(GB / (t_extract + t_graph), 2),
+            "extract_s": round(t_extract, 3),
+            "device_s": round(t_graph, 3),
+            "graphs": GB,
+            "anomalies": sum(1 for r in grs if r["valid"] is not True),
+            "closure_matmuls": gstats.get("closure_matmuls"),
+            "mxu_macs_e9": round(gstats.get("mxu_macs", 0.0) / 1e9, 3),
+            "mxu_util": round(gstats.get("mxu_macs", 0.0) / t_graph
+                              / (mxu_tmacs * 1e12), 6),
+            "mxu_tmacs_assumed": mxu_tmacs,
+            "vertex_buckets": sorted(
+                [v, n] for v, n in Counter(
+                    bucket_v(g.n) for g in la_graphs).items()),
+            "resilience": {k: gstats.get(k, 0) for k in
+                           ("retries", "bisections", "watchdog_fired",
+                            "oom_events", "corrupt_chunks",
+                            "quarantined_rows", "faults_injected")},
+        }
+
     # ---------------------------------------- op-axis probe (10k ops)
     # The north star fixes 1k-op histories; this probes the op axis at
     # LB histories x 10k history lines (5k op pairs). The kernel scan
@@ -678,6 +735,7 @@ def main():
         "fold_total_queue_rate": round(fold_rate, 2),
         "fold_histories": FB,
         "fold_invalid": fold_invalid,
+        "graph_checker": graph_section,
         "fusion_ratio": fusion_ratio,
         "mean_live_slots": mean_live_slots,
         "fused_bad_refined": len(refined),

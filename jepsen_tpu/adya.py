@@ -41,7 +41,11 @@ def g2_gen() -> g.Generator:
 
 
 class G2Checker(Checker):
-    """At most one insert may succeed per key (adya.clj:57-83)."""
+    """At most one insert may succeed per key (adya.clj:57-83).
+    ``illegal-keys`` lists the witnessing keys themselves (not just the
+    per-key counts), so host verdicts compare field-for-field against
+    the device cycle checker's ``illegal-keys``
+    (checkers.cycle.CycleChecker over ops.graph.graph_adya_g2)."""
 
     def check(self, test, model, history, opts=None) -> dict:
         keys: dict = {}
@@ -60,8 +64,19 @@ class G2Checker(Checker):
             "legal-count": insert_count - len(illegal),
             "illegal-count": len(illegal),
             "illegal": illegal,
+            "illegal-keys": sorted(illegal),
         }
 
 
 def g2_checker() -> Checker:
     return G2Checker()
+
+
+def g2_cycle_checker() -> Checker:
+    """The device twin: G2 histories lowered to anti-dependency graphs
+    (ops.graph.graph_adya_g2) and decided by batched transitive closure
+    on the MXU — a doubly-inserted key is an rw 2-cycle, the canonical
+    G2 anomaly. Result carries the same ``illegal-keys`` list as
+    G2Checker plus the refined witness cycle."""
+    from .checkers.cycle import CycleChecker
+    return CycleChecker(family="adya-g2")

@@ -15,6 +15,8 @@ from .simple import (
     counter_checker,
 )
 from .linearizable import linearizable, LinearizableChecker
+from .cycle import (cycle_checker, host_cycle_checker, CycleChecker,
+                    HostCycleChecker, check_graphs_batch)
 from .brute import brute, brute_check, BruteChecker
 from .perf import latency_graph, perf, rate_graph_checker
 from .timeline import html_timeline

@@ -1,0 +1,160 @@
+"""Happens-before cycle checker: Adya anomaly detection behind the
+Checker protocol.
+
+``CycleChecker`` decides register / list-append / Adya-G2 histories by
+typed-dependency-graph cycle search on the device (ops.graph closure
+kernels scheduled by ops.schedule.GraphScheduler), with a pure-host DFS
+oracle twin (``HostCycleChecker``) as the parity reference — the same
+device/host pairing as checkers.simple ↔ ops.folds and the WGL engines.
+
+``check_graphs_batch`` is the batch seam (the check_batch_tpu analog):
+one call decides a whole corpus of graphs, streams verdicts per chunk,
+survives the checker nemesis (ops.faults FaultPlan injection) through
+the scheduler's degradation ladder — quarantined graphs re-decide on
+the host oracle, tagged ``host-fallback`` — and journals retired chunks
+durably (store.ChunkJournal) so an interrupted run resumes without
+re-dispatching a decided graph. Cyclic graphs are refined on the host
+into a minimal witness cycle (ops.graph.refine_witness — the
+fused_refine pattern). Anomaly classes and extraction rules:
+doc/graphs.md.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..ops.graph import (DepGraph, LEVELS, check_graph_host,
+                         encode_graphs, extract_graph, graph_result,
+                         refine_witness)
+from .core import Checker
+
+
+def _as_graphs(items, family: Optional[str]) -> List[DepGraph]:
+    return [g if isinstance(g, DepGraph) else extract_graph(g, family)
+            for g in items]
+
+
+def _rehydrate(g: DepGraph, valid, bad, prov) -> dict:
+    """A journal-resumed verdict: bare (no witness — the journal stores
+    the anomaly class, not the refined cycle), as in the WGL resume."""
+    anomaly = None if valid else LEVELS[int(bad)]
+    out = graph_result(g, anomaly, None, prov)
+    out["valid"] = bool(valid)      # journal is authoritative
+    out["resumed"] = True
+    return out
+
+
+def _chunk_recorder(sch, journal):
+    """on_chunk hook journaling graph verdicts as chunks retire.
+    Quarantined rows carry inert placeholders in-band — skipped here
+    and journaled when the host oracle decides them."""
+
+    def on_chunk(bucket, lo, hi, cyc, node):
+        rows, vals, bads, provs = [], [], [], []
+        for r in range(lo, hi):
+            i = bucket.indices[r]
+            if i in sch.quarantined:
+                continue
+            c = cyc[r - lo]
+            lvl = int(np.argmax(c)) if c.any() else None
+            rows.append(i)
+            vals.append(not c.any())
+            bads.append(lvl)
+            provs.append(sch.row_provenance.get(i, "device"))
+        if rows:
+            journal.record(rows, vals, bads, provs)
+
+    return on_chunk
+
+
+def check_graphs_batch(items: Sequence, *, family: Optional[str] = None,
+                       faults=None, journal=None,
+                       scheduler_opts: Optional[dict] = None,
+                       stats_out: Optional[dict] = None) -> List[dict]:
+    """Decide a batch of histories (or pre-extracted DepGraphs) by
+    device transitive closure; returns one result dict per input
+    (ops.graph.graph_result shape), every row tagged ``device`` /
+    ``device-retried`` / ``host-fallback``.
+
+    ``faults`` — a FaultInjector (the checker nemesis) threaded into
+    the scheduler's stage boundaries. ``journal`` — a store.ChunkJournal;
+    rows it already holds rehydrate as bare ``resumed`` verdicts and
+    never re-encode, retired chunks journal as they decode.
+    ``stats_out`` — filled with the scheduler's stats (graphs, chunks,
+    closure_matmuls, mxu_macs, ladder counters).
+    """
+    from ..ops.schedule import GraphScheduler
+    graphs = _as_graphs(items, family)
+    results: List[Optional[dict]] = [None] * len(graphs)
+    if journal is not None:
+        for i, (valid, bad, prov) in journal.decided().items():
+            if 0 <= i < len(graphs):
+                results[i] = _rehydrate(graphs[i], valid, bad, prov)
+    todo = [i for i, r in enumerate(results) if r is None]
+    sch = GraphScheduler(faults=faults, **(scheduler_opts or {}))
+    if journal is not None:
+        sch.on_chunk = _chunk_recorder(sch, journal)
+    buckets = encode_graphs([graphs[i] for i in todo], indices=todo)
+    for bucket, (cyc, node) in sch.run(buckets):
+        for r, i in enumerate(bucket.indices):
+            if i in sch.quarantined:
+                continue
+            g = graphs[i]
+            c = cyc[r]
+            if c.any():
+                li = int(np.argmax(c))
+                results[i] = graph_result(
+                    g, LEVELS[li], refine_witness(g, li),
+                    sch.row_provenance.get(i, "device"))
+            else:
+                results[i] = graph_result(
+                    g, None, None, sch.row_provenance.get(i, "device"))
+    # Quarantined graphs: the device ladder gave up — the host DFS
+    # oracle decides them (the quarantine contract), and they join the
+    # journal only once truly decided.
+    for i, reason in sch.quarantined.items():
+        r = check_graph_host(graphs[i], provenance="host-fallback")
+        r["quarantine_reason"] = reason
+        results[i] = r
+        if journal is not None:
+            lvl = (None if r["valid"]
+                   else LEVELS.index(r["anomaly"]))
+            journal.record([i], [r["valid"]], [lvl], ["host-fallback"])
+    if stats_out is not None:
+        stats_out.update(sch.stats)
+    assert all(r is not None for r in results), \
+        "every graph must receive a verdict"
+    return results
+
+
+class CycleChecker(Checker):
+    """Checker-protocol adapter: one history rides a batch of one (real
+    scale comes from check_graphs_batch). ``family`` pins the
+    extraction rules; None auto-detects from the op vocabulary."""
+
+    def __init__(self, family: Optional[str] = None, device: bool = True):
+        self.family = family
+        self.device = device
+
+    def check(self, test, model, history, opts=None) -> dict:
+        g = extract_graph(list(history), self.family)
+        if not self.device:
+            return check_graph_host(g)
+        return check_graphs_batch([g])[0]
+
+
+class HostCycleChecker(CycleChecker):
+    """The pure-host oracle twin (DFS, no device, no shared cycle
+    machinery) — the parity reference tests compare against."""
+
+    def __init__(self, family: Optional[str] = None):
+        super().__init__(family, device=False)
+
+
+def cycle_checker(family: Optional[str] = None) -> Checker:
+    return CycleChecker(family)
+
+
+def host_cycle_checker(family: Optional[str] = None) -> Checker:
+    return HostCycleChecker(family)

@@ -33,7 +33,10 @@ def render_op(inv: Op, comp: Optional[Op], end_s: float, col: int) -> str:
     t0 = (inv.time or 0) / 1e9
     t1 = (comp.time / 1e9) if comp is not None and comp.time is not None \
         else end_s
-    color = TYPE_COLORS.get(comp.type if comp is not None else None)
+    # Unknown completion types fall back to the neutral pending color —
+    # .get with no default would render "background: None".
+    color = TYPE_COLORS.get(comp.type if comp is not None else None,
+                            TYPE_COLORS[None])
     comp_desc = f"{comp.type} {comp.value!r}" if comp is not None else "?"
     title = (f"{inv.process} {inv.f} {inv.value!r} → {comp_desc} "
              f"[{t0:.3f}s – {t1:.3f}s]")

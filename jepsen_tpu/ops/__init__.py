@@ -12,8 +12,12 @@ Modules:
   encode     — history → event tensor lowering (slot assignment, batching)
   linearize  — dense-frontier WGL linearizability kernel (vmapped, sharded)
   folds      — vmapped single-pass checkers (set/counter/unique-ids/queue)
+  graph      — happens-before dependency graphs: typed ww/wr/rw edge
+               extraction, bitset-packed adjacency batches, MXU cycle
+               detection by boolean matrix squaring (doc/graphs.md)
   schedule   — streaming bucket scheduler + the degradation ladder
-               (watchdog, retry, OOM bisection, poison-row quarantine)
+               (watchdog, retry, OOM bisection, poison-row quarantine),
+               for both the WGL scan and the graph closure kernels
   faults     — the checker nemesis: deterministic fault injection at the
                encode/dispatch/decode boundaries (doc/resilience.md)
 

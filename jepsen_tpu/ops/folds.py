@@ -50,12 +50,21 @@ def _pow2(n: int) -> int:
     return 1 << max(n - 1, 0).bit_length()
 
 
-def _cached_kernel(cache: Dict, key, build):
-    k = cache.get(key)
+def _cached_kernel(cache: Dict, key, build, limit: int = 0):
+    """Bounded per-family kernel cache with LRU eviction: a hit
+    reinserts the entry at the MRU end (dicts preserve insertion
+    order), and overflow evicts only the single least-recently-used
+    kernel — a long-lived process cycling through limit+1 shapes keeps
+    every warm compile but one, where wholesale clearing would recompile
+    the lot. Shared by the fold kernels and the dependency-graph
+    closure kernels (ops.graph)."""
+    limit = limit or _KERNEL_CACHE_LIMIT
+    k = cache.pop(key, None)
     if k is None:
-        if len(cache) >= _KERNEL_CACHE_LIMIT:
-            cache.clear()
-        k = cache[key] = build()
+        if len(cache) >= limit:
+            cache.pop(next(iter(cache)))
+        k = build()
+    cache[key] = k
     return k
 
 

@@ -1106,6 +1106,307 @@ def _slice_rows(b: EncodedBatch, lo: int, hi: int) -> EncodedBatch:
                        if b.orig_n_events is not None else None))
 
 
+# ----------------------------------------- dependency-graph scheduler
+
+# Rows per graph-kernel dispatch (the graph analog of
+# DEFAULT_CHUNK_ROWS; graphs are dense [L, V, V] closures, so memory
+# per row is L x V^2 floats — far below the WGL frontier).
+GRAPH_CHUNK_ROWS = int(os.environ.get("JT_GRAPH_CHUNK_ROWS", "2048"))
+
+# Assumed worst-case sustained MXU throughput (MACs/s) for the graph
+# watchdog deadline — pessimistic for the same reason as
+# WATCHDOG_LANE_OPS_PER_S: the watchdog catches wedges, not slowness.
+WATCHDOG_MXU_MACS_PER_S = float(
+    os.environ.get("JT_WATCHDOG_MXU_MACS_PER_S", "1e11"))
+
+
+def _concat_graph_pieces(pieces):
+    if len(pieces) == 1:
+        return pieces[0]
+    return (np.concatenate([p[0] for p in pieces]),
+            np.concatenate([p[1] for p in pieces]))
+
+
+class GraphScheduler:
+    """Vertex-count bucket scheduler for the dependency-graph cycle
+    kernels (ops.graph) — the MXU twin of BucketScheduler, sharing its
+    fault model end to end: every chunk dispatches through the same
+    FaultInjector stage hooks (encode/dispatch/decode), decodes under a
+    watchdog deadline priced by the MXU op model, and degrades through
+    the same ladder — bounded retry with backoff, RESOURCE_EXHAUSTED
+    row bisection (the learned safe size sticks per vertex bucket),
+    poison-row binary search with quarantine to the caller's host DFS
+    oracle. Dispatch is synchronous per chunk (a graph chunk is one
+    matmul-chain dispatch; jax's async dispatch already overlaps the
+    host pad of the next chunk), results stream per bucket.
+
+    Contract mirrors BucketScheduler: ``run(buckets)`` yields
+    ``(bucket, (cyc, node))`` with cyc bool [B, L] / node int32 [B, L];
+    quarantined rows surface in ``quarantined`` carrying inert
+    placeholders (callers MUST re-decide them on the host oracle), and
+    every off-happy-path row is tagged in ``row_provenance``
+    ("device-retried" / "host-fallback"). ``on_chunk(bucket, lo, hi,
+    cyc, node)`` fires per decided chunk — the store.ChunkJournal hook.
+    Stats count DISPATCHED work (retries included), so
+    closure_matmuls/mxu_macs price what the device actually ran.
+    """
+
+    def __init__(self, *, chunk_rows: Optional[int] = None,
+                 faults: Optional[FaultInjector] = None,
+                 max_retries: Optional[int] = None,
+                 backoff_s: Optional[float] = None,
+                 on_chunk=None,
+                 compilation_cache: bool = True):
+        self.chunk_rows = (GRAPH_CHUNK_ROWS if chunk_rows is None
+                           else max(1, int(chunk_rows)))
+        if compilation_cache:
+            enable_compilation_cache()
+        self.faults = faults if faults is not None \
+            else FaultInjector.from_env()
+        self.max_retries = RETRY_MAX if max_retries is None \
+            else max(0, int(max_retries))
+        if backoff_s is None:
+            backoff_s = (self.faults.backoff_s
+                         if self.faults is not None else None)
+        self.backoff_s = RETRY_BACKOFF_S if backoff_s is None \
+            else float(backoff_s)
+        self.on_chunk = on_chunk
+        self.quarantined: Dict[int, str] = {}
+        self.row_provenance: Dict[int, str] = {}
+        self._safe_bp: Dict[int, int] = {}
+        self._awaited_shapes: set = set()
+        self.stats: dict = {
+            "graphs": 0, "buckets": 0, "chunks": 0,
+            "closure_matmuls": 0, "mxu_macs": 0.0, "wall_s": None,
+            "retries": 0, "bisections": 0, "watchdog_fired": 0,
+            "oom_events": 0, "corrupt_chunks": 0, "quarantined_rows": 0,
+            "faults_injected": 0,
+        }
+
+    # ------------------------------------------------------------ plumbing
+    def _deadline(self, b, rows: int) -> float:
+        from .graph import mxu_op_model
+        if self.faults is not None and self.faults.deadline_s is not None:
+            return self.faults.deadline_s
+        est = rows * mxu_op_model(b.V)["macs"]
+        d = max(WATCHDOG_MIN_S,
+                est / WATCHDOG_MXU_MACS_PER_S * WATCHDOG_FACTOR)
+        if b.V not in self._awaited_shapes:
+            self._awaited_shapes.add(b.V)
+            d += WATCHDOG_COMPILE_GRACE_S
+        return d
+
+    def _ship(self, b, lo: int, hi: int, Bp: int):
+        """The ONE dispatch sequence for both the happy path and every
+        ladder re-dispatch: fault hooks, zero-pad to Bp rows (padding
+        graphs are edgeless, never cyclic), async kernel launch."""
+        from .graph import graph_kernel, mxu_op_model
+        if self.faults is not None:
+            self.faults.fire("encode")
+        nb = hi - lo
+        adj = np.zeros((Bp,) + b.adj.shape[1:], np.uint32)
+        adj[:nb] = b.adj[lo:hi]
+        delay = 0.0
+        if self.faults is not None:
+            delay = self.faults.sleep_for(self.faults.fire("dispatch"))
+        out = graph_kernel(b.V)(adj)
+        m = mxu_op_model(b.V)
+        self.stats["chunks"] += 1
+        self.stats["closure_matmuls"] += Bp * int(m["matmuls"])
+        self.stats["mxu_macs"] += Bp * m["macs"]
+        return out, delay
+
+    def _await(self, out, nb: int, b, deadline: float,
+               delay: float = 0.0):
+        """Materialize one dispatch on a daemon thread under the
+        watchdog deadline; decode faults fire on that thread, decoded
+        verdicts are shape-validated (corrupt output is a retryable
+        fault, never a wrong verdict)."""
+        from .graph import validate_graph_decoded
+        import queue
+        q: "queue.Queue" = queue.Queue(1)
+
+        def work():
+            try:
+                if delay:
+                    time.sleep(delay)
+                kind = None
+                if self.faults is not None:
+                    kind = self.faults.fire("decode")
+                    s = self.faults.sleep_for(kind)
+                    if s:
+                        time.sleep(s)
+                cyc, node = out
+                c = np.asarray(cyc)[:nb]
+                nd = np.asarray(node)[:nb]
+                if kind == "corrupt":
+                    c, nd = corrupt_arrays(c, nd)
+                validate_graph_decoded(c, nd, b.V)
+                q.put(((c, nd), None))
+            except BaseException as e:   # noqa: BLE001 — relayed below
+                q.put((None, e))
+
+        threading.Thread(target=work, name="jepsen-graph-retire",
+                         daemon=True).start()
+        try:
+            r, err = q.get(timeout=deadline)
+        except queue.Empty:
+            self.stats["watchdog_fired"] += 1
+            raise WatchdogExpired(
+                f"graph chunk (V={b.V}, rows={nb}) exceeded its "
+                f"{deadline:.2f}s decode deadline") from None
+        if err is not None:
+            raise err
+        return r
+
+    # ------------------------------------------------ watchdog + ladder
+    def _exec_once(self, b, lo: int, hi: int, Bp: int):
+        pieces = []
+        for s in range(lo, hi, Bp):
+            e = min(s + Bp, hi)
+            out, delay = self._ship(b, s, e, Bp)
+            pieces.append(self._await(out, e - s, b,
+                                      self._deadline(b, Bp), delay))
+        return _concat_graph_pieces(pieces)
+
+    def _exec_retry(self, b, lo: int, hi: int, Bp: int):
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self.stats["retries"] += 1
+                time.sleep(self.backoff_s * (2 ** (attempt - 1)))
+            try:
+                return self._exec_once(b, lo, hi, Bp)
+            except Exception as e:
+                c = classify_failure(e)
+                if c is None or c == "oom":
+                    raise
+                if isinstance(e, CorruptOutput):
+                    self.stats["corrupt_chunks"] += 1
+                last = e
+        raise _ChunkFailed(last)
+
+    def _placeholder(self, n: int):
+        from .graph import N_LEVELS
+        return (np.zeros((n, N_LEVELS), bool),
+                np.full((n, N_LEVELS), INT32_MAX, np.int32))
+
+    def _quarantine(self, b, row: int, cause: BaseException):
+        i = b.indices[row]
+        reason = f"{type(cause).__name__}: {cause}"
+        self.quarantined[i] = reason
+        self.row_provenance[i] = "host-fallback"
+        self.stats["quarantined_rows"] += 1
+        log.warning("quarantining graph %s after exhausting the device "
+                    "ladder (%s); the host DFS oracle decides it", i,
+                    reason)
+        return self._placeholder(1)
+
+    def _hunt_poison(self, b, lo: int, hi: int, Bp: int):
+        if hi - lo == 1:
+            try:
+                return self._exec_once(b, lo, hi, min(Bp, 8))
+            except Exception as e:
+                if classify_failure(e) is None:
+                    raise
+                return self._quarantine(b, lo, e)
+        mid = (lo + hi) // 2
+        pieces = []
+        for a, c in ((lo, mid), (mid, hi)):
+            try:
+                piece = self._exec_once(b, a, c, Bp)
+            except Exception as e:
+                if classify_failure(e) is None:
+                    raise
+                piece = self._hunt_poison(b, a, c, Bp)
+            pieces.append(piece)
+        return _concat_graph_pieces(pieces)
+
+    def _exec_range(self, b, lo: int, hi: int, Bp: int,
+                    first_cause: Optional[BaseException] = None):
+        """retry → OOM row-bisection (learned safe size sticks per
+        vertex bucket) → poison-row hunt with quarantine. Always
+        returns full (cyc, node) for the range."""
+        cap = self._safe_bp.get(b.V)
+        if cap:
+            Bp = min(Bp, cap)
+        oom = first_cause is not None and \
+            classify_failure(first_cause) == "oom"
+        while True:
+            if not oom:
+                try:
+                    return self._exec_retry(b, lo, hi, Bp)
+                except _ChunkFailed:
+                    return self._hunt_poison(b, lo, hi, Bp)
+                except Exception as e:
+                    if classify_failure(e) != "oom":
+                        raise
+                    self.stats["oom_events"] += 1
+                    oom = True
+                    continue
+            if Bp > 1:
+                Bp = max(1, Bp // 2)
+                self.stats["bisections"] += 1
+                self._safe_bp[b.V] = Bp
+                log.warning("OOM on graph chunk (V=%s): bisecting to %s "
+                            "rows/dispatch", b.V, Bp)
+                oom = False
+                continue
+            return self._hunt_poison(b, lo, hi, 1)
+
+    def _recover(self, b, lo: int, hi: int, Bp: int,
+                 cause: BaseException):
+        c = classify_failure(cause)
+        if c == "oom":
+            self.stats["oom_events"] += 1
+        if isinstance(cause, CorruptOutput):
+            self.stats["corrupt_chunks"] += 1
+        log.warning("graph chunk (V=%s, rows %s:%s) failed (%s: %s); "
+                    "entering the degradation ladder", b.V, lo, hi,
+                    type(cause).__name__, cause)
+        self.stats["retries"] += 1
+        out = self._exec_range(b, lo, hi, Bp, first_cause=cause)
+        for r in range(lo, hi):
+            self.row_provenance.setdefault(b.indices[r],
+                                           "device-retried")
+        return out
+
+    # -------------------------------------------------------------- driver
+    def run(self, buckets):
+        """Yield (bucket, (cyc, node)) per vertex bucket — see the
+        class docstring for the contract."""
+        t0 = time.monotonic()
+        for b in buckets:
+            if not b.batch:
+                continue
+            self.stats["buckets"] += 1
+            self.stats["graphs"] += b.batch
+            pieces = []
+            for lo in range(0, b.batch, self.chunk_rows):
+                hi = min(lo + self.chunk_rows, b.batch)
+                Bp = min(self.chunk_rows, max(8, _pow2_ceil(hi - lo)))
+                # An earlier OOM bisection taught us this bucket's real
+                # memory wall: later chunks dispatch under it instead
+                # of re-OOMing at full size and re-entering the ladder
+                # (which would halve the learned size once per chunk).
+                cap = self._safe_bp.get(b.V)
+                if cap:
+                    Bp = min(Bp, cap)
+                try:
+                    cyc, node = self._exec_once(b, lo, hi, Bp)
+                except Exception as e:
+                    if classify_failure(e) is None:
+                        raise
+                    cyc, node = self._recover(b, lo, hi, Bp, e)
+                if self.on_chunk is not None:
+                    self.on_chunk(b, lo, hi, cyc, node)
+                pieces.append((cyc, node))
+            yield b, _concat_graph_pieces(pieces)
+        self.stats["wall_s"] = round(time.monotonic() - t0, 4)
+        if self.faults is not None:
+            self.stats["faults_injected"] = len(self.faults.log)
+
+
 def run_buckets_streamed(batches, return_frontier=False, **kw):
     """Drop-in pipelined successor to run_buckets_threaded: same
     (batch, out) yield contract, but the yielded buckets are the

@@ -24,3 +24,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "faults: checker-nemesis fault schedules (fast, "
                    "deterministic; runs in tier-1)")
+    config.addinivalue_line(
+        "markers", "graphs: dependency-graph cycle-checker parity gate "
+                   "(fast, deterministic; runs in tier-1)")

@@ -19,6 +19,7 @@ def test_bench_emits_contract_json():
     env = dict(os.environ,
                JT_BENCH_B="200", JT_BENCH_OPS="100",
                JT_BENCH_REPEATS="1", JT_BENCH_FOLD_B="50",
+               JT_BENCH_GRAPH_B="40",
                JT_BENCH_STORE_B="20", JT_BENCH_CONVERTED="200",
                JT_BENCH_FULL_PARITY="0",
                JT_BENCH_LONG_B="50", JT_BENCH_LONG_OPS="500",
@@ -48,6 +49,14 @@ def test_bench_emits_contract_json():
     assert d["roofline"]["vpu_util"] >= 0
     assert d["roofline"]["closure_iters_total"] > 0
     assert d["roofline"]["source_events_per_s"] > 0
+    # Graph-checker section (ISSUE 4 acceptance): MXU op-model figures
+    # next to the WGL VPU metrics.
+    g = d["graph_checker"]
+    assert g["graphs"] == 40 and g["graphs_per_s"] > 0
+    assert g["closure_matmuls"] > 0 and g["mxu_util"] >= 0
+    assert g["anomalies"] >= 1
+    assert g["vertex_buckets"]
+    assert g["resilience"]["quarantined_rows"] == 0
     x = d["xlong_history"]
     assert x["histories"] > 0 and x["events_per_s"] > 0
     assert x["encode_s"] >= 0 and x["device_s"] > 0   # the breakdown

@@ -298,3 +298,36 @@ def test_empty_histories():
     assert check_counters_batch([[]])[0]["valid"] is True
     assert check_unique_ids_batch([[]])[0]["valid"] is True
     assert check_queues_batch([[]])[0]["valid"] is True
+
+
+def test_kernel_cache_evicts_single_lru_entry():
+    """Overflow must evict ONE least-recently-used kernel, not wipe the
+    cache: a process cycling through limit+1 shapes keeps every warm
+    compile but one."""
+    from jepsen_tpu.ops.folds import _cached_kernel
+
+    cache, builds = {}, []
+
+    def mk(k):
+        def build():
+            builds.append(k)
+            return k
+        return build
+
+    for k in range(3):
+        assert _cached_kernel(cache, k, mk(k), limit=3) == k
+    # A hit refreshes recency: 0 becomes MRU without rebuilding.
+    assert _cached_kernel(cache, 0, mk(0), limit=3) == 0
+    assert builds == [0, 1, 2]
+    # Overflow evicts only the LRU entry (1), never the whole cache.
+    _cached_kernel(cache, 3, mk(3), limit=3)
+    assert set(cache) == {0, 2, 3}
+    assert builds == [0, 1, 2, 3]
+    # Survivors are still warm...
+    _cached_kernel(cache, 0, mk(0), limit=3)
+    _cached_kernel(cache, 2, mk(2), limit=3)
+    assert builds == [0, 1, 2, 3]
+    # ...and only the evictee pays a recompile.
+    _cached_kernel(cache, 1, mk(1), limit=3)
+    assert builds == [0, 1, 2, 3, 1]
+    assert set(cache) == {0, 2, 1}

@@ -125,3 +125,15 @@ def test_invalid_analysis_renders_linear_svg(tmp_path):
                               cas_register(), h2)
     assert r2["valid"] is True
     assert not (handle2.dir / "linear.svg").exists()
+
+
+def test_timeline_unknown_completion_type_gets_neutral_color():
+    """render_op must fall back to the neutral pending color for a
+    completion type outside the palette — never 'background: None'."""
+    from jepsen_tpu.checkers.timeline import TYPE_COLORS, render_op
+    inv = Op(process=0, type="invoke", f="read", value=None, time=0)
+    comp = Op(process=0, type="surprise", f="read", value=1,
+              time=int(1e9))
+    block = render_op(inv, comp, 2.0, 0)
+    assert f"background:{TYPE_COLORS[None]}" in block
+    assert "background:None" not in block
