@@ -26,7 +26,9 @@ runs), JT_SCHED_CLASSES / JT_SCHED_CHUNK_ROWS / JT_SCHED_ENCODE_ROWS
 JT_BENCH_XLONG_OPS (the 100-history x 100k-line probe; 0 skips),
 JT_BENCH_VPU_GOPS / JT_BENCH_HBM_PEAK_GBPS / JT_BENCH_MXU_TMACS
 (roofline ceilings), JT_BENCH_GRAPH_B (dependency-graph cycle-checker
-figure; 0 skips),
+figure; 0 skips), JT_BENCH_WAL_OPS (run-durability figure: live-WAL
+worker-loop overhead, group-commit flush percentiles, salvage
+throughput; 0 skips),
 JT_FUSE_KINDS (event-fusion vocabulary budget, ops/encode.py). Narrow
 buckets all stay on device (the scheduler consolidates them into W
 classes); only tiny wide buckets route to the native CPU engine. The
@@ -609,6 +611,94 @@ def main():
                             "quarantined_rows", "faults_injected")},
         }
 
+    # ------------------------------------- run-durability (live WAL)
+    # The run layer's crash durability (doc/resilience.md "Run-level
+    # durability"): every worker-loop op appends to a fsynced,
+    # group-committed WAL. Three figures: worker-loop ops/s with the
+    # WAL on vs off (the acceptance gate: within 10% at the default
+    # JT_WAL_FLUSH_MS), group-commit fsync latency percentiles, and
+    # salvage throughput (ops/s reconstructed from a WAL segment).
+    WOPS = int(os.environ.get("JT_BENCH_WAL_OPS", "20000"))
+    durability_section = None
+    if WOPS:
+        import random as _rand
+        import tempfile as _tempfile
+
+        from jepsen_tpu import runtime as _runtime
+        from jepsen_tpu.history.wal import WAL_FILE, HistoryWAL
+        from jepsen_tpu.store import Store as _Store
+        from jepsen_tpu.testing import atom_cas_test as _atom_test
+        from jepsen_tpu.utils.core import Relatime as _Relatime
+
+        def _loop_time(seed: int, wal=None) -> float:
+            """Time the WORKER LOOP alone (run_case: clients + op loop
+            + history appends), with/without a live WAL attached — the
+            persistence tail (save_history) is deliberately outside
+            the window, it exists in both modes and measures IO, not
+            the WAL's group-commit tax."""
+            t = _atom_test(n_ops=WOPS, concurrency=4, seed=seed)
+            t["rng"] = _rand.Random(seed)
+            t["clock"] = _Relatime()
+            t["active_histories"] = set()
+            t["barrier"] = None
+            t["wal"] = wal
+            t0 = time.time()
+            _runtime.run_case(t)
+            return time.time() - t0
+
+        _loop_time(seed=0)                            # warm the path
+        t_off = statistics.median(
+            _loop_time(seed=i) for i in range(max(2, repeats)))
+        wal_times, sync_ns = [], []
+        with _tempfile.TemporaryDirectory() as td:
+            for i in range(max(2, repeats)):
+                wal = HistoryWAL(os.path.join(td, f"w{i}.jsonl"),
+                                 header={"seed": 100 + i})
+                wal.stamp_phase("run")
+                wal_times.append(_loop_time(seed=100 + i, wal=wal))
+                wal.close()
+                sync_ns.extend(wal.sync_ns)
+        t_on = statistics.median(wal_times)
+        sync_ms = sorted(ns / 1e6 for ns in sync_ns)
+
+        def _pct(xs, p):
+            # Nearest-rank percentile: ceil(p·n/100) − 1, clamped.
+            if not xs:
+                return None
+            import math
+            return round(xs[max(0, min(len(xs) - 1,
+                                       math.ceil(p / 100 * len(xs))
+                                       - 1))], 3)
+
+        # Salvage throughput: reconstruct a checkable history from a
+        # crashed run's WAL (torn-tail drop + dangling completion +
+        # standard-file materialize).
+        with _tempfile.TemporaryDirectory() as td:
+            st = _Store(td)
+            h = st.create("bench-wal")
+            wal = HistoryWAL(h.path(WAL_FILE), header={"seed": 999})
+            wal.stamp_phase("run")
+            _loop_time(seed=999, wal=wal)
+            wal.close()
+            name, ts = st.incomplete()[0]
+            t0 = time.time()
+            sv = st.salvage(name, ts)
+            t_salvage = time.time() - t0
+        durability_section = {
+            "wal_ops": WOPS,
+            "flush_ms": float(os.environ.get("JT_WAL_FLUSH_MS", "50")),
+            "ops_per_s_wal_off": round(2 * WOPS / t_off, 1),
+            "ops_per_s_wal_on": round(2 * WOPS / t_on, 1),
+            "wal_overhead_pct": round(100.0 * (t_on - t_off)
+                                      / max(t_off, 1e-9), 2),
+            "group_commits": len(sync_ms),
+            "flush_p50_ms": _pct(sync_ms, 50),
+            "flush_p99_ms": _pct(sync_ms, 99),
+            "salvage_ops_per_s": round(sv["ops"] / max(t_salvage, 1e-9),
+                                       1),
+            "salvage_dangling_completed": sv["dangling_completed"],
+        }
+
     # ---------------------------------------- op-axis probe (10k ops)
     # The north star fixes 1k-op histories; this probes the op axis at
     # LB histories x 10k history lines (5k op pairs). The kernel scan
@@ -736,6 +826,7 @@ def main():
         "fold_histories": FB,
         "fold_invalid": fold_invalid,
         "graph_checker": graph_section,
+        "run_durability": durability_section,
         "fusion_ratio": fusion_ratio,
         "mean_live_slots": mean_live_slots,
         "fused_bad_refined": len(refined),

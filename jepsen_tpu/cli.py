@@ -320,6 +320,13 @@ def suite_cmd() -> dict:
                             "under N nemesis seeds and pool every "
                             "run's linearizability analysis into one "
                             "device dispatch (north-star batch mode)")
+        p.add_argument("--resume", action="store_true", default=False,
+                       help="Resume a killed --seeds campaign from its "
+                            "durable checkpoint "
+                            "(store/<name>/campaign.jsonl): completed "
+                            "seeds rehydrate, the in-flight seed "
+                            "salvages its WAL prefix, only remaining "
+                            "seeds re-run (doc/resilience.md)")
         # Suites pick their own concurrency unless the user insists.
         p.set_defaults(concurrency=None, time_limit=None)
 
@@ -385,6 +392,14 @@ def suite_cmd() -> dict:
             kw.update(nodes=m["nodes"], ssh=m["ssh"],
                       concurrency=m["concurrency"],
                       time_limit=m["time_limit"])
+        if d.get("resume") and not d.get("seeds"):
+            print("--resume applies to --seeds campaigns (single runs "
+                  "salvage via the `salvage` subcommand instead)")
+            return 254
+        if d.get("resume") and d["no_store"]:
+            print("--resume needs the store (the checkpoint lives "
+                  "there); drop --no-store")
+            return 254
         builder = suite_registry()[name]
         if d.get("seeds"):
             if d["test_count"] != 1:
@@ -392,7 +407,8 @@ def suite_cmd() -> dict:
                       "seeded runs)")
                 return 254
             return _run_seeded_batch(builder, kw, d["seeds"],
-                                     d.get("seed") or 0, d["no_store"])
+                                     d.get("seed") or 0, d["no_store"],
+                                     resume=d["resume"])
         for _ in range(d["test_count"]):
             if not _run_built_test(builder(dict(kw)), d["no_store"]):
                 return 1
@@ -402,24 +418,30 @@ def suite_cmd() -> dict:
 
 
 def _run_seeded_batch(builder: Callable, kw: dict, n_seeds: int,
-                      base_seed: int, no_store: bool) -> int:
+                      base_seed: int, no_store: bool,
+                      resume: bool = False) -> int:
     """Run one suite under N nemesis seeds, pooling all analyses into
-    one device dispatch (runtime.run_seeds). Prints one JSON line of
-    per-seed verdicts + store dirs; exit 1 unless every seed is valid."""
+    one device dispatch (runtime.run_seeds). Stored campaigns
+    checkpoint per-seed progress durably; ``resume`` continues a
+    killed campaign re-running zero completed seeds. Prints one JSON
+    line of per-seed verdicts + store dirs; exit 1 unless every seed
+    is valid."""
     import json as _json
 
     from . import runtime
 
     seeds = [base_seed + i for i in range(n_seeds)]
     tests = runtime.run_seeds(lambda s: builder(dict(kw, seed=s)), seeds,
-                              store=not no_store)
+                              store=not no_store,
+                              checkpoint=not no_store, resume=resume)
     out = {"seeds": {}, "valid": True}
     for s, t in zip(seeds, tests):
         v = (t.get("results") or {}).get("valid")
         handle = t.get("store_handle")
         out["seeds"][str(s)] = {
             "valid": v,
-            **({"dir": str(handle.dir)} if handle is not None else {})}
+            **({"dir": str(handle.dir)} if handle is not None else {}),
+            **({"resumed": True} if t.get("resumed_seed") else {})}
         if v is not True:
             out["valid"] = False
     print(_json.dumps(out, default=str))
@@ -484,8 +506,114 @@ def recheck_cmd() -> dict:
     return {"recheck": {"add_opts": add_opts, "run": run}}
 
 
+def salvage_cmd() -> dict:
+    """``salvage [--test NAME] [--run TS] [--model FAMILY]``:
+    salvage-to-verdict for crashed runs. With no arguments, lists and
+    salvages EVERY incomplete run (live WAL present, no results.json);
+    ``--test``/``--run`` narrow the sweep. Salvage drops the torn WAL
+    tail, completes dangling invocations as ``:info``, and
+    materializes the standard history files so recheck, every checker
+    family, and the web UI work on the crashed run unchanged.
+    ``--model FAMILY`` goes all the way to verdicts: the salvaged runs
+    are immediately rechecked (the replay seam)."""
+    from .recheck import FAMILY_NAMES
+
+    def add_opts(p):
+        p.add_argument("--test", default=None,
+                       help="Salvage only this stored test's runs")
+        p.add_argument("--run", default=None,
+                       help="Salvage only this run timestamp "
+                            "(requires --test)")
+        p.add_argument("--model", default=None,
+                       choices=list(FAMILY_NAMES),
+                       help="After salvaging, recheck the salvaged "
+                            "tests under this checker family "
+                            "(salvage-to-VERDICT)")
+        p.add_argument("--list", action="store_true", default=False,
+                       help="Only list incomplete runs; salvage "
+                            "nothing")
+
+    def run(opts):
+        import json as _json
+        import os as _os
+        import time as _time
+
+        from .history.wal import WAL_FILE, wal_header, writer_alive
+        from .recheck import recheck_family
+        from .store import DEFAULT
+
+        if opts.run and not opts.test:
+            print("--run requires --test")
+            return 254
+        targets = [(n, t) for n, t in DEFAULT.incomplete()
+                   if (opts.test is None or n == opts.test)
+                   and (opts.run is None or t == opts.run)]
+        # A WAL still being written is a LIVE run, not a crashed one:
+        # the blind sweep must not salvage under a running campaign.
+        # Two guards: the writer pid from the WAL header still alive
+        # on this host (covers silent phases — device analysis writes
+        # nothing for long stretches), and a quiescence window — WAL
+        # untouched for JT_SALVAGE_MIN_AGE_S (default 5 s, several
+        # group-commit windows; covers cross-host/NFS stores where the
+        # pid means nothing). Naming an explicit --test --run
+        # overrides both.
+        explicit = bool(opts.test and opts.run)
+        skipped_live = []
+        if not explicit:
+            min_age = float(_os.environ.get("JT_SALVAGE_MIN_AGE_S",
+                                            "5"))
+            now = _time.time()
+
+            def live(n, t):
+                wal = DEFAULT.run_dir(n, t) / WAL_FILE
+                return (writer_alive(wal_header(wal))
+                        or now - wal.stat().st_mtime < min_age)
+
+            fresh = [(n, t) for n, t in targets if live(n, t)]
+            skipped_live = [f"{n}/{t}" for n, t in fresh]
+            targets = [x for x in targets if x not in fresh]
+        out = {"incomplete": [f"{n}/{t}" for n, t in targets],
+               "skipped_live": skipped_live,
+               "salvaged": {}, "errors": {}}
+        salvaged_ts: Dict[str, List[str]] = {}
+        if not opts.list:
+            for n, t in targets:
+                # One unreadable WAL (e.g. killed before the header
+                # fsync) must not abort the sweep — the other crashed
+                # runs are still perfectly recoverable.
+                try:
+                    out["salvaged"][f"{n}/{t}"] = DEFAULT.salvage(n, t)
+                    salvaged_ts.setdefault(n, []).append(t)
+                except Exception as e:
+                    out["errors"][f"{n}/{t}"] = str(e)
+        if opts.model and not opts.list:
+            out["recheck"] = {}
+            for name in sorted(salvaged_ts):
+                # Only the runs salvaged in THIS sweep: pre-existing
+                # runs of the same test neither pay re-analysis nor
+                # drive the verdict/exit code.
+                r = recheck_family(DEFAULT, name, opts.model,
+                                   timestamps=salvaged_ts[name])
+                out["recheck"][name] = {
+                    "valid": r["valid"],
+                    "runs": {ts: run_r["valid"]
+                             for ts, run_r in r["runs"].items()}}
+        print(_json.dumps(out, default=str))
+        if opts.list:
+            return 0
+        if out["errors"]:
+            return 1          # partial recovery must be visible to scripts
+        if opts.model:
+            return 0 if all(r["valid"] is True
+                            for r in out["recheck"].values()) else 1
+        return 0
+
+    return {"salvage": {"add_opts": add_opts, "run": run}}
+
+
 def main(argv: Optional[Sequence[str]] = None) -> None:
-    run_cli({**suite_cmd(), **serve_cmd(), **recheck_cmd()}, argv)
+    run_cli({**suite_cmd(), **serve_cmd(), **recheck_cmd(),
+             **salvage_cmd()}, argv)
 
 
 if __name__ == "__main__":

@@ -83,13 +83,34 @@ def write_jsonl(path, history: Iterable[Op], chunk: int = 8192) -> None:
             f.write("\n".join(buf) + "\n")
 
 
-def read_jsonl(path) -> List[Op]:
+class CorruptHistoryLine(ValueError):
+    """A history line that doesn't parse — carries the path and
+    1-based line number (a bare json.JSONDecodeError loses both)."""
+
+    def __init__(self, path, lineno: int, cause: Exception):
+        self.path, self.lineno = str(path), lineno
+        super().__init__(
+            f"{path}:{lineno}: corrupt/truncated history line: {cause}")
+
+
+def read_jsonl(path, tolerant: bool = False) -> List[Op]:
+    """Parse a JSONL history. A corrupt or truncated line raises
+    CorruptHistoryLine naming the path and line number; with
+    ``tolerant=True`` it instead ends the read and returns the good
+    prefix — the salvage path's primitive (a process killed mid-write
+    leaves at most one torn final line)."""
     out: List[Op] = []
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, 1):
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 out.append(loads_op(line))
+            except Exception as e:
+                if tolerant:
+                    break
+                raise CorruptHistoryLine(path, lineno, e) from e
     return out
 
 

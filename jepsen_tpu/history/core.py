@@ -20,16 +20,25 @@ class History:
     Workers call ``append`` concurrently (guarded by a lock, mirroring the
     reference's history atom, core.clj:41-45); analysis operates on the
     frozen list from ``ops()``.
+
+    ``on_append`` (optional) observes every op inside the append lock,
+    AFTER its index is assigned — the live-WAL seam (history/wal.py):
+    the listener sees ops in exactly history order, so a write-ahead
+    log built from it replays to the same sequence analysis would see.
     """
 
-    def __init__(self, ops: Optional[Iterable[Op]] = None):
+    def __init__(self, ops: Optional[Iterable[Op]] = None,
+                 on_append=None):
         self._ops: List[Op] = list(ops) if ops is not None else []
         self._lock = threading.Lock()
+        self._on_append = on_append
 
     def append(self, op: Op) -> Op:
         with self._lock:
             op.index = len(self._ops)
             self._ops.append(op)
+            if self._on_append is not None:
+                self._on_append(op)
         return op
 
     def ops(self) -> List[Op]:

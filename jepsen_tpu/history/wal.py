@@ -1,0 +1,274 @@
+"""Live history write-ahead log: the run layer's crash durability.
+
+The reference's core loop records every invocation/completion in memory
+and persists only at teardown (core.clj:329-436) — a control-node crash
+mid-run forfeits the entire fault-injected history, which is exactly
+the artifact the system exists to produce. PR 3 gave the *checker* a
+WAL (store.ChunkJournal); this module gives the *run* one: the worker
+loop appends every op to a per-run fsynced, group-committed JSONL
+segment as it lands in the in-memory history, so any prefix of the run
+survives process death.
+
+Segment format (``history.wal.jsonl`` in the run dir):
+
+    line 1:  {"wal": "JTWAL1", "test": {...}, "seed": ..., "phase": "setup"}
+    then:    op records (codec.dumps_op — the history.jsonl line format)
+             interleaved with phase stamps {"phase": NAME, "wal_ops": N}
+             at each lifecycle transition (setup/run/teardown/analyzed).
+
+Phase stamps and the header are flushed+fsynced immediately; op records
+group-commit — buffered writes are fsynced when ``JT_WAL_FLUSH_MS``
+(default 50) has elapsed since the last sync, bounding both the fsync
+rate (the worker-loop overhead bench.py's ``run_durability`` section
+measures) and the window of ops a crash can lose. A torn final line
+(killed mid-write) is tolerated and dropped on read, exactly like
+ChunkJournal.
+
+Because the P-compositionality results this repo already exploits mean
+a prefix history is still fully checkable, ``salvage_history`` turns
+any recovered prefix into a standard checkable history: dangling
+invocations complete as ``:info`` (the Jepsen convention — the op may
+or may not have taken effect) and the sequence reindexes. Op records
+are distinguished from phase stamps by the ``type`` key, which every op
+carries and no stamp does.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from .codec import dumps_op, loads_op
+from .core import index
+from .ops import Op, INFO, INVOKE
+
+log = logging.getLogger("jepsen.wal")
+
+WAL_MAGIC = "JTWAL1"
+
+# Lifecycle phases, in stamp order (mirrored by ops.faults.RUN_PHASES).
+PHASES = ("setup", "run", "teardown", "analyzed")
+
+WAL_FILE = "history.wal.jsonl"
+
+
+def flush_window_ms() -> float:
+    return float(os.environ.get("JT_WAL_FLUSH_MS", "50"))
+
+
+class HistoryWAL:
+    """One run's live op log. ``append_op`` is called from the History
+    append hook (inside the history lock, so records land in history
+    order); ``stamp_phase`` marks lifecycle transitions. Thread safety
+    comes from the caller's serialization (History's lock for ops; the
+    run's single control thread for stamps) plus file appends being
+    whole-line writes.
+
+    ``run_fault`` threads the crash nemesis (ops.faults
+    .RunFaultInjector) into the two places run-level faults fire:
+    after an op is durable, and at a phase boundary."""
+
+    def __init__(self, path, header: Optional[dict] = None,
+                 flush_ms: Optional[float] = None, run_fault=None):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.flush_ms = flush_window_ms() if flush_ms is None \
+            else float(flush_ms)
+        self.run_fault = run_fault
+        self.ops_appended = 0
+        self.phase = "setup"
+        # Group-commit fsync latencies (bench's flush percentiles).
+        # Only op-path syncs are recorded — header/stamp/close fsyncs
+        # are mandatory, not group commits — and the deque bounds a
+        # long run's memory (recent-window percentiles are what bench
+        # reports anyway).
+        from collections import deque
+        self.sync_ns = deque(maxlen=65536)
+        self._record_sync = False
+        self._f = open(self.path, "w")
+        self._dirty = False
+        self._last_sync = time.monotonic()
+        self._closed = False
+        # The writer pid lets a blind salvage sweep tell a LIVE run
+        # (writer still alive on this host) from a crashed one.
+        head = {"wal": WAL_MAGIC, **(header or {}),
+                "pid": os.getpid(), "phase": "setup"}
+        self._f.write(json.dumps(head, default=repr) + "\n")
+        self.sync()
+        # The durable header IS the ``setup`` stamp — give the crash
+        # nemesis its boundary (``phase:setup`` kills fire here).
+        if self.run_fault is not None:
+            self.run_fault.on_phase(self, "setup")
+
+    # ------------------------------------------------------- writing
+    def sync(self) -> None:
+        """Flush + fsync everything buffered — the group commit."""
+        if self._closed:
+            return
+        t0 = time.monotonic_ns()
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        if self._record_sync:
+            self.sync_ns.append(time.monotonic_ns() - t0)
+        self._dirty = False
+        self._last_sync = time.monotonic()
+
+    def _maybe_sync(self) -> None:
+        if self.flush_ms <= 0 or \
+                (time.monotonic() - self._last_sync) * 1000.0 >= \
+                self.flush_ms:
+            self._record_sync = True
+            try:
+                self.sync()
+            finally:
+                self._record_sync = False
+
+    def append_op(self, op: Op) -> None:
+        """Record one history op (invoke or completion). Buffered;
+        durable at the next group commit."""
+        if self._closed:
+            return
+        n = self.ops_appended
+        self._f.write(dumps_op(op) + "\n")
+        self.ops_appended = n + 1
+        self._dirty = True
+        self._maybe_sync()
+        if self.run_fault is not None:
+            self.run_fault.on_op(self, n)
+
+    def stamp_phase(self, phase: str) -> None:
+        """Mark a lifecycle transition. Stamps are synchronous — the
+        boundary itself must be durable (salvage reports how far the
+        run got, and the campaign resume trusts it)."""
+        assert phase in PHASES, phase
+        if self._closed:
+            return
+        self.phase = phase
+        self._f.write(json.dumps(
+            {"phase": phase, "wal_ops": self.ops_appended}) + "\n")
+        self.sync()
+        if self.run_fault is not None:
+            self.run_fault.on_phase(self, phase)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        try:
+            self.sync()
+        finally:
+            self._closed = True
+            try:
+                self._f.close()
+            except Exception:
+                pass
+
+
+# ------------------------------------------------------------ reading
+
+def wal_header(path) -> Optional[dict]:
+    """Just the (fsynced-first) header line — the cheap probe for
+    sweeps that must not read a potentially huge segment. None when the
+    file has no durable header (killed before the first fsync)."""
+    try:
+        with open(path, "rb") as f:
+            line = f.readline()
+        if not line.endswith(b"\n"):
+            return None
+        d = json.loads(line)
+        return d if d.get("wal") == WAL_MAGIC else None
+    except Exception:
+        return None
+
+
+def writer_alive(header: Optional[dict]) -> bool:
+    """Is the WAL's writer process still alive on THIS host? Best
+    effort (pid reuse can false-positive) — the blind salvage sweep's
+    liveness guard, overridable by naming the run explicitly."""
+    pid = (header or {}).get("pid")
+    if not isinstance(pid, int) or pid <= 0 or pid == os.getpid():
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except PermissionError:
+        return True       # exists, just unsignalable from this user
+    except OSError:
+        return False
+
+
+def read_wal(path) -> dict:
+    """Recover a WAL segment, tolerating the torn tail a kill leaves.
+
+    Returns ``{"header": dict, "phases": [(name, wal_ops)...],
+    "ops": [Op...], "torn": bool}`` — ``torn`` is True when a trailing
+    partial/corrupt line (or missing final newline) was dropped. A file
+    that isn't a WAL (wrong magic) raises ValueError naming the path.
+    """
+    data = Path(path).read_bytes()
+    header: Optional[dict] = None
+    phases: List[Tuple[str, int]] = []
+    ops: List[Op] = []
+    torn = False
+    pos = 0
+    while pos < len(data):
+        nl = data.find(b"\n", pos)
+        if nl < 0:
+            torn = True             # killed mid-write: drop the tail
+            break
+        line = data[pos:nl].strip()
+        pos = nl + 1
+        if not line:
+            continue
+        try:
+            if header is None:
+                d = json.loads(line)
+                if d.get("wal") != WAL_MAGIC:
+                    raise ValueError(
+                        f"{path}: not a history WAL (bad magic)")
+                header = d
+            elif b'"type"' in line:
+                ops.append(loads_op(line.decode()))
+            else:
+                d = json.loads(line)
+                phases.append((d["phase"], int(d.get("wal_ops", -1))))
+        except Exception:
+            if header is None:
+                raise
+            # Corruption can only be the in-flight group commit at the
+            # moment of death — everything after it was never written.
+            torn = True
+            break
+    if header is None:
+        raise ValueError(f"{path}: empty WAL (no durable header)")
+    return {"header": header, "phases": phases, "ops": ops, "torn": torn}
+
+
+def salvage_history(ops: List[Op]) -> Tuple[List[Op], int]:
+    """A recovered prefix → a standard checkable history.
+
+    Dangling client invocations (no completion in the prefix) complete
+    as ``:info`` — the Jepsen convention for an op that may or may not
+    have taken effect by the end of the (truncated) test — appended in
+    invocation order, and the whole sequence reindexes. Returns
+    (history, number of dangling invocations completed). Every checker
+    family accepts the result: WGL treats ``:info`` as pending forever,
+    the graph families consider only ok-completed pairs.
+    """
+    out = [op.with_() for op in ops]
+    open_: dict = {}
+    for i, op in enumerate(out):
+        if op.type == INVOKE:
+            open_[op.process] = i
+        elif op.is_completion and op.process in open_:
+            open_.pop(op.process)
+    dangling = sorted(open_.values())
+    t = max((op.time for op in out if op.time is not None), default=None)
+    for i in dangling:
+        inv = out[i]
+        out.append(inv.with_(type=INFO, time=t,
+                             error="salvaged: run crashed before "
+                                   "completion"))
+    return index(out), len(dangling)

@@ -288,3 +288,122 @@ class FaultInjector:
         if not text:
             return None
         return cls(FaultPlan.parse(text))
+
+
+# ------------------------------------------------- run-level nemesis
+
+# Run lifecycle phases, in stamp order (runtime.run's WAL stamps).
+RUN_PHASES = ("setup", "run", "teardown", "analyzed")
+
+RUN_FAULT_KINDS = ("op", "phase", "wedge")
+
+
+class RunFaultInjector:
+    """The crash nemesis for the RUN layer — $JT_RUN_FAULT.
+
+    Where FaultPlan kills the *checker* pipeline at chunk boundaries,
+    this kills the *test run* itself at seeded lifecycle points, so
+    crash-recovery parity (WAL salvage, campaign resume) is provable
+    the same way checker-fault parity is. Grammar (one spec):
+
+      * ``op:K[@R]``      — SIGKILL the process immediately after the
+                            Kth history op (0-based, WAL-durable first)
+                            of the Rth run in this process (default 0);
+      * ``phase:NAME[@R]``— SIGKILL at that phase-stamp boundary (the
+                            stamp is flushed first, so salvage sees the
+                            boundary was reached);
+      * ``wedge:K[:S]``   — the Kth barrier arrival (0-based, process-
+                            wide) sleeps S seconds (default 3600) —
+                            wedging a worker past the barrier deadline
+                            so retirement is exercised, not simulated.
+
+    Kills are SIGKILL — no handlers, no flushing beyond what already
+    hit the disk: exactly the failure mode the WAL exists for. The
+    fsync-before-kill for ``op:K`` is what makes schedules
+    deterministic: salvage recovers exactly ops 0..K, every time.
+    """
+
+    def __init__(self, kind: str, arg, run: int = 0,
+                 wedge_s: float = 3600.0):
+        # ValueError, not assert: a typo'd $JT_RUN_FAULT must fail
+        # loudly even under -O — a silently inert crash nemesis turns
+        # every durability run into a vacuous pass.
+        if kind not in RUN_FAULT_KINDS:
+            raise ValueError(f"unknown run fault kind {kind!r} "
+                             f"(kinds: {RUN_FAULT_KINDS})")
+        if kind == "phase" and arg not in RUN_PHASES:
+            raise ValueError(f"unknown run phase {arg!r} "
+                             f"(phases: {RUN_PHASES})")
+        self.kind = kind
+        self.arg = arg
+        self.run = run
+        self.wedge_s = wedge_s
+        self._runs = -1          # bumped by begin_run → 0-based ordinal
+        self._arrivals = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, text: str) -> "RunFaultInjector":
+        """``op:12``, ``op:12@2``, ``phase:teardown``, ``wedge:1:0.5``."""
+        bits = text.strip().split(":")
+        kind = bits[0]
+        if kind == "op":
+            arg, run = bits[1], 0
+            if "@" in arg:
+                arg, r = arg.split("@")
+                run = int(r)
+            return cls("op", int(arg), run)
+        if kind == "phase":
+            arg, run = bits[1], 0
+            if "@" in arg:
+                arg, r = arg.split("@")
+                run = int(r)
+            return cls("phase", arg, run)
+        if kind == "wedge":
+            wedge_s = float(bits[2]) if len(bits) > 2 else 3600.0
+            return cls("wedge", int(bits[1]), wedge_s=wedge_s)
+        raise ValueError(f"unknown run fault {text!r} "
+                         f"(kinds: {RUN_FAULT_KINDS})")
+
+    @classmethod
+    def from_env(cls) -> Optional["RunFaultInjector"]:
+        text = os.environ.get("JT_RUN_FAULT")
+        if not text:
+            return None
+        return cls.parse(text)
+
+    def begin_run(self) -> None:
+        """Called once per runtime.run — op/phase specs target one run
+        ordinal, so seed campaigns can kill mid-campaign."""
+        with self._lock:
+            self._runs += 1
+
+    def _kill(self) -> None:
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_op(self, wal, ordinal: int) -> None:
+        """WAL hook: op ``ordinal`` just appended. For a matching spec,
+        force the group commit (the op must be salvageable — that is
+        the fault being modeled: death AFTER acknowledge) then die."""
+        if self.kind == "op" and self._runs == self.run \
+                and ordinal == self.arg:
+            wal.sync()
+            self._kill()
+
+    def on_phase(self, wal, phase: str) -> None:
+        """WAL hook: ``phase`` stamp just written (and flushed)."""
+        if self.kind == "phase" and self._runs == self.run \
+                and phase == self.arg:
+            wal.sync()
+            self._kill()
+
+    def barrier_delay(self) -> float:
+        """DeadlineBarrier hook: seconds this arrival should sleep
+        before waiting (0 for non-matching arrivals)."""
+        if self.kind != "wedge":
+            return 0.0
+        with self._lock:
+            n = self._arrivals
+            self._arrivals = n + 1
+        return self.wedge_s if n == self.arg else 0.0

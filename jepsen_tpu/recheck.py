@@ -101,8 +101,12 @@ def recheck_family(store: Store, test_name: str, family: str, *,
                    independent: Optional[bool] = None,
                    accounts: Optional[int] = None,
                    balance: Optional[int] = None,
-                   resume: bool = False) -> dict:
-    """Re-analyze every stored run of ``test_name`` under ``family``.
+                   resume: bool = False,
+                   timestamps=None) -> dict:
+    """Re-analyze every stored run of ``test_name`` under ``family`` —
+    or only ``timestamps`` when given (the salvage CLI passes just the
+    runs it salvaged, so old unrelated runs neither pay re-analysis
+    nor drive the verdict/exit code).
 
     Returns the Store.recheck shape: {"valid", "runs": {ts: {"valid",
     "results"}}}. Linearizable families delegate to Store.recheck
@@ -130,9 +134,11 @@ def recheck_family(store: Store, test_name: str, family: str, *,
         "independent", independent, inv.get("independent"), False))
     if spec["kind"] == "linear":
         return store.recheck(test_name, spec["model"](),
+                             timestamps=timestamps,
                              independent=independent, resume=resume)
 
-    ts = store.tests().get(test_name, [])
+    ts = (list(timestamps) if timestamps is not None
+          else store.tests().get(test_name, []))
     units, labels = store.strain_units(test_name, ts,
                                        independent=independent)
     if not units:

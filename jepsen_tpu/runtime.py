@@ -25,9 +25,12 @@ takes over the thread (core.clj:185-205) — the thread id is
 from __future__ import annotations
 
 import logging
+import os
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional
 
 from . import gen as generator
@@ -35,16 +38,124 @@ from .checkers.core import check_safe
 from .client import Client
 from .history.core import History
 from .history.ops import Op, INVOKE, OK, FAIL, INFO, NEMESIS
-from .utils.core import Relatime
+from .utils.core import Relatime, timeout_call
 
 log = logging.getLogger("jepsen.runtime")
 
 COMPLETION_TYPES = (OK, FAIL, INFO)
 
+# Resilience counters every run carries (test["resilience"]): run-level
+# degradations that kept the run alive instead of killing it — the
+# run-layer analog of BucketScheduler.stats.
+RESILIENCE_COUNTERS = ("barrier_timeouts", "workers_retired",
+                      "snarf_timeouts")
+
+_COUNTER_LOCK = threading.Lock()
+
+
+def _bump(test: dict, key: str, n: int = 1) -> None:
+    res = test.get("resilience")
+    if res is None:
+        return
+    with _COUNTER_LOCK:
+        res[key] = res.get(key, 0) + n
+
+
+# The process-wide run-fault nemesis ($JT_RUN_FAULT) — one injector so
+# run ordinals count across a whole seed campaign. Resolved lazily and
+# cached; tests exercise it in subprocesses, where the env is fresh.
+_RUN_FAULT: Optional[Any] = None
+_RUN_FAULT_INITED = False
+
+
+def run_fault_injector():
+    global _RUN_FAULT, _RUN_FAULT_INITED
+    if not _RUN_FAULT_INITED:
+        from .ops.faults import RunFaultInjector
+        _RUN_FAULT = RunFaultInjector.from_env()
+        _RUN_FAULT_INITED = True
+    return _RUN_FAULT
+
+
+class DeadlineBarrier:
+    """``threading.Barrier`` with a deadline (``JT_BARRIER_TIMEOUT_S``,
+    default 300 s — generous next to any healthy setup phase).
+
+    A phase that cannot assemble within the deadline breaks ONCE: the
+    barrier retires (every later wait, including the wedged worker's
+    eventual arrival, is a no-op), arrived workers proceed, and the
+    break is counted in the run's resilience counters — a wedged worker
+    costs the run its phase alignment, never its life (the reference's
+    bare ``.await`` deadlocks forever, core.clj:34-39)."""
+
+    def __init__(self, parties: int, counters: Optional[dict] = None,
+                 timeout_s: Optional[float] = None, run_fault=None):
+        self.parties = parties
+        self.timeout_s = (
+            float(os.environ.get("JT_BARRIER_TIMEOUT_S", "300"))
+            if timeout_s is None else float(timeout_s))
+        self.counters = counters
+        self.run_fault = run_fault
+        self._b = threading.Barrier(parties)
+        self._dead = False
+        self._waiting = 0
+        self._lock = threading.Lock()
+
+    @property
+    def broken(self) -> bool:
+        return self._dead
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        if self.run_fault is not None:
+            delay = self.run_fault.barrier_delay()
+            if delay > 0:
+                log.warning("run nemesis: wedging this barrier arrival "
+                            "for %.1fs", delay)
+                time.sleep(delay)
+        if self._dead:
+            return -1        # retired barrier: phase alignment is gone
+        with self._lock:
+            self._waiting += 1
+        try:
+            return self._b.wait(self.timeout_s if timeout is None
+                                else timeout)
+        except threading.BrokenBarrierError:
+            first = wedged = 0
+            with self._lock:
+                if not self._dead:
+                    self._dead = True
+                    first = 1
+                    # Everyone who arrived is in _waiting; the
+                    # difference is the wedged workers being retired.
+                    # Best-effort: a wedged worker arriving in the
+                    # break window can slip into _waiting first and
+                    # undercount itself — the counter is triage
+                    # signal, not an invariant.
+                    wedged = max(0, self.parties - self._waiting)
+            if first:
+                self._count("barrier_timeouts", 1)
+                self._count("workers_retired", wedged)
+                log.warning(
+                    "barrier broke after %.1fs (%d of %d parties "
+                    "arrived): retiring %d wedged worker(s) and the "
+                    "barrier; the run stays alive", self.timeout_s,
+                    self.parties - wedged, self.parties, wedged)
+            return -1
+        finally:
+            with self._lock:
+                self._waiting -= 1
+
+    def _count(self, key: str, n: int = 1) -> None:
+        if self.counters is not None and n:
+            with _COUNTER_LOCK:
+                self.counters[key] = self.counters.get(key, 0) + n
+
 
 def synchronize(test: dict) -> None:
     """Block until all nodes arrive (core.clj:34-39). Used by DB/OS
-    implementations that need cluster-wide phases during setup."""
+    implementations that need cluster-wide phases during setup. Waits
+    carry the run's barrier deadline: a wedged node breaks the phase,
+    it does not deadlock the run (DeadlineBarrier)."""
     b = test.get("barrier")
     if b is not None:
         b.wait()
@@ -168,8 +279,12 @@ def _setup_clients(test: dict) -> List[Client]:
 
 def run_case(test: dict) -> List[Op]:
     """Spawn nemesis + workers, run one case, return its history
-    (run-case!, core.clj:275-313)."""
-    history = History()
+    (run-case!, core.clj:275-313). Every append streams into the run's
+    live WAL (history/wal.py) when one is attached — the crash-durable
+    twin of the in-memory history."""
+    wal = test.get("wal")
+    history = History(
+        on_append=wal.append_op if wal is not None else None)
     test = {**test, "history": history}
     test["active_histories"].add(history)
 
@@ -237,19 +352,46 @@ def run_case(test: dict) -> List[Op]:
     return history.ops()
 
 
+_SNARF_TIMED_OUT = object()
+
+
 def snarf_logs(test: dict) -> None:
-    """Download db log files from every node (core.clj:92-123)."""
+    """Download db log files from every node (core.clj:92-123). Each
+    node's snarf runs under a retry (control.util.with_retry — one
+    dropped connection doesn't lose the file) AND a hard deadline
+    (``JT_SNARF_TIMEOUT_S``, default 120 s per file), so one hung SSH
+    can't stall teardown indefinitely; expiries are logged and counted
+    as ``snarf_timeouts`` in the run's resilience counters."""
     db = test.get("db")
     store = test.get("store_handle")
     if db is None or store is None or not hasattr(db, "log_files"):
         return
-    from .control.core import on_nodes, download
+    from .control.core import _ctx, download, on_nodes, with_session
+    from .control.util import with_retry
+
+    deadline_s = float(os.environ.get("JT_SNARF_TIMEOUT_S", "120"))
 
     def snarf(t, node):
+        # The control session is thread-local; the deadline runs the
+        # download on a watchdog thread, so rebind this node's session
+        # there explicitly.
+        host, sess = _ctx.host, _ctx.session
+
+        def fetch(remote, local):
+            with with_session(host, sess):
+                return with_retry(download, remote, local)
+
         for remote in db.log_files(t, node) or []:
             local = store.path(str(node), remote.lstrip("/"))
             try:
-                download(remote, local)
+                got = timeout_call(deadline_s, _SNARF_TIMED_OUT,
+                                   fetch, remote, local)
+                if got is _SNARF_TIMED_OUT:
+                    _bump(test, "snarf_timeouts")
+                    log.warning(
+                        "snarf of %s from %s blew the %.0fs deadline; "
+                        "abandoning the file (teardown continues)",
+                        remote, node, deadline_s)
             except Exception as e:
                 log.info("couldn't download %s from %s: %s", remote, node, e)
 
@@ -273,49 +415,104 @@ def _on_nodes_local(test: dict, f: Callable) -> None:
         raise errs[0]
 
 
+def _open_wal(test: dict, run_fault=None):
+    """Attach a live history WAL to a stored run: the header carries
+    the scrubbed test map, seed, and the initial ``setup`` phase stamp
+    (history/wal.py). Storeless runs get no WAL — there is no durable
+    directory to recover into."""
+    store = test.get("store_handle")
+    if store is None:
+        return None
+    from .history.wal import HistoryWAL, WAL_FILE
+    from .store import NONSERIALIZABLE_KEYS, _scrub
+    clean = {k: _scrub(v) for k, v in test.items()
+             if k not in NONSERIALIZABLE_KEYS}
+    return HistoryWAL(store.path(WAL_FILE),
+                      header={"test": clean, "seed": test.get("seed")},
+                      run_fault=run_fault)
+
+
 def run(test: dict, analyze: bool = True) -> dict:
     """Run a complete test; returns the test dict with :history and
     :results filled in (core.clj:329-436). ``analyze=False`` stops
     after the history is recorded and persisted — the batch mode
-    (run_seeds) pools the analysis phase across runs."""
+    (run_seeds) pools the analysis phase across runs.
+
+    Stored runs are crash-durable: every op streams into a live WAL as
+    it lands, phase stamps mark each lifecycle transition, and a run
+    killed at ANY point salvages to a checkable history
+    (Store.salvage / ``jepsen-tpu salvage``)."""
     test = dict(test)
     nodes = test.get("nodes") or []
     test.setdefault("concurrency", max(1, len(nodes)))
     test.setdefault("rng", __import__("random").Random(test.get("seed")))
-    test["barrier"] = threading.Barrier(len(nodes)) if nodes else None
+    test.setdefault("resilience",
+                    {k: 0 for k in RESILIENCE_COUNTERS})
+    rf = run_fault_injector()
+    if rf is not None:
+        rf.begin_run()
+    test["barrier"] = DeadlineBarrier(
+        len(nodes), counters=test["resilience"],
+        run_fault=rf) if nodes else None
     test["active_histories"] = set()
 
     store = test.get("store_handle")
     os_ = test.get("os")
     db = test.get("db")
+    wal = _open_wal(test, run_fault=rf)
+    test["wal"] = wal
 
     from contextlib import ExitStack
-    with ExitStack() as stack:
-        if test.get("ssh") is not None:
-            from .control.core import with_ssh
-            stack.enter_context(with_ssh(test))
-        try:
-            if os_ is not None:
-                _on_nodes_local(test, os_.setup)
+    try:
+        with ExitStack() as stack:
+            if test.get("ssh") is not None:
+                from .control.core import with_ssh
+                stack.enter_context(with_ssh(test))
             try:
-                if db is not None:
-                    _on_nodes_local(test, db.cycle)
-                    if hasattr(db, "setup_primary") and nodes:
-                        db.setup_primary(test, primary(test))
-                test["clock"] = Relatime()
-                history = run_case(test)
-                test["history"] = history
-                if store is not None:
-                    store.save_history(history, model=test.get("model"))
-            except BaseException:
-                snarf_logs(test)  # emergency log dump (core.clj:133-137)
-                raise
+                if os_ is not None:
+                    _on_nodes_local(test, os_.setup)
+                try:
+                    if db is not None:
+                        _on_nodes_local(test, db.cycle)
+                        if hasattr(db, "setup_primary") and nodes:
+                            db.setup_primary(test, primary(test))
+                    test["clock"] = Relatime()
+                    if wal is not None:
+                        wal.stamp_phase("run")
+                    history = run_case(test)
+                    test["history"] = history
+                    if store is not None:
+                        store.save_history(history,
+                                           model=test.get("model"))
+                    if wal is not None:
+                        wal.stamp_phase("teardown")
+                except BaseException:
+                    snarf_logs(test)  # emergency dump (core.clj:133-137)
+                    raise
+                finally:
+                    if db is not None:
+                        _on_nodes_local(test, db.teardown)
             finally:
-                if db is not None:
-                    _on_nodes_local(test, db.teardown)
-        finally:
-            if os_ is not None:
-                _on_nodes_local(test, os_.teardown)
+                if os_ is not None:
+                    _on_nodes_local(test, os_.teardown)
+    except BaseException as e:
+        # The WAL stays ON DISK (that is its whole purpose) but this
+        # process is done writing it. A marker distinguishes a run
+        # that FAILED (harness/setup exception — this code ran) from
+        # one that was killed outright (no marker): a later salvage
+        # reports the error instead of presenting a setup-crashed
+        # run's empty prefix as a clean recovery.
+        if store is not None:
+            try:
+                store.write_json(
+                    "harness-error.json",
+                    {"error": repr(e),
+                     "phase": wal.phase if wal is not None else None})
+            except Exception:
+                pass
+        if wal is not None:
+            wal.close()
+        raise
 
     if not analyze:
         return test
@@ -325,12 +522,21 @@ def run(test: dict, analyze: bool = True) -> dict:
 def analyze_run(test: dict) -> dict:
     """Analysis phase: run the checker over the recorded history and
     persist results (core.clj:414-436's tail). Split from ``run`` so
-    the seeded batch mode can pool device dispatches across runs."""
+    the seeded batch mode can pool device dispatches across runs.
+    Completing it stamps the WAL ``analyzed`` — the run is no longer
+    salvageable because there is nothing left to lose."""
     store = test.get("store_handle")
-    test["results"] = check_safe(test.get("checker"), test,
-                                 test.get("model"), test["history"])
+    results = check_safe(test.get("checker"), test,
+                         test.get("model"), test["history"])
+    if test.get("resilience") and any(test["resilience"].values()):
+        results.setdefault("resilience", dict(test["resilience"]))
+    test["results"] = results
     if store is not None:
         store.save_results(test["results"])
+    wal = test.get("wal")
+    if wal is not None:
+        wal.stamp_phase("analyzed")
+        wal.close()
     valid = test["results"].get("valid")
     log.info("Analysis complete: valid? = %s", valid)
     return test
@@ -388,8 +594,54 @@ def _linear_unit_kinds(checker) -> tuple:
     return per_key, whole
 
 
+def _rehydrate_seed(test: dict, seed, state: dict, root,
+                    ckpt) -> Optional[dict]:
+    """A checkpointed seed: its cluster execution never re-runs.
+    ``done`` seeds load their stored history; ``started`` seeds (the
+    campaign died mid-run) salvage their WAL prefix first — either way
+    the history joins the pooled dispatch and analysis re-runs, so a
+    resumed campaign's verdict set matches an uninterrupted one's.
+
+    Returns None when nothing is recoverable — a campaign killed in
+    the window between the ``started`` checkpoint record and the WAL
+    header fsync leaves a dir with no durable ops; that seed must
+    simply re-run fresh, not wedge every future resume."""
+    from .history.codec import read_jsonl
+    from .store import StoreHandle
+
+    d = Path(state["dir"])
+    name, ts = d.parent.name, d.name
+    if not state["done"]:
+        try:
+            stats = root.salvage(name, ts, model=test.get("model"))
+        except Exception as e:
+            log.warning("campaign resume: seed %s has no salvageable "
+                        "WAL (%s); re-running it fresh", seed, e)
+            return None
+        log.info("campaign resume: salvaged seed %s (%d ops, %d "
+                 "dangling completed, died in phase %s)", seed,
+                 stats["ops"], stats["dangling_completed"],
+                 stats["phase"])
+        ckpt.done(int(seed))
+    test = dict(test)
+    test["store_handle"] = StoreHandle(d, store=root, test_name=name)
+    try:
+        test["history"] = read_jsonl(d / "history.jsonl")
+    except Exception as e:
+        # A done seed whose stored history was lost (dir deleted,
+        # file corrupted beyond its torn tail): same rule as above —
+        # re-run fresh rather than wedge every future resume.
+        log.warning("campaign resume: seed %s has no usable stored "
+                    "history (%s); re-running it fresh", seed, e)
+        return None
+    test["resumed_seed"] = True
+    return test
+
+
 def run_seeds(builder: Callable[[int], dict], seeds,
-              store: bool = True) -> List[dict]:
+              store: bool = True, store_root=None,
+              checkpoint: bool = False,
+              resume: bool = False) -> List[dict]:
     """The north-star batch mode (BASELINE.md): replay one generator
     under N nemesis seeds and feed the whole history batch to ONE
     pooled device dispatch.
@@ -405,17 +657,48 @@ def run_seeds(builder: Callable[[int], dict], seeds,
     The reference's run! checks each run as it completes
     (core.clj:329-436); pooling the batch axis across seeds is the
     device-native reformulation this framework exists for.
+
+    ``checkpoint=True`` (stored campaigns only) journals per-seed
+    progress to ``store/<name>/campaign.jsonl``
+    (store.CampaignCheckpoint): ``started`` when a seed's run dir is
+    created, ``done`` when its history lands durably. A killed
+    campaign relaunched with ``resume=True`` re-runs ZERO completed
+    seeds — done seeds rehydrate their stored history, the in-flight
+    seed salvages its WAL prefix, and only the remaining seeds
+    execute; salvaged-prefix and fresh histories pool into the one
+    batched device dispatch alike. The checkpoint deletes itself when
+    the whole campaign (execution AND analysis) completes.
     """
     from .independent import history_keys, subhistory
 
+    seeds = list(seeds)
     tests: List[dict] = []
     handles: List = []
+    ckpt = None
     try:
         for s in seeds:
             t = builder(s)
             if store:
                 from . import store as store_mod
-                store_mod.attach(t)
+                root = store_root if store_root is not None \
+                    else store_mod.DEFAULT
+                if checkpoint and ckpt is None:
+                    name = t.get("name", "noname")
+                    ckpt = store_mod.CampaignCheckpoint(
+                        root.base / name / "campaign.jsonl",
+                        {"name": name,
+                         "seeds": [int(x) for x in seeds]},
+                        resume=resume)
+                state = ckpt.seed_state(s) if ckpt is not None else None
+                if state is not None:
+                    re = _rehydrate_seed(t, s, state, root, ckpt)
+                    if re is not None:
+                        handles.append(re["store_handle"])
+                        tests.append(re)
+                        continue
+                store_mod.attach(t, root)
+                if ckpt is not None:
+                    ckpt.started(int(s), t["store_handle"].dir)
             # Record the handle BEFORE running: a mid-batch crash must
             # still detach this run's log handler in the finally below.
             h = t.get("store_handle")
@@ -430,6 +713,8 @@ def run_seeds(builder: Callable[[int], dict], seeds,
                 # seed's lines into this run's run.log.
                 if h is not None:
                     h.stop_logging()
+            if ckpt is not None:
+                ckpt.done(int(s))
 
         assert all(t.get("model") == tests[0].get("model")
                    for t in tests), \
@@ -470,8 +755,15 @@ def run_seeds(builder: Callable[[int], dict], seeds,
             finally:
                 if h is not None:
                     h.stop_logging()
+        if ckpt is not None:
+            # Every seed executed AND analyzed: the checkpoint has
+            # served its purpose.
+            ckpt.finish()
     finally:
-        # Safety net for mid-batch crashes (stop_logging is idempotent).
+        # Safety net for mid-batch crashes (stop_logging is idempotent;
+        # an interrupted campaign keeps its checkpoint on disk).
+        if ckpt is not None:
+            ckpt.close()
         for handle in handles:
             handle.stop_logging()
     return tests

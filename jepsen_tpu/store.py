@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from .history.codec import read_jsonl, write_jsonl, write_txt
 from .history.ops import Op
+from .history.wal import WAL_FILE
 
 BASE = Path("store")
 
@@ -45,8 +46,17 @@ JOURNAL_MAGIC = "JTJRNL1"
 NONSERIALIZABLE_KEYS = {
     "db", "os", "net", "client", "nemesis", "checker", "model", "generator",
     "barrier", "clock", "rng", "sessions", "active_histories", "history",
-    "results", "store_handle", "ssh",
+    "results", "store_handle", "ssh", "wal",
 }
+
+# Campaign-checkpoint header magic (CampaignCheckpoint).
+CAMPAIGN_MAGIC = "JTCAMP1"
+
+
+class CampaignMismatch(ValueError):
+    """An explicit campaign resume named a checkpoint belonging to a
+    DIFFERENT campaign (key mismatch) — refused rather than clobbered,
+    because the checkpoint is the only resume point."""
 
 
 def _scrub(x):
@@ -58,11 +68,20 @@ def _scrub(x):
 
 
 class StoreHandle:
-    """One run's directory + file helpers."""
+    """One run's directory + file helpers.
 
-    def __init__(self, dir: Path):
+    ``store``/``test_name`` (set by Store.create) let ``save_results``
+    promote the run's ``latest`` symlinks — which move ONLY once
+    results exist, so ``latest`` never points at a run directory a
+    crash left without a verdict (the ``latest-incomplete`` link tracks
+    those instead)."""
+
+    def __init__(self, dir: Path, store: Optional["Store"] = None,
+                 test_name: Optional[str] = None):
         self.dir = Path(dir)
         self.dir.mkdir(parents=True, exist_ok=True)
+        self.store = store
+        self.test_name = test_name
         self._log_handler: Optional[logging.Handler] = None
 
     # ---------------------------------------------------------- paths
@@ -151,8 +170,12 @@ class StoreHandle:
         os.replace(tmp, target)
 
     def save_results(self, results: dict) -> None:
-        """Phase 2: analysis output (save-2!, store.clj:292-302)."""
+        """Phase 2: analysis output (save-2!, store.clj:292-302).
+        Completing phase 2 is what promotes this run to ``latest``."""
         self.write_json("results.json", results)
+        if self.store is not None and self.test_name is not None:
+            self.store.update_symlinks(self.test_name, self.dir)
+            self.store.retire_incomplete_links(self.dir)
 
     # -------------------------------------------------------- logging
     def start_logging(self) -> None:
@@ -184,33 +207,132 @@ class Store:
             while (self.base / test_name / ts).exists():
                 n += 1
                 ts = f"{base}.{n}"
-        h = StoreHandle(self.base / test_name / ts)
-        self.update_symlinks(test_name, h.dir)
+        h = StoreHandle(self.base / test_name / ts, store=self,
+                        test_name=test_name)
+        # A fresh run has no results yet: it is the newest INCOMPLETE
+        # run. ``latest`` moves only when save_results lands, so a
+        # crash here never leaves ``latest`` pointing at a verdictless
+        # directory.
+        self.update_symlinks(test_name, h.dir, kind="latest-incomplete")
         return h
 
-    def update_symlinks(self, test_name: str, target: Path) -> None:
-        """Maintain store/<name>/latest and store/latest
-        (store.clj:235-247)."""
-        for link in (self.base / test_name / "latest", self.base / "latest"):
+    def update_symlinks(self, test_name: str, target: Path,
+                        kind: str = "latest") -> None:
+        """Maintain store/<name>/<kind> and store/<kind>
+        (store.clj:235-247). ``kind`` is ``latest`` (completed runs —
+        moved by save_results) or ``latest-incomplete`` (the newest
+        crashed/salvageable run — moved at create time)."""
+        for link in (self.base / test_name / kind, self.base / kind):
             link.parent.mkdir(parents=True, exist_ok=True)
             if link.is_symlink() or link.exists():
                 link.unlink()
             link.symlink_to(os.path.relpath(target, link.parent))
 
+    def retire_incomplete_links(self, target: Path) -> None:
+        """Drop any ``latest-incomplete`` link pointing at a run that
+        just completed — it is no longer incomplete."""
+        target = Path(target).resolve()
+        for link in (target.parent / "latest-incomplete",
+                     self.base / "latest-incomplete"):
+            try:
+                if link.is_symlink() and link.resolve() == target:
+                    link.unlink()
+            except OSError:
+                pass
+
     # ---------------------------------------------------------- browse
     def tests(self) -> Dict[str, List[str]]:
-        """{test-name: [timestamps]} of stored runs (store.clj tests)."""
+        """{test-name: [timestamps]} of stored runs (store.clj tests).
+        Symlinks (latest, latest-incomplete) are never runs."""
         out: Dict[str, List[str]] = {}
         if not self.base.exists():
             return out
         for name_dir in sorted(self.base.iterdir()):
-            if not name_dir.is_dir() or name_dir.name == "latest":
+            if (not name_dir.is_dir() or name_dir.is_symlink()
+                    or name_dir.name == "latest"):
                 continue
             runs = [d.name for d in sorted(name_dir.iterdir())
-                    if d.is_dir() and d.name != "latest"]
+                    if d.is_dir() and not d.is_symlink()
+                    and d.name != "latest"]
             if runs:
                 out[name_dir.name] = runs
         return out
+
+    def incomplete(self, include_salvaged: bool = False) -> List[tuple]:
+        """(test_name, ts) of crashed/salvageable runs: a live-WAL
+        segment exists but no results.json — the run died (or is still
+        running) somewhere between setup and analysis. Salvage
+        materializes their checkable history; ``latest`` never points
+        at them.
+
+        Runs already salvaged (salvage.json at least as new as the
+        WAL) are skipped so repeat sweeps converge instead of
+        re-salvaging and re-checking the same crash forever;
+        ``include_salvaged=True`` lists them anyway."""
+        out = []
+        for name, runs in self.tests().items():
+            for ts in runs:
+                d = self.base / name / ts
+                if not (d / WAL_FILE).exists() or \
+                        (d / "results.json").exists():
+                    continue
+                if not include_salvaged:
+                    try:
+                        sj = d / "salvage.json"
+                        if sj.exists() and sj.stat().st_mtime >= \
+                                (d / WAL_FILE).stat().st_mtime:
+                            continue
+                    except OSError:
+                        pass
+                out.append((name, ts))
+        return out
+
+    def salvage(self, test_name: str, ts: str, model=None) -> dict:
+        """Salvage-to-verdict, step 1: reconstruct a checkable run from
+        a (possibly torn) live WAL. Drops the torn tail, completes
+        dangling invocations as ``:info``, and materializes the
+        standard ``history.jsonl``/``history.txt`` (+ the machine-form
+        sidecar when ``model`` is given, so the batched replay seam
+        skips the text parse) — after which ``Store.recheck``, every
+        checker family, and the web UI work on the crashed run
+        unchanged. ``test.json`` is restored from the WAL header if the
+        crash predated it. Returns the salvage stats (also persisted as
+        ``salvage.json``)."""
+        from .history.wal import read_wal, salvage_history
+
+        d = self.run_dir(test_name, ts)
+        wal_path = d / WAL_FILE
+        if not wal_path.exists():
+            raise FileNotFoundError(f"{wal_path}: no WAL to salvage")
+        w = read_wal(wal_path)
+        history, dangling = salvage_history(w["ops"])
+        h = StoreHandle(d, store=self, test_name=test_name)
+        h.save_history(history, model=model)
+        if not (d / "test.json").exists():
+            h.write_json("test.json", w["header"].get("test") or {})
+        phases = [p for p, _ in w["phases"]]
+        stats = {
+            "salvaged": True,
+            "ops": len(history),
+            "wal_ops": len(w["ops"]),
+            "dangling_completed": dangling,
+            "torn_tail": w["torn"],
+            "phase": phases[-1] if phases else
+            w["header"].get("phase", "setup"),
+            "seed": w["header"].get("seed"),
+        }
+        # A run that FAILED (harness exception) rather than being
+        # killed left a marker; surface it so an empty salvaged
+        # prefix is never mistaken for a clean recovery.
+        he = d / "harness-error.json"
+        if he.exists():
+            try:
+                stats["harness_error"] = json.loads(
+                    he.read_text()).get("error")
+            except Exception:
+                stats["harness_error"] = "unreadable harness-error.json"
+        h.write_json("salvage.json", stats)
+        return stats
 
     def run_dir(self, test_name: str, ts: str = "latest") -> Path:
         return self.base / test_name / ts
@@ -591,6 +713,127 @@ class ChunkJournal:
 
     def finish(self) -> None:
         """The run completed: the journal has served its purpose."""
+        self.close()
+        try:
+            self.path.unlink()
+        except FileNotFoundError:
+            pass
+
+
+class CampaignCheckpoint:
+    """Durable seed-campaign progress — run_seeds' write-ahead log.
+
+    One JSON line per transition, fsynced: line 1 is a header binding
+    the checkpoint to one campaign (``{"campaign": "JTCAMP1", "key":
+    {...}}`` — test name + seed list; resuming against a mismatched
+    checkpoint raises CampaignMismatch rather than clobbering the only
+    resume point), then ``{"seed": s, "dir": ..., "status":
+    "started"}`` when a seed's run dir is created and ``{"seed": s,
+    "status": "done"}`` when its execution completes (history durably
+    saved). A killed campaign resumes running only the remaining seeds:
+    ``done`` seeds rehydrate their stored history, ``started`` seeds
+    salvage their WAL prefix, absent seeds run fresh. Torn final lines
+    are tolerated and truncated before appending (the ChunkJournal
+    discipline). ``finish()`` deletes the file — a checkpoint only
+    outlives an interrupted campaign.
+    """
+
+    def __init__(self, path, key: dict, resume: bool = False):
+        self.path = Path(path)
+        self.key = dict(key)
+        self._runs: Dict[int, dict] = {}   # seed -> {"dir", "done"}
+        self._good_end = 0
+        if resume and self.path.exists():
+            self._load()
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        if self._runs:
+            with open(self.path, "r+b") as f:
+                f.truncate(self._good_end)
+            self._f = open(self.path, "a")
+        else:
+            self._f = open(self.path, "w")
+            self._f.write(json.dumps(
+                {"campaign": CAMPAIGN_MAGIC, "key": self.key}) + "\n")
+            self._flush()
+
+    def _load(self) -> None:
+        try:
+            data = self.path.read_bytes()
+            pos = 0
+            header_seen = False
+            while pos < len(data):
+                nl = data.find(b"\n", pos)
+                if nl < 0:
+                    break
+                try:
+                    e = json.loads(data[pos:nl])
+                    if not header_seen:
+                        if e.get("campaign") != CAMPAIGN_MAGIC or \
+                                e.get("key") != self.key:
+                            # An EXPLICIT resume against the wrong
+                            # campaign must refuse, not overwrite the
+                            # only resume point (a mistyped --seeds
+                            # would otherwise destroy all progress).
+                            raise CampaignMismatch(
+                                f"campaign checkpoint {self.path} "
+                                f"belongs to a different campaign: "
+                                f"stored key {e.get('key')!r} != "
+                                f"{self.key!r}; start a fresh "
+                                f"campaign (without --resume) to "
+                                f"replace it")
+                        header_seen = True
+                    elif e.get("status") == "started":
+                        self._runs[int(e["seed"])] = {
+                            "dir": e["dir"], "done": False}
+                    elif e.get("status") == "done":
+                        r = self._runs.get(int(e["seed"]))
+                        if r is not None:
+                            r["done"] = True
+                except CampaignMismatch:
+                    raise
+                except Exception:
+                    break
+                pos = nl + 1
+                self._good_end = pos
+        except CampaignMismatch:
+            raise
+        except Exception:
+            self._runs = {}
+            self._good_end = 0
+
+    def _flush(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def seed_state(self, seed: int) -> Optional[dict]:
+        """{"dir": ..., "done": bool} for a seed a prior campaign
+        already touched, else None."""
+        r = self._runs.get(int(seed))
+        return dict(r) if r is not None else None
+
+    def started(self, seed: int, dir) -> None:
+        self._runs[int(seed)] = {"dir": str(dir), "done": False}
+        self._f.write(json.dumps(
+            {"seed": int(seed), "dir": str(dir), "status": "started"})
+            + "\n")
+        self._flush()
+
+    def done(self, seed: int) -> None:
+        r = self._runs.get(int(seed))
+        if r is not None:
+            r["done"] = True
+        self._f.write(json.dumps(
+            {"seed": int(seed), "status": "done"}) + "\n")
+        self._flush()
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+    def finish(self) -> None:
+        """The campaign completed: every seed ran and analyzed."""
         self.close()
         try:
             self.path.unlink()

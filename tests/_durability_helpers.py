@@ -1,0 +1,143 @@
+"""Shared builders for the run-durability tests (test_durability.py).
+
+Deterministic, concurrency-1, seeded test maps whose histories are
+bit-identical across executions — so a run SIGKILLed mid-flight can be
+salvaged and compared field-for-field against the same prefix of an
+uncrashed run. Covers the two checker families the acceptance gate
+names: register (WGL linearizability) and list-append (dependency-graph
+cycle checking).
+
+Run as a script, this module executes one stored run or one seed
+campaign — the subprocess the kill tests SIGKILL via $JT_RUN_FAULT:
+
+    python _durability_helpers.py run register <store-base> <seed> <corrupt>
+    python _durability_helpers.py run la       <store-base> <seed> <stale>
+    python _durability_helpers.py campaign     <store-base> <n-seeds> <bad-seed>
+
+(<corrupt>/<stale> of 0 means a clean run.)
+"""
+import random
+import sys
+
+from jepsen_tpu import gen
+from jepsen_tpu.client import Client
+from jepsen_tpu.testing import AtomClient, atom_cas_test, noop_test
+
+
+class CorruptingAtomClient(AtomClient):
+    """Deterministically corrupts the Nth successful read (an
+    unwritable value) — the seeded linearizability violation the
+    verdict-parity tests rely on."""
+
+    def __init__(self, register=None, corrupt_read=None):
+        super().__init__(register)
+        self.corrupt_read = corrupt_read
+        self.reads = 0
+
+    def setup(self, test, node):
+        return self          # concurrency 1: one shared client
+
+    def invoke(self, test, op):
+        out = super().invoke(test, op)
+        if out["f"] == "read" and out["type"] == "ok" \
+                and self.corrupt_read is not None:
+            self.reads += 1
+            if self.reads == self.corrupt_read:
+                out = {**out, "value": 999}
+        return out
+
+
+def register_test(seed=7, n_ops=40, corrupt_read=None, **overrides):
+    """A fully deterministic CAS-register test: single worker, seeded
+    generator, in-process atom register. ``corrupt_read=N`` makes the
+    Nth read observe 999 (never written) — invalid from that op on."""
+    return atom_cas_test(
+        name="reg-crash", n_ops=n_ops, concurrency=1, seed=seed,
+        client=CorruptingAtomClient(corrupt_read=corrupt_read),
+        **overrides)
+
+
+class ListAppendClient(Client):
+    """In-process list-append store. ``stale_read=N`` serves the Nth
+    read MINUS its newest element — an element whose append completed
+    before the read invoked, i.e. exactly a G2 anti-dependency cycle
+    (workloads.synth.synth_la_history's corruption, live)."""
+
+    def __init__(self, stale_read=None):
+        self.lists = {}
+        self.stale_read = stale_read
+        self.reads = 0
+
+    def setup(self, test, node):
+        return self          # concurrency 1: one shared client
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        if op["f"] == "append":
+            self.lists.setdefault(k, []).append(v)
+            return {**op, "type": "ok"}
+        obs = list(self.lists.get(k, []))
+        self.reads += 1
+        if self.reads == self.stale_read and obs:
+            obs = obs[:-1]
+        return {**op, "type": "ok", "value": [k, obs]}
+
+
+def la_ops(n_ops, n_keys=2, seed=0):
+    """A seeded deterministic op sequence: ~60% appends with globally
+    unique elements, the rest reads."""
+    rng = random.Random(seed)
+    counter = 0
+    out = []
+    for _ in range(n_ops):
+        k = rng.randrange(n_keys)
+        if rng.random() < 0.6:
+            counter += 1
+            out.append({"f": "append", "value": [k, counter]})
+        else:
+            out.append({"f": "read", "value": [k, None]})
+    return out
+
+
+def la_test(seed=0, n_ops=30, stale_read=None, **overrides):
+    """A deterministic list-append test checked by the dependency-graph
+    cycle checker (the second acceptance family)."""
+    from jepsen_tpu.checkers.cycle import cycle_checker
+
+    return noop_test(
+        name="la-crash", concurrency=1, seed=seed,
+        client=ListAppendClient(stale_read=stale_read),
+        generator=gen.clients(gen.seq(la_ops(n_ops, seed=seed))),
+        checker=cycle_checker("list-append"),
+        **overrides)
+
+
+def _main(argv):
+    from jepsen_tpu import runtime
+    from jepsen_tpu.store import Store, attach
+
+    cmd = argv[0]
+    if cmd == "run":
+        kind, base, seed, knob = (argv[1], argv[2], int(argv[3]),
+                                  int(argv[4]))
+        knob = knob or None
+        t = (register_test(seed=seed, corrupt_read=knob)
+             if kind == "register" else la_test(seed=seed,
+                                                stale_read=knob))
+        attach(t, Store(base))
+        runtime.run(t)
+        return 0
+    if cmd == "campaign":
+        base, n_seeds, bad = argv[1], int(argv[2]), int(argv[3])
+        runtime.run_seeds(
+            lambda s: register_test(
+                seed=s, n_ops=30,
+                corrupt_read=3 if s == bad else None),
+            list(range(n_seeds)), store=True, store_root=Store(base),
+            checkpoint=True)
+        return 0
+    raise SystemExit(f"unknown command {cmd!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(_main(sys.argv[1:]))

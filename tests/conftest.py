@@ -13,6 +13,14 @@ from jepsen_tpu.provision import provision_in_process
 # itself opt back in explicitly.
 os.environ.setdefault("JT_COMPILE_CACHE", "0")
 
+# The live-WAL group commit fsyncs ~20x/s at the production 50 ms
+# window — fine for one real run, a measurable tax across hundreds of
+# stored test runs on this filesystem. A wide window keeps the WAL
+# path fully exercised (header/stamp/close syncs and crash-nemesis
+# kills force their own fsyncs regardless); durability tests that
+# measure the window itself set it explicitly.
+os.environ.setdefault("JT_WAL_FLUSH_MS", "250")
+
 provision_in_process(8)
 
 
@@ -27,3 +35,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "graphs: dependency-graph cycle-checker parity gate "
                    "(fast, deterministic; runs in tier-1)")
+    config.addinivalue_line(
+        "markers", "durability: run-level crash durability — live-WAL "
+                   "salvage parity under subprocess SIGKILLs and "
+                   "seed-campaign resume (deterministic; runs in "
+                   "tier-1)")

@@ -21,7 +21,11 @@ def test_bench_emits_contract_json():
                JT_BENCH_REPEATS="1", JT_BENCH_FOLD_B="50",
                JT_BENCH_GRAPH_B="40",
                JT_BENCH_STORE_B="20", JT_BENCH_CONVERTED="200",
-               JT_BENCH_FULL_PARITY="0",
+               JT_BENCH_FULL_PARITY="0", JT_BENCH_WAL_OPS="400",
+               # Per-op commits: 400 toy ops can finish inside one
+               # 50 ms window, which would leave zero time-triggered
+               # group commits to measure.
+               JT_WAL_FLUSH_MS="0",
                JT_BENCH_LONG_B="50", JT_BENCH_LONG_OPS="500",
                JT_BENCH_XLONG_B="8", JT_BENCH_XLONG_OPS="2000")
     r = subprocess.run([sys.executable, str(REPO / "bench.py")],
@@ -57,6 +61,13 @@ def test_bench_emits_contract_json():
     assert g["anomalies"] >= 1
     assert g["vertex_buckets"]
     assert g["resilience"]["quarantined_rows"] == 0
+    # Run-durability section (ISSUE 5 acceptance): live-WAL worker-loop
+    # overhead, group-commit flush percentiles, salvage throughput.
+    rd = d["run_durability"]
+    assert rd["wal_ops"] == 400
+    assert rd["ops_per_s_wal_on"] > 0 and rd["ops_per_s_wal_off"] > 0
+    assert rd["group_commits"] > 0 and rd["flush_p99_ms"] is not None
+    assert rd["salvage_ops_per_s"] > 0
     x = d["xlong_history"]
     assert x["histories"] > 0 and x["events_per_s"] > 0
     assert x["encode_s"] >= 0 and x["device_s"] > 0   # the breakdown

@@ -1,0 +1,415 @@
+"""Run-level crash durability (the live history WAL, salvage-to-verdict,
+campaign resume — doc/resilience.md "Run-level durability").
+
+The framework's premise applied to its own run layer: a control-node
+crash at ANY lifecycle point must forfeit nothing that was durable.
+Real SIGKILLs via subprocess ($JT_RUN_FAULT, the run-level nemesis),
+deterministic concurrency-1 builders (tests/_durability_helpers.py),
+and the acceptance gate: the salvaged history's verdicts match
+field-for-field the same prefix of an uncrashed run — for both the
+register (WGL) and list-append (dependency-graph) checker families —
+and a killed seed campaign resumes re-running zero completed seeds.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from _durability_helpers import la_test, register_test
+from jepsen_tpu import runtime
+from jepsen_tpu.checkers.linearizable import wgl_check
+from jepsen_tpu.history.codec import (CorruptHistoryLine, dumps_op,
+                                      read_jsonl)
+from jepsen_tpu.history.ops import INFO, invoke_op, ok_op
+from jepsen_tpu.history.wal import (HistoryWAL, WAL_FILE, read_wal,
+                                    salvage_history)
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.runtime import DeadlineBarrier, run
+from jepsen_tpu.store import Store, attach
+
+pytestmark = pytest.mark.durability
+
+REPO = Path(__file__).resolve().parent.parent
+HELPER = Path(__file__).resolve().parent / "_durability_helpers.py"
+
+
+def _sig(o):
+    """The op fields verdicts can depend on (time excluded: wall-clock
+    differs across processes; checkers never consult it)."""
+    return (o.process, o.type, o.f, o.value, o.index, o.error)
+
+
+def kill_run(base, kind, fault, seed, knob=0):
+    """Execute one stored run in a subprocess under $JT_RUN_FAULT and
+    assert the nemesis actually SIGKILLed it."""
+    env = {**os.environ, "JT_RUN_FAULT": fault, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": str(REPO)}
+    r = subprocess.run(
+        [sys.executable, str(HELPER), "run", kind, str(base), str(seed),
+         str(knob)],
+        capture_output=True, text=True, env=env, timeout=180)
+    assert r.returncode == -signal.SIGKILL, \
+        (fault, r.returncode, r.stdout[-500:], r.stderr[-2000:])
+    return Store(base)
+
+
+# ----------------------------------------------------- WAL lifecycle
+
+def test_wal_written_and_phases_stamped(tmp_path):
+    store = Store(tmp_path / "store")
+    t = register_test(seed=3, n_ops=20)
+    attach(t, store)
+    t = run(t)
+    t["store_handle"].stop_logging()
+    d = t["store_handle"].dir
+    w = read_wal(d / WAL_FILE)
+    assert [p for p, _ in w["phases"]] == ["run", "teardown", "analyzed"]
+    assert not w["torn"]
+    assert w["header"]["seed"] == 3
+    assert w["header"]["test"]["name"] == "reg-crash"
+    # The WAL replays to EXACTLY the persisted history.
+    hist = read_jsonl(d / "history.jsonl")
+    assert [_sig(o) for o in w["ops"]] == [_sig(o) for o in hist]
+    # Completed run: promoted to latest, no longer incomplete.
+    assert (store.base / "latest").resolve() == d.resolve()
+    assert store.incomplete() == []
+    assert not (store.base / "latest-incomplete").exists()
+
+
+def test_latest_never_points_at_verdictless_run(tmp_path):
+    store = Store(tmp_path / "store")
+    t1 = run(attach(register_test(seed=1, n_ops=10), store))
+    t1["store_handle"].stop_logging()
+    d1 = t1["store_handle"].dir
+    # Second run "crashes" before analysis: no results.json ever lands.
+    t2 = run(attach(register_test(seed=2, n_ops=10), store),
+             analyze=False)
+    t2["store_handle"].stop_logging()
+    t2["wal"].close()
+    d2 = t2["store_handle"].dir
+    assert (store.base / "latest").resolve() == d1.resolve()
+    assert (store.base / "latest-incomplete").resolve() == d2.resolve()
+    assert store.incomplete() == [("reg-crash", d2.name)]
+    # ...and the symlinks are never mistaken for runs.
+    assert set(store.tests()["reg-crash"]) == {d1.name, d2.name}
+
+
+def test_wal_torn_tail_recovery(tmp_path):
+    p = tmp_path / "w.jsonl"
+    wal = HistoryWAL(p, header={"seed": 11}, flush_ms=0)
+    wal.stamp_phase("run")
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(1, "read", None)]
+    for i, op in enumerate(h):
+        op.index = i
+        wal.append_op(op)
+    wal.close()
+    # A kill mid-write leaves a partial final line.
+    p.write_bytes(p.read_bytes() + b'{"process":0,"type":"ok","f":"re')
+    w = read_wal(p)
+    assert w["torn"] is True
+    assert w["header"]["seed"] == 11
+    assert [p_ for p_, _ in w["phases"]] == ["run"]
+    assert [_sig(o) for o in w["ops"]] == [_sig(o) for o in h]
+
+
+def test_salvage_completes_dangling_as_info():
+    h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+         invoke_op(1, "read", None)]
+    for i, op in enumerate(h):
+        op.index = i
+    out, dangling = salvage_history(h)
+    assert dangling == 1
+    assert len(out) == 4
+    assert [o.index for o in out] == [0, 1, 2, 3]
+    tail = out[-1]
+    assert tail.type == INFO and tail.process == 1 and tail.f == "read"
+    assert "salvaged" in str(tail.error)
+    # Idempotent on complete histories.
+    out2, d2 = salvage_history(out)
+    assert d2 == 0 and len(out2) == 4
+
+
+# ------------------------------------------------- codec (satellite)
+
+def test_read_jsonl_names_path_and_line(tmp_path):
+    p = tmp_path / "h.jsonl"
+    good = [invoke_op(0, "write", 1), ok_op(0, "write", 1)]
+    p.write_text("\n".join(dumps_op(o) for o in good)
+                 + '\n{"process": 0, "type": "ok", "f"')
+    with pytest.raises(CorruptHistoryLine) as e:
+        read_jsonl(p)
+    assert "h.jsonl" in str(e.value) and ":3:" in str(e.value)
+    assert e.value.lineno == 3
+    prefix = read_jsonl(p, tolerant=True)
+    assert [_sig(o) for o in prefix] == \
+        [(0, "invoke", "write", 1, None, None),
+         (0, "ok", "write", 1, None, None)]
+
+
+# ------------------------------------- barrier deadline (satellite)
+
+def test_barrier_deadline_retires_wedged_worker():
+    counters = {}
+    b = DeadlineBarrier(3, counters=counters, timeout_s=0.3)
+    got = []
+    threads = [threading.Thread(target=lambda: got.append(b.wait()))
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert not any(t.is_alive() for t in threads), \
+        "arrived workers must not deadlock on a wedged peer"
+    assert counters["barrier_timeouts"] == 1
+    assert counters["workers_retired"] == 1      # 3 parties, 2 arrived
+    assert b.broken
+    # The wedged worker finally arrives: a no-op, not a deadlock — and
+    # not double-counted.
+    assert b.wait() == -1
+    assert counters["workers_retired"] == 1
+
+
+def test_run_fault_wedge_spec_delays_one_arrival():
+    from jepsen_tpu.ops.faults import RunFaultInjector
+
+    rf = RunFaultInjector.parse("wedge:1:0.6")
+    counters = {}
+    b = DeadlineBarrier(2, counters=counters, timeout_s=0.15,
+                        run_fault=rf)
+    got = []
+    threads = [threading.Thread(target=lambda: got.append(b.wait()))
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert not any(t.is_alive() for t in threads)
+    assert counters["barrier_timeouts"] == 1
+    assert counters["workers_retired"] == 1
+
+
+def test_run_fault_parse_grammar():
+    from jepsen_tpu.ops.faults import RunFaultInjector
+
+    rf = RunFaultInjector.parse("op:12@2")
+    assert (rf.kind, rf.arg, rf.run) == ("op", 12, 2)
+    rf = RunFaultInjector.parse("phase:teardown")
+    assert (rf.kind, rf.arg, rf.run) == ("phase", "teardown", 0)
+    rf = RunFaultInjector.parse("wedge:1:2.5")
+    assert (rf.kind, rf.arg, rf.wedge_s) == ("wedge", 1, 2.5)
+    with pytest.raises(ValueError):
+        RunFaultInjector.parse("bogus:1")
+
+
+# ------------------------- salvage parity under SIGKILL (register)
+
+def _salvage_and_reference(store, builder):
+    """Salvage the single crashed run; build the salvage-completed
+    prefix of an uncrashed reference run at the same WAL op count."""
+    (name, ts), = store.incomplete()
+    stats = store.salvage(name, ts)
+    salvaged = read_jsonl(store.run_dir(name, ts) / "history.jsonl")
+    ref = run(builder(), analyze=False)["history"]
+    prefix, _ = salvage_history(ref[:stats["wal_ops"]])
+    return salvaged, prefix, stats
+
+
+@pytest.mark.parametrize("fault,corrupt", [
+    ("op:5", 0), ("op:17", 0), ("op:17", 2), ("op:29", 2),
+    ("phase:setup", 0), ("phase:run", 0), ("phase:teardown", 2),
+])
+def test_register_kill_salvage_parity(tmp_path, fault, corrupt):
+    """Crash at a seeded op index or phase boundary; the salvaged
+    history must equal (and verdict-match field-for-field) the
+    salvage-completed prefix of an uncrashed run."""
+    store = kill_run(tmp_path / "store", "register", fault, seed=7,
+                     knob=corrupt)
+    salvaged, prefix, stats = _salvage_and_reference(
+        store, lambda: register_test(seed=7,
+                                     corrupt_read=corrupt or None))
+    if fault in ("phase:setup", "phase:run"):
+        assert stats["wal_ops"] == 0          # killed before any op
+    if fault == "phase:teardown":
+        assert stats["dangling_completed"] == 0   # full history durable
+    assert [_sig(o) for o in salvaged] == [_sig(o) for o in prefix]
+    rs = wgl_check(cas_register(), salvaged)
+    rp = wgl_check(cas_register(), prefix)
+    assert rs["valid"] == rp["valid"]
+    if rs["valid"] is False:
+        assert rs["op"]["index"] == rp["op"]["index"]
+    # The salvaged run is fully store-visible: recheck decides it too.
+    out = store.recheck("reg-crash", cas_register())
+    assert out["valid"] == rs["valid"]
+
+
+def test_register_corrupt_prefix_is_actually_invalid(tmp_path):
+    """Guard against vacuous parity: the corrupt-read schedule used
+    above really does yield an INVALID salvaged prefix."""
+    store = kill_run(tmp_path / "store", "register", "op:29", seed=7,
+                     knob=2)
+    salvaged, prefix, _ = _salvage_and_reference(
+        store, lambda: register_test(seed=7, corrupt_read=2))
+    assert wgl_check(cas_register(), salvaged)["valid"] is False
+    assert wgl_check(cas_register(), prefix)["valid"] is False
+
+
+# --------------------- salvage parity under SIGKILL (list-append)
+
+def test_list_append_kill_salvage_parity(tmp_path):
+    """The second acceptance family: a killed list-append run salvages
+    to a history the dependency-graph checker decides identically to
+    the uncrashed prefix — including the seeded G2 anomaly."""
+    from jepsen_tpu.checkers.cycle import check_graphs_batch
+
+    store = kill_run(tmp_path / "store", "la", "op:25", seed=4, knob=2)
+    salvaged, prefix, _ = _salvage_and_reference(
+        store, lambda: la_test(seed=4, stale_read=2))
+    assert [_sig(o) for o in salvaged] == [_sig(o) for o in prefix]
+    rs, rp = check_graphs_batch([salvaged, prefix],
+                                family="list-append")
+    assert rs["valid"] == rp["valid"]
+    assert rs.get("anomaly") == rp.get("anomaly")
+    assert rs["valid"] is False, \
+        "the stale read must land before the kill (schedule drift?)"
+    assert rs["anomaly"] == "G2"
+
+
+# ------------------------------------- seed-campaign kill + resume
+
+def _campaign_builder(s):
+    return register_test(seed=s, n_ops=30,
+                         corrupt_read=1 if s == 1 else None)
+
+
+def test_campaign_kill_and_resume(tmp_path):
+    """Kill a 4-seed campaign during seed 2; resume re-runs ZERO
+    completed seeds (dirs are reused, not recreated), salvages the
+    in-flight seed's prefix, runs only the remainder, and the pooled
+    verdict set matches an uninterrupted campaign's."""
+    base = tmp_path / "store"
+    env = {**os.environ, "JT_RUN_FAULT": "op:20@2",
+           "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO)}
+    r = subprocess.run(
+        [sys.executable, str(HELPER), "campaign", str(base), "4", "1"],
+        capture_output=True, text=True, env=env, timeout=180)
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stderr[-2000:])
+    store = Store(base)
+    ckpt = base / "reg-crash" / "campaign.jsonl"
+    assert ckpt.exists(), "the killed campaign must leave its checkpoint"
+    dirs_before = set(store.tests()["reg-crash"])
+    assert len(dirs_before) == 3          # seeds 0, 1 done + seed 2 cut
+
+    tests = runtime.run_seeds(_campaign_builder, [0, 1, 2, 3],
+                              store=True, store_root=store,
+                              checkpoint=True, resume=True)
+    verdicts = [t["results"]["valid"] for t in tests]
+    # Zero completed seeds re-ran: their dirs are reused verbatim, and
+    # only seed 3 got a new directory.
+    assert [bool(t.get("resumed_seed")) for t in tests] == \
+        [True, True, True, False]
+    dirs_after = set(store.tests()["reg-crash"])
+    assert dirs_before <= dirs_after and len(dirs_after) == 4
+    assert not ckpt.exists(), "a finished campaign deletes its checkpoint"
+
+    ref = runtime.run_seeds(_campaign_builder, [0, 1, 2, 3],
+                            store=True,
+                            store_root=Store(tmp_path / "ref"))
+    assert verdicts == [t["results"]["valid"] for t in ref]
+    assert verdicts == [True, False, True, True]
+
+
+def test_campaign_checkpoint_key_mismatch_refuses(tmp_path):
+    from jepsen_tpu.store import CampaignCheckpoint, CampaignMismatch
+
+    p = tmp_path / "c.jsonl"
+    c1 = CampaignCheckpoint(p, {"name": "a", "seeds": [0, 1]})
+    c1.started(0, "/d0")
+    c1.done(0)
+    c1.close()
+    # Same key resumes; a mismatched resume REFUSES rather than
+    # clobbering the only resume point (a mistyped --seeds would
+    # otherwise destroy all recorded progress).
+    c2 = CampaignCheckpoint(p, {"name": "a", "seeds": [0, 1]},
+                            resume=True)
+    assert c2.seed_state(0) == {"dir": "/d0", "done": True}
+    assert c2.seed_state(1) is None
+    c2.close()
+    with pytest.raises(CampaignMismatch):
+        CampaignCheckpoint(p, {"name": "b", "seeds": [0, 1]},
+                           resume=True)
+    assert p.exists(), "the refused resume must leave the file intact"
+    # A FRESH campaign (no resume) may replace it.
+    c3 = CampaignCheckpoint(p, {"name": "b", "seeds": [0, 1]})
+    assert c3.seed_state(0) is None
+    c3.finish()
+    assert not p.exists()
+
+
+# --------------------------------------------- operator CLI surface
+
+def test_cli_salvage_to_verdict(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    # The sweep's quiescence guard would treat this seconds-old WAL as
+    # a live run; the crash is simulated, so disarm it.
+    monkeypatch.setenv("JT_SALVAGE_MIN_AGE_S", "0")
+    # A pre-existing COMPLETED invalid run of the same test: it must
+    # neither be re-analyzed nor drive the sweep's verdict/exit code.
+    old = run(attach(register_test(seed=9, n_ops=12, corrupt_read=1)))
+    old["store_handle"].stop_logging()
+    assert old["results"]["valid"] is False
+    t = run(attach(register_test(seed=5, n_ops=12)), analyze=False)
+    t["store_handle"].stop_logging()
+    t["wal"].close()
+    from jepsen_tpu.cli import main
+
+    with pytest.raises(SystemExit) as e:
+        main(["salvage", "--model", "cas"])
+    assert e.value.code == 0
+    line = [ln for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("{")][-1]
+    out = json.loads(line)
+    assert len(out["incomplete"]) == 1
+    (k, stats), = out["salvaged"].items()
+    assert stats["salvaged"] is True and stats["ops"] == 24
+    rc = out["recheck"]["reg-crash"]
+    assert rc["valid"] is True
+    assert list(rc["runs"]) == [t["store_handle"].dir.name], \
+        "recheck must cover ONLY the salvaged run"
+    # Repeat sweeps CONVERGE: the salvaged run is not re-salvaged.
+    with pytest.raises(SystemExit) as e2:
+        main(["salvage", "--model", "cas"])
+    assert e2.value.code == 0
+    line2 = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")][-1]
+    out2 = json.loads(line2)
+    assert out2["incomplete"] == [] and out2["salvaged"] == {}
+
+
+def test_harness_error_marker_surfaces_in_salvage(tmp_path):
+    """A run that FAILS with an exception (harness bug, setup error)
+    is distinguishable from a killed one: salvage reports the error
+    instead of presenting the empty prefix as a clean recovery."""
+    from jepsen_tpu.testing import noop_test
+
+    def bad_gen(test, process, ctx):
+        raise ValueError("boom at setup-ish time")
+
+    store = Store(tmp_path / "store")
+    t = attach(noop_test(name="err-run", concurrency=1,
+                         generator=bad_gen), store)
+    with pytest.raises(ValueError, match="boom"):
+        run(t)
+    t["store_handle"].stop_logging()
+    (name, ts), = store.incomplete()
+    stats = store.salvage(name, ts)
+    assert "boom" in stats["harness_error"]
+    assert stats["ops"] == 0
+    # ...and once salvaged, it no longer clogs the incomplete list.
+    assert store.incomplete() == []
+    assert store.incomplete(include_salvaged=True) == [(name, ts)]
