@@ -55,6 +55,14 @@ class ColumnarOps:
               was converted from (-1 on PAD); present on converted
               batches (``ops_to_columnar``) so verdict line positions
               map back to original op indices
+    key     — optional int32 [B, N]: independent-key id per line (the
+              columnar form of a KV-valued history,
+              jepsen_tpu.independent); -1 marks unkeyed lines. Present
+              only on keyed batches (workloads.synth ``n_keys > 1``).
+              Checkers never interpret it directly — the
+              P-compositional pre-partition (ops.partition) strains a
+              keyed batch into per-key sub-histories before encoding,
+              and the sub-batches it produces carry no key column.
     """
 
     type: np.ndarray
@@ -62,6 +70,7 @@ class ColumnarOps:
     kind: np.ndarray
     kinds: List[Tuple]
     index: Optional[np.ndarray] = None
+    key: Optional[np.ndarray] = None
 
     @property
     def batch(self) -> int:

@@ -283,8 +283,15 @@ class BatchLinearizableChecker(Checker):
 
     def check(self, test, model, history, opts=None) -> dict:
         from .ops.linearize import check_batch_columnar, check_batch_tpu
-        ks = history_keys(history)
-        subs = [subhistory(k, history) for k in ks]
+        from .ops.partition import partition_histories
+        # One strainer for the lifted checker AND the engines' own
+        # pre-encode partition (ops.partition wraps subhistory), so the
+        # per-key machinery cannot drift between the two entry points.
+        parts = partition_histories([history], force=True)
+        if parts is None:
+            ks, subs = [], []
+        else:
+            subs, _, ks = parts
         # Seeded batch mode: the runner may have pooled every key's
         # verdict into one cross-run dispatch (runtime.LinearPool); any
         # miss recomputes the whole run normally. The pool computed its

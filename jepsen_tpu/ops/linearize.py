@@ -352,6 +352,51 @@ def get_kernel(V: int, W: int, *, kind: str = "data1", mesh=None,
     return k
 
 
+def make_fused_kernel(members):
+    """Build the multi-bucket megakernel body: one program that scans
+    several class buckets back to back — ``members`` is a tuple of
+    (V, W, w_live, shared_target) per bucket chunk, the callable takes
+    4 flat args per member (ev_type, ev_slot, ev_slots, target) and
+    returns 3 flat outputs per member (valid, bad, frontier). One jit
+    of this retires a whole dispatch group in a single XLA call — the
+    per-dispatch overhead (host round trip, launch latency) that
+    dominates the many-small-buckets shape is paid once per group
+    instead of once per bucket (ops.schedule's fused dispatch path)."""
+    kerns = []
+    for (V, W, wl, shared) in members:
+        kerns.append(jax.vmap(make_kernel(V, W, w_live=wl),
+                              in_axes=(0, 0, 0, None if shared else 0)))
+
+    def fused(*flat):
+        out = []
+        for i, kern in enumerate(kerns):
+            out.extend(kern(*flat[4 * i:4 * i + 4]))
+        return tuple(out)
+
+    return fused
+
+
+def get_fused_kernel(members, donate: bool = False):
+    """Resolve (build + cache) a compiled fused multi-bucket kernel —
+    the dispatch-group twin of ``get_kernel``, sharing the process-wide
+    registry so compile accounting and AOT shipping see one kernel
+    set. ``members`` as in make_fused_kernel; ``donate`` donates every
+    member's event buffers (each group ships exactly once)."""
+    members = tuple(tuple(m) for m in members)
+    key = ("fusedN", members, donate)
+    k = _KERNEL_REGISTRY.get(key)
+    if k is None:
+        donate_argnums = tuple(j for i in range(len(members))
+                               for j in (4 * i, 4 * i + 1, 4 * i + 2)) \
+            if donate else ()
+        if donate:
+            _silence_donation_warning()
+        k = jax.jit(make_fused_kernel(members),
+                    donate_argnums=donate_argnums)
+        _KERNEL_REGISTRY[key] = k
+    return k
+
+
 def log_kernel_shapes(V: int, W: int, kind: str, shared_target: bool,
                       donate: bool, B: int, N: int,
                       w_live: Optional[int] = None) -> None:
@@ -891,7 +936,8 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
                     max_states: int = MAX_PACKED_STATES, max_slots: int = 16,
                     host_fallback=None, min_device_batch: int = 1,
                     scheduler: bool = True, faults=None, journal=None,
-                    scheduler_opts: Optional[dict] = None) -> List[dict]:
+                    scheduler_opts: Optional[dict] = None,
+                    partition: object = "auto") -> List[dict]:
     """Check many raw histories on device; per-history result dicts.
 
     Histories the encoder cannot bound (state-space explosion, pending
@@ -919,10 +965,35 @@ def check_batch_tpu(model: Model, histories: Sequence[List[Op]], *,
     ``journal`` (store.ChunkJournal) makes retired chunk verdicts
     durable and resumes from them; ``scheduler_opts`` forwards knobs to
     BucketScheduler (chunk_rows, max_classes, ...).
+
+    ``partition`` is the P-compositional pre-partition (ops.partition):
+    KV-valued histories strain into per-key sub-histories BEFORE
+    encoding — each key checks at its own (much smaller) pending
+    window W, collapsing the 2^W frontier cost — and verdicts
+    recombine host-side with the witness key preserved
+    (``independent_key``). ``"auto"`` (default) samples each history's
+    head for KV values; True forces the strain; False keeps the
+    unpartitioned path. The journal's row namespace becomes the
+    (deterministically ordered) sub-history list, so a resumed run
+    re-dispatches ZERO decided sub-histories.
     """
     from ..checkers.linearizable import prepare_history, wgl_check
     from ..history.core import index as index_history
     from .encode import take_rows
+    if partition:
+        from .partition import partition_histories, recombine_details
+        parts = partition_histories(histories,
+                                    force=partition is True)
+        if parts is not None:
+            subs, sub_hist, sub_key = parts
+            inner = check_batch_tpu(
+                model, subs, max_states=max_states, max_slots=max_slots,
+                host_fallback=host_fallback,
+                min_device_batch=min_device_batch, scheduler=scheduler,
+                faults=faults, journal=journal,
+                scheduler_opts=scheduler_opts, partition=False)
+            return recombine_details(inner, sub_hist, sub_key,
+                                     len(histories))
     if host_fallback is None:
         _cache: dict = {}
 
@@ -1119,17 +1190,20 @@ class _NativeTailWorker:
 def _cols_take(cols, rows):
     """Row-subset of a ColumnarOps batch (the journal-resume filter)."""
     r = np.asarray(rows, np.int64)
+    key = getattr(cols, "key", None)
     return type(cols)(
         type=cols.type[r], process=cols.process[r], kind=cols.kind[r],
         kinds=cols.kinds,
-        index=cols.index[r] if cols.index is not None else None)
+        index=cols.index[r] if cols.index is not None else None,
+        key=key[r] if key is not None else None)
 
 
 def check_columnar(model: Model, cols, *, max_slots: int = 16,
                    host_fallback=None, details=False,
                    min_device_batch: int = 1, scheduler: bool = True,
                    faults=None, journal=None,
-                   scheduler_opts: Optional[dict] = None):
+                   scheduler_opts: Optional[dict] = None,
+                   partition: object = "auto"):
     """Device-check a ColumnarOps batch end-to-end at tensor speed.
 
     Returns (valid [B] bool, bad [B] int32) — ``bad`` is the op index of
@@ -1172,7 +1246,35 @@ def check_columnar(model: Model, cols, *, max_slots: int = 16,
     Resumed rows' detail dicts are bare verdicts (no config sample)
     marked ``resumed``. ``scheduler_opts`` forwards BucketScheduler
     knobs (chunk_rows, max_classes, ...).
+
+    ``partition`` (default ``"auto"``): a KEYED batch (``cols.key``,
+    the columnar form of a KV-valued workload) strains into its
+    per-key sub-batch before encoding (ops.partition) — the
+    P-compositional W collapse — and verdicts recombine per history:
+    valid iff every key is, ``bad`` the smallest original bad-op index
+    over the invalid keys, and (details mode) the witness sub's result
+    verbatim plus ``independent_key``. The journal then rides the
+    sub-batch's deterministic row order, so a resumed run
+    re-dispatches zero decided sub-histories.
     """
+    if partition and getattr(cols, "key", None) is not None:
+        from .partition import (partition_columnar, recombine_details,
+                                recombine_verdicts)
+        pb = partition_columnar(cols)
+        if pb is not None:
+            inner = check_columnar(
+                model, pb.cols, max_slots=max_slots,
+                host_fallback=host_fallback, details=details,
+                min_device_batch=min_device_batch, scheduler=scheduler,
+                faults=faults, journal=journal,
+                scheduler_opts=scheduler_opts, partition=False)
+            if details:
+                return recombine_details(inner, pb.sub_history,
+                                         pb.sub_key, cols.batch)
+            v, b, _ = recombine_verdicts(inner[0], inner[1],
+                                         pb.sub_history, pb.sub_key,
+                                         cols.batch)
+            return v, b
     if journal is None or not scheduler:
         return _check_columnar_impl(
             model, cols, max_slots=max_slots, host_fallback=host_fallback,
@@ -1448,20 +1550,36 @@ def check_batch_columnar(model: Model, histories: Sequence[List[Op]], *,
                          min_device_batch: int = 1,
                          scheduler: bool = True, faults=None,
                          journal=None,
-                         scheduler_opts: Optional[dict] = None
-                         ) -> List[dict]:
+                         scheduler_opts: Optional[dict] = None,
+                         partition: object = "auto") -> List[dict]:
     """Check recorded Op-list histories through the columnar fast path:
     one fused conversion walk (history.columnar.ops_to_columnar), one
     vectorized encode, one device dispatch per cost bucket. Falls back
     to the per-history path (``check_batch_tpu``) when the shared
     vocabulary's state space explodes. Per-history result dicts;
     ``details="invalid"`` skips the valid rows' Python decode (see
-    check_columnar)."""
+    check_columnar). KV-valued histories pre-partition into per-key
+    sub-histories before conversion (``partition`` — see
+    check_batch_tpu; KV values never reach the kind vocabulary)."""
     from ..history.columnar import ops_to_columnar
     from .statespace import StateSpaceExplosion
 
     if not histories:
         return []
+    if partition:
+        from .partition import partition_histories, recombine_details
+        parts = partition_histories(histories,
+                                    force=partition is True)
+        if parts is not None:
+            subs, sub_hist, sub_key = parts
+            inner = check_batch_columnar(
+                model, subs, max_slots=max_slots, max_states=max_states,
+                host_fallback=host_fallback, details=details,
+                min_device_batch=min_device_batch, scheduler=scheduler,
+                faults=faults, journal=journal,
+                scheduler_opts=scheduler_opts, partition=False)
+            return recombine_details(inner, sub_hist, sub_key,
+                                     len(histories))
     try:
         cols = ops_to_columnar(model, histories,
                                max_states=min(max_states,

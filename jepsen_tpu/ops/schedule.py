@@ -87,9 +87,10 @@ from .faults import (CorruptOutput, FaultInjector, WatchdogExpired,
                      classify_failure, corrupt_arrays, validate_decoded)
 from .linearize import (DATA_MAX_SLOTS, DISPATCH_LOG, INT32_MAX,
                         KERNEL_SHAPE_LOG, MAX_FRONTIER_ELEMENTS,
-                        MIN_ROWS_PER_DEVICE, WindowOverflow, get_kernel,
-                        log_kernel_shapes, n_state_words, production_mesh,
-                        run_encoded_batch, run_event_chunked, vpu_op_model)
+                        MIN_ROWS_PER_DEVICE, WindowOverflow,
+                        get_fused_kernel, get_kernel, log_kernel_shapes,
+                        n_state_words, production_mesh, run_encoded_batch,
+                        run_event_chunked, vpu_op_model)
 
 log = logging.getLogger("jepsen.schedule")
 
@@ -102,6 +103,37 @@ DEFAULT_CHUNK_ROWS = int(os.environ.get("JT_SCHED_CHUNK_ROWS", "1024"))
 
 # Consolidation budget for the W <= DATA_MAX_SLOTS side.
 DEFAULT_MAX_CLASSES = int(os.environ.get("JT_SCHED_CLASSES", "5"))
+
+# Fused-dispatch group width: up to this many class chunks ride ONE
+# XLA call (a tuple-input megakernel, linearize.get_fused_kernel), so
+# the bucket histogram's long cheap head stops paying one dispatch
+# each. 1 = the per-chunk dispatch flow (the pre-fusion behavior; the
+# fault-ordinal tests pin it).
+DEFAULT_FUSE_WIDTH = 4
+
+
+def default_fuse_width() -> int:
+    """The fuse width a BucketScheduler uses when the caller passes
+    none. A fused megakernel is a compile-time investment — each group
+    composition is a fresh XLA program roughly ``width`` bodies big —
+    that only pays off when compiles amortize: across processes via
+    the persistent cache + AOT shipping, or within one long streaming
+    run. With the compile cache OFF (JT_COMPILE_CACHE=0, the hermetic
+    tests contract) every short-lived process would pay full megakernel
+    compiles for one-shot dispatch groups, so the default collapses to
+    1 (the per-chunk flow). $JT_SCHED_FUSE_WIDTH and the explicit
+    ``fuse_width=`` argument override unconditionally — how the
+    dispatch-budget guard engages fusion under a disabled cache."""
+    env = os.environ.get("JT_SCHED_FUSE_WIDTH")
+    if env is not None:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            log.warning("ignoring malformed JT_SCHED_FUSE_WIDTH=%r "
+                        "(want an integer >= 1)", env)
+    if os.environ.get("JT_COMPILE_CACHE") == "0":
+        return 1
+    return DEFAULT_FUSE_WIDTH
 
 # In-flight chunk budget: 2 = classic double buffering (host pads k+1,
 # device runs k, host decodes k-1).
@@ -220,9 +252,70 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:
 
 # ------------------------------------------------------ W-class cost model
 
+# Assumed sustained lane-op rate that converts the measured dispatch
+# overhead (wall microseconds) into the DP's cost-base units
+# (base x 2^W ~ lane-ops): overhead_units = overhead_s x rate. The
+# same pessimism class as WATCHDOG_LANE_OPS_PER_S — only the RATIO of
+# overhead to work matters to the partition choice.
+DISPATCH_COST_LANE_OPS_PER_S = float(
+    os.environ.get("JT_DISPATCH_COST_LANE_OPS_PER_S", "1e8"))
+
+_DISPATCH_OVERHEAD_US: Optional[float] = None
+_OVERHEAD_LOCK = threading.Lock()
+
+
+def measure_dispatch_overhead_us(samples: int = 12) -> float:
+    """The fixed cost of one device dispatch, in wall microseconds —
+    a tiny jitted round trip timed after warmup, median over
+    ``samples``. Calibrated once per process (the first BucketScheduler
+    pays ~a millisecond); $JT_DISPATCH_OVERHEAD_US overrides the
+    measurement entirely — how tests pin the DP and how deployments
+    with known launch latency skip the probe. 0 disables the term
+    (the pre-r06 cost model)."""
+    global _DISPATCH_OVERHEAD_US
+    env = os.environ.get("JT_DISPATCH_OVERHEAD_US")
+    if env is not None:
+        try:
+            return max(0.0, float(env))
+        except ValueError:
+            # The env's contract is "override entirely": a typo must
+            # not silently re-enable the machine-dependent probe the
+            # caller meant to pin away. 0 = the term off (pre-r06).
+            log.warning("ignoring malformed JT_DISPATCH_OVERHEAD_US=%r "
+                        "(want a number of microseconds); dispatch "
+                        "overhead term disabled", env)
+            return 0.0
+    with _OVERHEAD_LOCK:
+        if _DISPATCH_OVERHEAD_US is not None:
+            return _DISPATCH_OVERHEAD_US
+        try:
+            import jax
+            import jax.numpy as jnp
+            f = jax.jit(lambda x: x + 1)
+            x = jnp.zeros(8, jnp.int32)
+            f(x).block_until_ready()        # compile outside the clock
+            ts = []
+            for _ in range(samples):
+                t0 = time.perf_counter()
+                f(x).block_until_ready()
+                ts.append(time.perf_counter() - t0)
+            _DISPATCH_OVERHEAD_US = sorted(ts)[len(ts) // 2] * 1e6
+        except Exception:
+            _DISPATCH_OVERHEAD_US = 0.0
+        return _DISPATCH_OVERHEAD_US
+
+
+def dispatch_overhead_units() -> float:
+    """The per-dispatch fixed-overhead term in cost-base units — what
+    choose_w_classes charges each group beyond its frontier work."""
+    return (measure_dispatch_overhead_us() * 1e-6
+            * DISPATCH_COST_LANE_OPS_PER_S)
+
+
 def choose_w_classes(stats: Dict[Tuple[int, int], float], *,
                      max_classes: int = DEFAULT_MAX_CLASSES,
-                     boundary: int = DATA_MAX_SLOTS
+                     boundary: int = DATA_MAX_SLOTS,
+                     overhead: Optional[float] = None
                      ) -> Dict[Tuple[int, int], int]:
     """Pick the W classes: {(V, exact_W): class_W}.
 
@@ -230,11 +323,22 @@ def choose_w_classes(stats: Dict[Tuple[int, int], float], *,
     proportional works). Per V, the exact windows <= ``boundary``
     partition into at most ``max_classes`` contiguous groups, each
     checked at its widest member; the dynamic program minimizes
-    sum(base_group x 2^class_W) — total padded frontier work — over
-    all such partitions. Windows past the boundary keep exact classes:
-    they dispatch through the wide/frontier routes, where the mask
-    axis is shape-critical (and they are rare).
+    sum(base_group x 2^class_W + overhead) — total padded frontier
+    work plus a per-group dispatch tax — over all such partitions.
+    Windows past the boundary keep exact classes: they dispatch
+    through the wide/frontier routes, where the mask axis is
+    shape-critical (and they are rare).
+
+    ``overhead`` is the measured fixed cost of one dispatch in
+    cost-base units (default dispatch_overhead_units(), i.e. the
+    startup-calibrated $JT_DISPATCH_OVERHEAD_US probe): without it the
+    DP undercounts many small classes — a class whose total frontier
+    work is below the launch overhead is pure loss, and the plateau's
+    long cheap bucket head was exactly that shape.
     """
+    if overhead is None:
+        overhead = dispatch_overhead_units()
+    overhead = max(0.0, float(overhead))
     out: Dict[Tuple[int, int], int] = {}
     by_v: Dict[int, List[int]] = {}
     for (v, w) in stats:
@@ -244,7 +348,7 @@ def choose_w_classes(stats: Dict[Tuple[int, int], float], *,
             out[(v, w)] = w
     for v, ws in by_v.items():
         ws = sorted(set(ws))
-        if len(ws) <= max_classes:
+        if len(ws) <= max_classes and not overhead:
             out.update({(v, w): w for w in ws})
             continue
         base = [float(stats[(v, w)]) for w in ws]
@@ -253,7 +357,7 @@ def choose_w_classes(stats: Dict[Tuple[int, int], float], *,
             pre.append(pre[-1] + b)
 
         def cost(i, j):        # group ws[i..j] checked at ws[j]
-            return (pre[j + 1] - pre[i]) * float(1 << ws[j])
+            return (pre[j + 1] - pre[i]) * float(1 << ws[j]) + overhead
 
         n = len(ws)
         INF = float("inf")
@@ -285,27 +389,181 @@ _AOT: Dict[Tuple, object] = {}
 _AOT_INFLIGHT: Dict[Tuple, threading.Event] = {}
 _AOT_LOCK = threading.Lock()
 
+# AOT-serialized kernel shipping: executables exported to / imported
+# from $JT_AOT_DIR keyed by _aot_key, so a fresh process on the same
+# runtime deserializes instead of recompiling — the cold-compile cut
+# beyond the persistent StableHLO cache (this ships the FINAL
+# executable, skipping trace+lower+compile entirely). Disabled when
+# unset or when JT_COMPILE_CACHE=0 (the hermetic-tests contract).
+AOT_STATS = {"hits": 0, "misses": 0, "exported": 0, "rejected": 0}
+_AOT_MISSING: set = set()      # keys probed on disk and absent
+
+
+def aot_dir() -> Optional[str]:
+    if os.environ.get("JT_COMPILE_CACHE") == "0":
+        return None
+    d = os.environ.get("JT_AOT_DIR")
+    return d or None
+
+
+def _aot_env_tag() -> str:
+    """The runtime fingerprint an executable is only valid under."""
+    import jax
+    try:
+        dev = jax.devices()[0]
+        dev_kind = f"{dev.platform}:{getattr(dev, 'device_kind', '?')}"
+    except Exception:
+        dev_kind = "none"
+    return f"jax-{jax.__version__}|{dev_kind}"
+
+
+def _aot_path(key: Tuple) -> Optional[str]:
+    d = aot_dir()
+    if d is None:
+        return None
+    import hashlib
+    h = hashlib.sha256(f"{_aot_env_tag()}|{key!r}".encode()).hexdigest()
+    return os.path.join(d, f"{h[:24]}.aot")
+
+
+def _aot_read(path: str):
+    """Pure read half of shipping: deserialize one .aot file, or None
+    on tag mismatch/corruption. No stats, no memo — safe to call from
+    measurement probes while prewarm threads run."""
+    import pickle
+
+    from jax.experimental import serialize_executable as se
+    with open(path, "rb") as f:
+        tag, payload, in_tree, out_tree = pickle.load(f)
+    if tag != _aot_env_tag():
+        return None
+    return se.deserialize_and_load(payload, in_tree, out_tree)
+
+
+def _aot_load(key: Tuple):
+    """Deserialize a shipped executable for ``key``, or None. Any
+    mismatch/corruption just counts as a miss — shipping is an
+    accelerator, never a failure mode."""
+    path = _aot_path(key)
+    if path is None or key in _AOT_MISSING:
+        return None
+    try:
+        if not os.path.exists(path):
+            _AOT_MISSING.add(key)
+            AOT_STATS["misses"] += 1
+            return None
+        compiled = _aot_read(path)
+        if compiled is None:
+            AOT_STATS["rejected"] += 1
+            return None
+        AOT_STATS["hits"] += 1
+        return compiled
+    except Exception:
+        AOT_STATS["rejected"] += 1
+        return None
+
+
+def _aot_store(key: Tuple, compiled) -> None:
+    """Serialize one executable into the shipping dir (best-effort,
+    atomic rename so a killed process never leaves a torn file). The
+    dir is created owner-only and files land 0600: shipped payloads
+    deserialize through pickle, so the shipping dir is a TRUSTED path
+    — same trust domain as the persistent compile cache, never a
+    world-writable drop box."""
+    path = _aot_path(key)
+    if path is None:
+        return
+    try:
+        import pickle
+
+        from jax.experimental import serialize_executable as se
+        payload, in_tree, out_tree = se.serialize(compiled)
+        os.makedirs(os.path.dirname(path), mode=0o700, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump((_aot_env_tag(), payload, in_tree, out_tree), f)
+        os.replace(tmp, path)
+        _AOT_MISSING.discard(key)
+        AOT_STATS["exported"] += 1
+    except Exception:
+        pass
+
+
+def aot_warm_probe() -> Optional[float]:
+    """Measured warm-start cost: re-deserialize every executable this
+    process parked in the shipping dir and return the wall seconds —
+    what a FRESH process pays instead of trace+lower+compile (the
+    bench's cold-vs-warm compile figure). None when shipping is
+    disabled or nothing was exported. Reads through _aot_read, which
+    touches neither AOT_STATS nor the missing-key memo (the probe is
+    measurement, not traffic — and prewarm threads may still be
+    exporting while it runs)."""
+    with _AOT_LOCK:
+        keys = list(_AOT.keys())
+    if not keys or aot_dir() is None:
+        return None
+    n = 0
+    t0 = time.perf_counter()
+    for k in keys:
+        try:
+            path = _aot_path(k)
+            if path and os.path.exists(path) \
+                    and _aot_read(path) is not None:
+                n += 1
+        except Exception:
+            pass
+    dt = time.perf_counter() - t0
+    return round(dt, 3) if n else None
+
 
 def _aot_key(V, W, w_live, shared, donate, Bp, Np, slot_dtype, K1):
     return (V, W, w_live, shared, donate, Bp, Np,
             np.dtype(slot_dtype).str, K1)
 
 
-def _compile_spec(V, W, w_live, shared, donate, Bp, Np, slot_dtype,
-                  K1) -> None:
-    """AOT-lower + compile one kernel shape and park the executable for
-    dispatch to pick up. Runs on a daemon thread; any failure just
-    leaves dispatch on the plain jit path."""
-    key = _aot_key(V, W, w_live, shared, donate, Bp, Np, slot_dtype, K1)
+def _spec_key(spec: Tuple) -> Tuple:
+    """Registry key for a pre-warm spec — a plain kernel-shape tuple,
+    or ("fused", (member specs...)) for a dispatch-group megakernel."""
+    if spec and spec[0] == "fused":
+        return ("fused",) + tuple(_aot_key(*m) for m in spec[1])
+    return _aot_key(*spec)
+
+
+def _member_shapes(spec: Tuple):
+    import jax
+    (V, W, w_live, shared, donate, Bp, Np, slot_dtype, K1) = spec
+    ev = jax.ShapeDtypeStruct((Bp, Np), np.int8)
+    slots = jax.ShapeDtypeStruct((Bp, Np, W), np.dtype(slot_dtype))
+    tgt = jax.ShapeDtypeStruct((K1, V) if shared else (Bp, K1, V),
+                               np.int32)
+    return [ev, ev, slots, tgt]
+
+
+def _compile_spec(spec: Tuple) -> None:
+    """AOT-lower + compile one kernel shape (or fused group shape) and
+    park the executable for dispatch to pick up — preferring a
+    deserialized shipped executable (_aot_load) over a fresh compile,
+    and exporting fresh compiles back to the shipping dir. Runs on a
+    daemon thread; any failure just leaves dispatch on the plain jit
+    path."""
+    key = _spec_key(spec)
     try:
-        import jax
-        kern = get_kernel(V, W, shared_target=shared, donate=donate,
-                          w_live=w_live)
-        ev = jax.ShapeDtypeStruct((Bp, Np), np.int8)
-        slots = jax.ShapeDtypeStruct((Bp, Np, W), np.dtype(slot_dtype))
-        tgt = jax.ShapeDtypeStruct((K1, V) if shared else (Bp, K1, V),
-                                   np.int32)
-        compiled = kern.lower(ev, ev, slots, tgt).compile()
+        compiled = _aot_load(key)
+        if compiled is None:
+            if spec[0] == "fused":
+                members = spec[1]
+                kern = get_fused_kernel(
+                    tuple(m[:4] for m in members),
+                    donate=bool(members[0][4]))
+                shapes = [s for m in members for s in _member_shapes(m)]
+            else:
+                (V, W, w_live, shared, donate, *_rest) = spec
+                kern = get_kernel(V, W, shared_target=shared,
+                                  donate=donate, w_live=w_live)
+                shapes = _member_shapes(spec)
+            compiled = kern.lower(*shapes).compile()
+            _aot_store(key, compiled)
     except Exception:
         compiled = None
     with _AOT_LOCK:
@@ -318,22 +576,25 @@ def _compile_spec(V, W, w_live, shared, donate, Bp, Np, slot_dtype,
 
 def prewarm_kernels(specs: Iterable[Tuple]) -> List[threading.Thread]:
     """Compile kernel shapes on background daemon threads (one each).
-    ``specs``: (V, W, w_live, shared, donate, Bp, Np, slot_dtype, K1) —
-    what BucketScheduler derives from the consolidated class set.
-    Dispatch coordinates through _AOT_INFLIGHT: a chunk that reaches
-    the device first WAITS for the in-flight compile instead of
-    racing a duplicate jit compile of the same shape (``.lower().
-    compile()`` does not populate the jit function's own cache, so
-    the race would compile everything twice)."""
+    ``specs``: (V, W, w_live, shared, donate, Bp, Np, slot_dtype, K1)
+    per kernel — what BucketScheduler derives from the consolidated
+    class set — or ("fused", (member specs...)) for a dispatch-group
+    megakernel shape. Dispatch coordinates through _AOT_INFLIGHT: a
+    chunk that reaches the device first WAITS for the in-flight
+    compile instead of racing a duplicate jit compile of the same
+    shape (``.lower().compile()`` does not populate the jit function's
+    own cache, so the race would compile everything twice)."""
     threads = []
     for spec in specs:
-        key = _aot_key(*spec)
+        key = _spec_key(spec)
         with _AOT_LOCK:
             if key in _AOT or key in _AOT_INFLIGHT:
                 continue
             _AOT_INFLIGHT[key] = threading.Event()
-        t = threading.Thread(target=_compile_spec, args=tuple(spec),
-                             name=f"jepsen-prewarm-W{spec[1]}", daemon=True)
+        name = ("jepsen-prewarm-fused" if spec[0] == "fused"
+                else f"jepsen-prewarm-W{spec[1]}")
+        t = threading.Thread(target=_compile_spec, args=(tuple(spec),),
+                             name=name, daemon=True)
         try:
             t.start()
         except Exception:
@@ -413,13 +674,21 @@ class BucketScheduler:
                  compilation_cache: bool = True,
                  faults: Optional[FaultInjector] = None,
                  max_retries: Optional[int] = None,
-                 backoff_s: Optional[float] = None):
+                 backoff_s: Optional[float] = None,
+                 fuse_width: Optional[int] = None,
+                 shard_min_rows: Optional[int] = None):
         self.return_frontier = return_frontier
         self.max_classes = (DEFAULT_MAX_CLASSES if max_classes is None
                             else max_classes)
         self.chunk_rows = (DEFAULT_CHUNK_ROWS if chunk_rows is None
                            else chunk_rows)
         self.depth = max(1, depth)
+        # Fused dispatch: up to fuse_width pipelined chunks (across W
+        # classes) ride one XLA call; 1 keeps the per-chunk flow.
+        self.fuse_width = max(1, default_fuse_width() if fuse_width is None
+                              else int(fuse_width))
+        self._fuse_buf: List[Tuple] = []
+        self._warmed_groups: set = set()
         self.consolidate = consolidate
         self.prewarm = prewarm
         if donate:
@@ -429,6 +698,14 @@ class BucketScheduler:
             donate = jax.default_backend() != "cpu"
         self.donate = donate
         self.min_device_rows = min_device_rows
+        # Routing floor for the batch-sharded (dataN) route: merged
+        # buckets below it stay on the fused chunked pipeline — which
+        # carries the fault hooks, chunk journal, and dispatch fusion —
+        # instead of draining the pipeline for a blocking SPMD call.
+        # None keeps the historical mesh-derived default
+        # (data devices * MIN_ROWS_PER_DEVICE); dispatch-latency-bound
+        # callers (and the hermetic partition tests) raise it.
+        self.shard_min_rows = shard_min_rows
         self.on_chunk = on_chunk
         if compilation_cache:
             enable_compilation_cache()
@@ -456,6 +733,7 @@ class BucketScheduler:
         self._awaited_shapes: set = set()
         self.stats: dict = {
             "input_buckets": 0, "classes": [], "chunks": 0,
+            "dispatches": 0, "fused_groups": 0,
             "rows": 0, "pad_rows": 0, "compiled_shapes": 0,
             "t_first_verdict_s": None, "wall_s": None,
             "encode_busy_s": 0.0, "dispatch_busy_s": 0.0,
@@ -508,14 +786,22 @@ class BucketScheduler:
         target[:nb] = batch.target[lo:hi]
         return ev_type, ev_slot, ev_slots, target
 
-    def _resolve(self, batch: EncodedBatch, Bp: int, Np: int):
-        key = _aot_key(batch.V, batch.W, batch.eff_w_live,
-                       batch.shared_target, self.donate,
-                       Bp, Np, batch.ev_slots.dtype,
-                       batch.target.shape[1])
+    def _resolve_key(self, key: Tuple):
+        """Shared executable-resolution discipline for both the
+        per-chunk and fused routes: parked pre-warm/shipped executable
+        first, then a disk load, then a BOUNDED wait on an in-flight
+        pre-warm compile. Returns None when the caller must fall back
+        to the registry jit (a wedged pre-warm is logged and counted
+        on the way out)."""
         with _AOT_LOCK:
             compiled = _AOT.get(key)
             waiting = _AOT_INFLIGHT.get(key)
+        if compiled is None and waiting is None:
+            # A shipped executable beats both waiting and compiling.
+            compiled = _aot_load(key)
+            if compiled is not None:
+                with _AOT_LOCK:
+                    _AOT[key] = compiled
         if compiled is None and waiting is not None:
             # The pre-warm thread is mid-compile for exactly this
             # shape: wait for it rather than racing a duplicate jit
@@ -536,10 +822,16 @@ class BucketScheduler:
                     "%.0fs; falling back to a duplicate jit compile",
                     key, PREWARM_WAIT_S)
                 self.stats["prewarm_wedged"] += 1
-        return compiled or get_kernel(batch.V, batch.W,
-                                      shared_target=batch.shared_target,
-                                      donate=self.donate,
-                                      w_live=batch.eff_w_live)
+        return compiled
+
+    def _resolve(self, batch: EncodedBatch, Bp: int, Np: int):
+        key = _aot_key(batch.V, batch.W, batch.eff_w_live,
+                       batch.shared_target, self.donate,
+                       Bp, Np, batch.ev_slots.dtype,
+                       batch.target.shape[1])
+        return self._resolve_key(key) or get_kernel(
+            batch.V, batch.W, shared_target=batch.shared_target,
+            donate=self.donate, w_live=batch.eff_w_live)
 
     def _ship(self, batch: EncodedBatch, lo: int, hi: int, Bp: int,
               Np: int, tag: str):
@@ -559,32 +851,103 @@ class BucketScheduler:
         log_kernel_shapes(batch.V, batch.W, "data1", batch.shared_target,
                           self.donate, Bp, Np, batch.eff_w_live)
         DISPATCH_LOG.append((tag, batch.V, batch.W, hi - lo))
+        self.stats["dispatches"] += 1
         out = kern(ev_type, ev_slot, ev_slots,
                    np.ascontiguousarray(batch.target[0])
                    if batch.shared_target else target)
         return out, delay
 
-    def _dispatch(self, run: _Run, lo: int, hi: int, Bp: int):
-        """Pipelined (async) dispatch of one chunk. Failures the fault
+    def _member_spec(self, batch: EncodedBatch, Bp: int,
+                     Np: int) -> Tuple:
+        return (batch.V, batch.W, batch.eff_w_live, batch.shared_target,
+                self.donate, Bp, Np, batch.ev_slots.dtype,
+                batch.target.shape[1])
+
+    def _resolve_group(self, specs: Tuple[Tuple, ...]):
+        """Resolve the fused megakernel for one dispatch group —
+        shipped/pre-warmed executable first (the _resolve_key
+        discipline), else the registry jit."""
+        key = ("fused",) + tuple(_aot_key(*s) for s in specs)
+        return self._resolve_key(key) or get_fused_kernel(
+            tuple(s[:4] for s in specs), donate=self.donate)
+
+    def _dispatch_group(self, members: List[Tuple]):
+        """Pipelined (async) dispatch of one fused group — one XLA call
+        retires every member chunk. ``members`` is [(run, lo, hi, Bp)];
+        single-member groups ride the plain per-chunk kernel (_ship),
+        which keeps fuse_width=1 bit-compatible with the pre-fusion
+        flow (same kernels, same fault ordinals). Failures the fault
         classifier recognizes are carried to retire time as the ``out``
         payload instead of raised, so the pipeline keeps streaming and
-        the degradation ladder (_recover) runs when the chunk's turn to
-        decode comes."""
-        batch = run.batch
+        the degradation ladder (_recover) runs per member when the
+        group's turn to decode comes."""
         t0 = time.monotonic()
-        Np = _round_up(batch.n_events, EVENT_QUANTUM)
+        outs: object
         try:
-            out, delay = self._ship(batch, lo, hi, Bp, Np, "data1")
+            if len(members) == 1:
+                run, lo, hi, Bp = members[0]
+                Np = _round_up(run.batch.n_events, EVENT_QUANTUM)
+                out, delay = self._ship(run.batch, lo, hi, Bp, Np,
+                                        "data1")
+                outs = [out]
+            else:
+                flat: List = []
+                specs: List[Tuple] = []
+                delay = 0.0
+                for run, lo, hi, Bp in members:
+                    b = run.batch
+                    Np = _round_up(b.n_events, EVENT_QUANTUM)
+                    # Fault hooks fire once per MEMBER, not per group:
+                    # the nemesis ordinals (FaultPlan chunk=N) count
+                    # chunks, and fusion must not shift them — the
+                    # fault-schedule parity tests pin the pre-fusion
+                    # ordinals. Member delays accumulate (each would
+                    # have stalled its own decode).
+                    if self.faults is not None:
+                        self.faults.fire("encode")
+                    ev_type, ev_slot, ev_slots, target = \
+                        self._pad_chunk(b, lo, hi, Bp, Np)
+                    if self.faults is not None:
+                        delay += self.faults.sleep_for(
+                            self.faults.fire("dispatch"))
+                    flat.extend([
+                        ev_type, ev_slot, ev_slots,
+                        np.ascontiguousarray(b.target[0])
+                        if b.shared_target else target])
+                    specs.append(self._member_spec(b, Bp, Np))
+                    log_kernel_shapes(b.V, b.W, "data1",
+                                      b.shared_target, self.donate, Bp,
+                                      Np, b.eff_w_live)
+                    DISPATCH_LOG.append(("data1fused", b.V, b.W,
+                                         hi - lo))
+                spec_t = tuple(specs)
+                gspec = ("fused", spec_t)
+                if self.prewarm and gspec not in self._warmed_groups:
+                    # First sight of this group composition: compile it
+                    # through the pre-warm path (daemon _compile_spec),
+                    # which prefers a SHIPPED executable and exports a
+                    # fresh compile back to the AOT dir — _resolve_group
+                    # below waits on the in-flight event instead of
+                    # racing a duplicate jit compile.
+                    self._warmed_groups.add(gspec)
+                    prewarm_kernels([gspec])
+                kern = self._resolve_group(spec_t)
+                self.stats["dispatches"] += 1
+                self.stats["fused_groups"] += 1
+                out_flat = kern(*flat)
+                outs = [tuple(out_flat[3 * i:3 * i + 3])
+                        for i in range(len(members))]
         except Exception as e:
             if classify_failure(e) is None:
                 raise
-            out, delay = e, 0.0
+            outs, delay = e, 0.0
         if self._first_dispatch_t is None:
             self._first_dispatch_t = time.monotonic()
-        self.stats["chunks"] += 1
-        self.stats["pad_rows"] += Bp - (hi - lo)
+        self.stats["chunks"] += len(members)
+        for _, lo, hi, Bp in members:
+            self.stats["pad_rows"] += Bp - (hi - lo)
         self.stats["dispatch_busy_s"] += time.monotonic() - t0
-        return (run, lo, hi, out, Bp, delay)
+        return (members, outs, delay)
 
     # ------------------------------------------------ watchdog + ladder
     def _deadline(self, batch: EncodedBatch, rows: int) -> float:
@@ -606,6 +969,37 @@ class BucketScheduler:
             d += WATCHDOG_COMPILE_GRACE_S
         return d
 
+    def _decode_member(self, out, nb: int, batch: EncodedBatch):
+        """Decode one dispatch's outputs (runs ON the retire thread —
+        the single copy both the per-chunk and fused-group awaits
+        share): fire the decode-stage fault, slice off pad rows, apply
+        a corrupt fault, validate (corrupt output becomes a retryable
+        fault, never a wrong verdict), and shape the frontier per
+        return_frontier."""
+        kind = None
+        if self.faults is not None:
+            kind = self.faults.fire("decode")
+            s = self.faults.sleep_for(kind)
+            if s:
+                time.sleep(s)
+        valid, bad, front = out
+        v = np.asarray(valid)[:nb]
+        b = np.asarray(bad)[:nb]
+        if kind == "corrupt":
+            v, b = corrupt_arrays(v, b)
+        validate_decoded(v, b, batch.n_events)
+        fr = None
+        if self.return_frontier is True:
+            fr = np.asarray(front)[:nb]
+        elif self.return_frontier == "invalid":
+            fr = {}
+            rows = np.nonzero(~v)[0]
+            if rows.size:
+                sel = np.asarray(front[rows])      # device gather
+                for i, r in enumerate(rows):
+                    fr[int(r)] = sel[i]
+        return v, b, fr
+
     def _await(self, out, nb: int, batch: EncodedBatch,
                deadline: float, delay: float = 0.0):
         """Materialize one dispatch's outputs on a daemon thread under
@@ -621,29 +1015,7 @@ class BucketScheduler:
             try:
                 if delay:
                     time.sleep(delay)
-                kind = None
-                if self.faults is not None:
-                    kind = self.faults.fire("decode")
-                    s = self.faults.sleep_for(kind)
-                    if s:
-                        time.sleep(s)
-                valid, bad, front = out
-                v = np.asarray(valid)[:nb]
-                b = np.asarray(bad)[:nb]
-                if kind == "corrupt":
-                    v, b = corrupt_arrays(v, b)
-                validate_decoded(v, b, batch.n_events)
-                fr = None
-                if self.return_frontier is True:
-                    fr = np.asarray(front)[:nb]
-                elif self.return_frontier == "invalid":
-                    fr = {}
-                    rows = np.nonzero(~v)[0]
-                    if rows.size:
-                        sel = np.asarray(front[rows])  # device gather
-                        for i, r in enumerate(rows):
-                            fr[int(r)] = sel[i]
-                q.put(((v, b, fr), None))
+                q.put((self._decode_member(out, nb, batch), None))
             except BaseException as e:   # noqa: BLE001 — relayed below
                 q.put((None, e))
 
@@ -829,30 +1201,85 @@ class BucketScheduler:
                                            "device-retried")
         return out
 
+    def _await_group(self, members: List[Tuple], outs, delay: float):
+        """Materialize every member of one fused dispatch on a daemon
+        thread under ONE group deadline (the sum of the members'
+        per-chunk deadlines — the group is one device program, so the
+        watchdog must budget for all of it). Decode-stage faults fire
+        once per MEMBER (chunk ordinals, fusion-invariant — the
+        fault-schedule parity tests pin them); a corrupt fault
+        corrupts its member, and any member failing validation fails
+        the whole group (the ladder then re-decides each member
+        individually). Returns [(valid, bad, frontier)] per member."""
+        import queue
+        if self.faults is not None and self.faults.deadline_s is not None:
+            deadline = self.faults.deadline_s
+        else:
+            deadline = sum(self._deadline(run.batch, hi - lo)
+                           for run, lo, hi, _ in members)
+        q: "queue.Queue" = queue.Queue(1)
+
+        def work():
+            try:
+                if delay:
+                    time.sleep(delay)
+                # Decode-stage faults fire once per MEMBER inside
+                # _decode_member (chunk ordinals, fusion-invariant).
+                q.put(([self._decode_member(out, hi - lo, run.batch)
+                        for (run, lo, hi, _), out
+                        in zip(members, outs)], None))
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                q.put((None, e))
+
+        threading.Thread(target=work, name="jepsen-retire",
+                         daemon=True).start()
+        try:
+            r, err = q.get(timeout=deadline)
+        except queue.Empty:
+            self.stats["watchdog_fired"] += 1
+            rows = sum(hi - lo for _, lo, hi, _ in members)
+            raise WatchdogExpired(
+                f"fused group ({len(members)} chunks, {rows} rows) "
+                f"exceeded its {deadline:.2f}s decode deadline") \
+                from None
+        if err is not None:
+            raise err
+        return r
+
     def _retire(self, item) -> None:
-        run, lo, hi, out, Bp, delay = item
-        nb = hi - lo
+        members, outs, delay = item
         t0 = time.monotonic()
-        if isinstance(out, BaseException):
-            v, b, fr = self._recover(run.batch, lo, hi, Bp, out)
+        if isinstance(outs, BaseException):
+            results, cause = None, outs
         else:
             try:
-                v, b, fr = self._await(out, nb, run.batch,
-                                       self._deadline(run.batch, nb),
-                                       delay)
+                if len(members) == 1:
+                    run, lo, hi, Bp = members[0]
+                    results = [self._await(
+                        outs[0], hi - lo, run.batch,
+                        self._deadline(run.batch, hi - lo), delay)]
+                else:
+                    results = self._await_group(members, outs, delay)
             except Exception as e:
                 if classify_failure(e) is None:
                     raise
-                v, b, fr = self._recover(run.batch, lo, hi, Bp, e)
+                results, cause = None, e
+        if results is None:
+            # The group failed as a unit: every member walks the
+            # degradation ladder individually — the resilience spine is
+            # per-chunk, unchanged by fusion.
+            results = [self._recover(run.batch, lo, hi, Bp, cause)
+                       for run, lo, hi, Bp in members]
         wait = time.monotonic() - t0
         self.stats["device_wait_s"] += wait
         self._last_retire_t = time.monotonic()
         if self.stats["t_first_verdict_s"] is None:
             self.stats["t_first_verdict_s"] = round(
                 self._last_retire_t - self._t0, 4)
-        if self.on_chunk is not None:
-            self.on_chunk(run.batch, lo, hi, v, b, fr)
-        run.collect(v, b, fr)
+        for (run, lo, hi, _), (v, b, fr) in zip(members, results):
+            if self.on_chunk is not None:
+                self.on_chunk(run.batch, lo, hi, v, b, fr)
+            run.collect(v, b, fr)
 
     def _run_wide(self, mb: EncodedBatch):
         """Blocking wide/frontier/sharded dispatch with bounded retry.
@@ -865,6 +1292,9 @@ class BucketScheduler:
                 self.stats["retries"] += 1
                 time.sleep(self.backoff_s * (2 ** (attempt - 1)))
             try:
+                # One XLA call per attempt — the wide/frontier routes
+                # count toward dispatch economics like any other ship.
+                self.stats["dispatches"] += 1
                 out = run_encoded_batch(mb, self.return_frontier)
                 if attempt:
                     for i in mb.indices:
@@ -896,6 +1326,8 @@ class BucketScheduler:
             if b.batch:
                 stats[(b.V, b.W)] = (stats.get((b.V, b.W), 0.0)
                                      + b.batch * b.n_events)
+        self.stats["dispatch_overhead_us"] = round(
+            measure_dispatch_overhead_us(), 2)
         return choose_w_classes(stats, max_classes=self.max_classes)
 
     def _class_of(self, class_map: Dict, V: int, W: int) -> int:
@@ -943,13 +1375,21 @@ class BucketScheduler:
                 yield order.popleft().result(self.return_frontier)
 
         def retire_ready():
-            # Keep at most `depth` chunks in flight, then yield any
-            # bucket whose last chunk has decoded.
+            # Keep at most `depth` dispatch groups in flight, then
+            # yield any bucket whose last chunk has decoded.
             while len(inflight) >= self.depth:
                 self._retire(inflight.popleft())
             yield from yield_done()
 
+        def flush():
+            # Ship the accumulated chunk group as ONE fused XLA call.
+            if self._fuse_buf:
+                group, self._fuse_buf = self._fuse_buf, []
+                yield from retire_ready()
+                inflight.append(self._dispatch_group(group))
+
         def drain():
+            yield from flush()
             while inflight:
                 self._retire(inflight.popleft())
             yield from yield_done()
@@ -967,8 +1407,10 @@ class BucketScheduler:
             self.stats["orig_events"] += (
                 int(mb.orig_n_events.sum())
                 if mb.orig_n_events is not None else ev)
-            if wide or (mesh is not None and mb.batch >=
-                        mesh.shape["data"] * MIN_ROWS_PER_DEVICE):
+            shard = mesh is not None and mb.batch >= (
+                mesh.shape["data"] * MIN_ROWS_PER_DEVICE
+                if self.shard_min_rows is None else self.shard_min_rows)
+            if wide or shard:
                 # Wide/frontier/sharded routes keep their own dispatch
                 # logic (run_encoded_batch): drain the pipeline so
                 # yields stay in dispatch order, then run blocking
@@ -1000,8 +1442,18 @@ class BucketScheduler:
             st = _Run(mb, len(chunks))
             order.append(st)
             for lo, hi in chunks:
-                yield from retire_ready()
-                inflight.append(self._dispatch(st, lo, hi, Bp))
+                # Adaptive group commit: while the pipeline has
+                # capacity a chunk ships immediately (keeps the device
+                # busy and time-to-first-verdict low); under
+                # backpressure chunks accumulate and ship as ONE fused
+                # XLA call of up to fuse_width members (flush) — the
+                # many-small-buckets shape stops paying one dispatch
+                # each exactly when dispatch is the bottleneck.
+                # fuse_width=1 degenerates to the per-chunk flow.
+                self._fuse_buf.append((st, lo, hi, Bp))
+                if (len(inflight) < self.depth
+                        or len(self._fuse_buf) >= self.fuse_width):
+                    yield from flush()
 
         it = iter(groups)
         while True:

@@ -122,9 +122,11 @@ def recheck_family(store: Store, test_name: str, family: str, *,
     ``resume=True`` continues an interrupted linearizable recheck from
     its durable chunk journal (store/<test>/recheck.journal.jsonl):
     rows with journaled verdicts are never re-dispatched
-    (doc/resilience.md). Applies to the columnar device path — the
-    fold/bank families re-derive from scratch (they are one cheap
-    dispatch).
+    (doc/resilience.md). Applies to both linearizable device paths —
+    the whole-history columnar batch AND the ``independent`` strained
+    (run, key) units, whose journal rows are sub-histories (the
+    partition/resume contract, doc/scaling.md) — while the fold/bank
+    families re-derive from scratch (they are one cheap dispatch).
     """
     from .store import group_unit_results
 

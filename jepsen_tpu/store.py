@@ -415,56 +415,35 @@ class Store:
                     return {"valid": "unknown", "runs": {},
                             "error":
                             f"no stored histories for {test_name!r}"}
-            journal = None
             try:
                 if machine is not None:
                     cols, labels = machine
                 else:
                     cols = jsonl_to_columnar(model, texts)
-                # Chunk journal: retired verdicts land durably as the
-                # stream runs, keyed to this exact batch, so a crashed
-                # or killed recheck resumes from completed chunks.
-                journal = ChunkJournal(
-                    self.base / test_name / "recheck.journal.jsonl",
-                    {"model": repr(model), "rows": cols.batch,
-                     "digest": columnar_digest(cols)},
-                    resume=resume)
                 # Lazy details: only invalid rows pay the Python replay
                 # decode and the frontier transfer — valid rows stay at
                 # tensor speed, matching the reference's
                 # render-only-failures discipline (checker.clj:98-103).
                 # Tiny tall-W buckets ride the native engine instead of
                 # paying a latency-bound device round trip each.
-                rs = check_columnar(model, cols, details="invalid",
-                                    min_device_batch=64,
-                                    journal=journal, faults=faults)
-                resume_hits = journal.resume_hits
-                journal.finish()
-                out = group_unit_results(labels, rs)
-                if resume:
-                    out["resume_hits"] = resume_hits
-                return out
+                return self._journaled_recheck(
+                    test_name,
+                    {"model": repr(model), "rows": cols.batch,
+                     "digest": columnar_digest(cols)},
+                    resume, labels,
+                    lambda journal: check_columnar(
+                        model, cols, details="invalid",
+                        min_device_batch=64, journal=journal,
+                        faults=faults))
             except StateSpaceExplosion:
                 # Vocabulary too rich for the packed table: degrade to
                 # the Op-list path, whose batch checker falls back to
                 # per-history engines (linearize.py's explosion route).
-                # The journal is keyed to the exploded columnar form —
-                # useless now, so drop it rather than confuse a later
-                # resume.
-                if journal is not None:
-                    journal.finish()
                 units = [loaded["history"] for t in ts
                          if "history" in
                          (loaded := self.load(test_name, t))]
                 rs = check_batch_columnar(model, units,
                                           details="invalid")
-            except BaseException:
-                # Interrupted/failed mid-stream: keep the journal ON
-                # DISK (that is its whole purpose) but release the
-                # handle.
-                if journal is not None:
-                    journal.close()
-                raise
         else:
             units, labels = self.strain_units(test_name, ts,
                                               independent=True)
@@ -474,8 +453,53 @@ class Store:
                 # histories to check".
                 return {"valid": "unknown", "runs": {},
                         "error": f"no stored histories for {test_name!r}"}
-            rs = check_batch_columnar(model, units, details="invalid")
+            # The strained (run, key) units are the batch rows: journal
+            # them like the columnar path, so an interrupted
+            # independent recheck resumes with zero decided
+            # sub-histories re-dispatched (the partition/resume
+            # contract, doc/scaling.md "Partition, then fuse").
+            return self._journaled_recheck(
+                test_name,
+                {"model": repr(model), "rows": len(units),
+                 "independent": True,
+                 "digest": _units_digest(units, labels)},
+                resume, labels,
+                lambda journal: check_batch_columnar(
+                    model, units, details="invalid", journal=journal,
+                    faults=faults))
         return group_unit_results(labels, rs)
+
+    def _journaled_recheck(self, test_name: str, header: dict,
+                           resume: bool, labels, call):
+        """One batched recheck under a durable chunk journal — the
+        shared lifecycle of the columnar and independent-unit paths:
+        retired verdicts land durably as the stream runs, keyed to the
+        exact batch (``header``), so a crashed or killed recheck
+        resumes from completed chunks. ``call(journal)`` runs the
+        check; an interrupted run keeps the journal ON DISK (that is
+        its whole purpose), while a StateSpaceExplosion drops it —
+        the journal is keyed to the exploded form, useless to any
+        later resume — before propagating to the caller's degradation
+        route."""
+        from .ops.statespace import StateSpaceExplosion
+
+        journal = ChunkJournal(
+            self.base / test_name / "recheck.journal.jsonl",
+            header, resume=resume)
+        try:
+            rs = call(journal)
+            resume_hits = journal.resume_hits
+            journal.finish()
+        except StateSpaceExplosion:
+            journal.finish()
+            raise
+        except BaseException:
+            journal.close()
+            raise
+        out = group_unit_results(labels, rs)
+        if resume:
+            out["resume_hits"] = resume_hits
+        return out
 
     def _load_machine_forms(self, test_name: str, ts, model):
         """(ColumnarOps, labels) assembled from every run's machine-form
@@ -841,6 +865,23 @@ class CampaignCheckpoint:
             pass
 
 
+def _units_digest(units, labels) -> str:
+    """Content fingerprint of strained (run, key) history units — the
+    independent recheck's journal key component. Every op line feeds
+    the hash: a re-salvage can flip a dangling MIDDLE invocation
+    between ok and :info without touching counts or endpoints, and a
+    journal keyed to the old contents must be discarded, never
+    trusted."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for (ts, k), u in zip(labels, units):
+        h.update(f"{ts}|{k!r}|{len(u)}".encode())
+        for op in u:
+            h.update(str(op).encode())
+    return h.hexdigest()[:16]
+
+
 def columnar_digest(cols) -> str:
     """Content fingerprint of a ColumnarOps batch — the chunk-journal
     key component that pins a journal to one exact row set/order."""
@@ -853,6 +894,13 @@ def columnar_digest(cols) -> str:
         h.update(np.ascontiguousarray(arr).tobytes())
     if cols.index is not None:
         h.update(np.ascontiguousarray(cols.index).tobytes())
+    # The key column determines the partitioned journal's entire
+    # (history, key) sub-row namespace: two batches differing only in
+    # key assignment must never share a journal.
+    key = getattr(cols, "key", None)
+    if key is not None:
+        h.update(b"key")
+        h.update(np.ascontiguousarray(key).tobytes())
     h.update(json.dumps(list(map(list, cols.kinds)), default=str)
              .encode())
     return h.hexdigest()[:16]

@@ -192,7 +192,8 @@ def cas_kind_vocabulary(n_values: int):
 
 def synth_cas_columnar(n: int, seed: int = 0, *, n_procs: int = 5,
                        n_ops: int = 40, n_values: int = 5,
-                       corrupt: float = 0.0, p_info: float = 0.0):
+                       corrupt: float = 0.0, p_info: float = 0.0,
+                       n_keys: int = 1):
     """Vectorized batch twin of ``synth_cas_history``: simulate ``n``
     register histories in lockstep with one numpy step loop (every
     iteration advances every unfinished history by one line). Returns a
@@ -201,11 +202,20 @@ def synth_cas_columnar(n: int, seed: int = 0, *, n_procs: int = 5,
 
     One (n, seed, params) tuple ↦ one deterministic batch — the
     north-star batch mode's workload generator at tensor speed.
-    """
+
+    ``n_keys > 1`` simulates ``n_keys`` independent registers per
+    history (the jepsen ``independent`` workload shape): each op picks
+    a key, both its lines carry the key id in the batch's ``key``
+    column, and linearizability decomposes per key (Herlihy–Wing
+    locality — the P-compositional pre-partition in ops.partition
+    strains the batch before encoding). ``n_keys=1`` is draw-for-draw
+    identical to the historical single-register generator (no key
+    column, same rng sequence)."""
     from ..history.columnar import (ColumnarOps, C_INVOKE, C_OK, C_INFO,
                                     PAD)
     rng = np.random.default_rng(seed)
     B, P, N = n, n_procs, 2 * n_ops
+    keyed = n_keys > 1
     READ0 = 0                     # kind ids: read(None)=0, read(v)=1+v
     WRITE0 = 1 + n_values         # write(v)
     CAS0 = 1 + 2 * n_values      # cas(a,b) = CAS0 + a*n_values + b
@@ -214,10 +224,14 @@ def synth_cas_columnar(n: int, seed: int = 0, *, n_procs: int = 5,
     proc = np.zeros((B, N), np.int16)
     kind = np.full((B, N), -1, np.int32)
 
-    reg = np.full(B, -1, np.int32)          # -1 = None (never written)
+    # Per-key register state; column 0 is the whole register when
+    # unkeyed (reg[i, 0] reads/writes reproduce the historical arrays).
+    reg = np.full((B, max(n_keys, 1)), -1, np.int32)   # -1 = None
     busy_f = np.full((B, P), -1, np.int8)   # 0=read 1=write 2=cas
     busy_a = np.zeros((B, P), np.int32)
     busy_b = np.zeros((B, P), np.int32)
+    busy_k = np.zeros((B, P), np.int32)     # key per live op (0 unkeyed)
+    key_col = np.full((B, N), -1, np.int32) if keyed else None
     inv_pos = np.zeros((B, P), np.int32)
     started = np.zeros(B, np.int32)
     n_live = np.zeros(B, np.int32)
@@ -246,6 +260,12 @@ def synth_cas_columnar(n: int, seed: int = 0, *, n_procs: int = 5,
             busy_f[i, p] = f
             busy_a[i, p] = a
             busy_b[i, p] = b
+            if keyed:
+                # Key draw gated on keyed so n_keys=1 keeps the
+                # historical rng sequence draw-for-draw.
+                k = rng.integers(0, n_keys, len(i)).astype(np.int32)
+                busy_k[i, p] = k
+                key_col[i, pos[i]] = k
             inv_pos[i, p] = pos[i]
             started[i] += 1
             n_live[i] += 1
@@ -258,17 +278,20 @@ def synth_cas_columnar(n: int, seed: int = 0, *, n_procs: int = 5,
             p = score.argmax(1).astype(np.int16)
             f = busy_f[i, p]
             a, b = busy_a[i, p], busy_b[i, p]
+            k = busy_k[i, p]
             is_info = rng.random(len(i)) < p_info
             applies = rng.random(len(i)) < 0.5     # info ops: took effect?
             ip = inv_pos[i, p]
             j = pos[i]
             typ[i, j] = C_OK
             proc[i, j] = p
+            if keyed:
+                key_col[i, j] = k
 
             rd, wr, cs = f == 0, f == 1, f == 2
             # read: observes reg; info-read observed nothing -> identity
             # -> drop both lines (the shared never-ok identity rule)
-            obs = reg[i]
+            obs = reg[i, k]
             kind[i, ip] = np.where(obs < 0, READ0, READ0 + 1 + obs)
             drop = rd & is_info
             typ[i[drop], j[drop]] = PAD
@@ -277,13 +300,13 @@ def synth_cas_columnar(n: int, seed: int = 0, *, n_procs: int = 5,
             # write: reg = v on ok; on info, half apply
             kind[i[wr], ip[wr]] = WRITE0 + a[wr]
             w_apply = wr & (~is_info | applies)
-            reg[i[w_apply]] = a[w_apply]
+            reg[i[w_apply], k[w_apply]] = a[w_apply]
             # cas: ok iff reg == a (else FAIL: both lines PAD);
             # info: half apply when it would have matched
             kind[i[cs], ip[cs]] = CAS0 + a[cs] * n_values + b[cs]
-            match = reg[i] == a
+            match = reg[i, k] == a
             c_apply = cs & match & (~is_info | applies)
-            reg[i[c_apply]] = b[c_apply]
+            reg[i[c_apply], k[c_apply]] = b[c_apply]
             fail = cs & ~match & ~is_info
             typ[i[fail], j[fail]] = PAD
             typ[i[fail], ip[fail]] = PAD
@@ -310,4 +333,5 @@ def synth_cas_columnar(n: int, seed: int = 0, *, n_procs: int = 5,
         kind[i, c] = READ0 + 1 + (old + delta) % n_values
 
     return ColumnarOps(type=typ, process=proc, kind=kind,
-                       kinds=cas_kind_vocabulary(n_values))
+                       kinds=cas_kind_vocabulary(n_values),
+                       key=key_col)

@@ -21,6 +21,12 @@ os.environ.setdefault("JT_COMPILE_CACHE", "0")
 # measure the window itself set it explicitly.
 os.environ.setdefault("JT_WAL_FLUSH_MS", "250")
 
+# Pin the W-class DP's per-dispatch overhead term: the startup
+# calibration probe is machine-dependent wall time, and the
+# consolidation tests assert exact class choices. Tests of the term
+# itself pass ``overhead=`` explicitly.
+os.environ.setdefault("JT_DISPATCH_OVERHEAD_US", "0")
+
 provision_in_process(8)
 
 
@@ -40,3 +46,8 @@ def pytest_configure(config):
                    "salvage parity under subprocess SIGKILLs and "
                    "seed-campaign resume (deterministic; runs in "
                    "tier-1)")
+    config.addinivalue_line(
+        "markers", "partition: P-compositional pre-partition + fused "
+                   "dispatch — per-key W collapse, verdict "
+                   "recombination, and partitioned-vs-exact parity "
+                   "(deterministic; runs in tier-1)")

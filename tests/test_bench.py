@@ -72,3 +72,28 @@ def test_bench_emits_contract_json():
     assert x["histories"] > 0 and x["events_per_s"] > 0
     assert x["encode_s"] >= 0 and x["device_s"] > 0   # the breakdown
     assert x["event_chunked"]["events_per_s"] > 0
+    # Partition section (ISSUE 6 acceptance): P-compositional W
+    # collapse + fused-dispatch economics + AOT shipping accounting.
+    p = d["partition"]
+    assert p["enabled"] is True and p["n_keys"] > 1
+    assert p["sub_histories"] > d["histories"]
+    assert p["subs_per_history"] > 1
+    assert p["pre_w_hist"] and p["post_w_hist"]
+    # The strain can only shrink pending windows.
+    assert (max(int(w) for w in p["post_w_hist"])
+            <= max(int(w) for w in p["pre_w_hist"]))
+    # dispatches counts EVERY XLA call — chunked ships, fused groups,
+    # and the wide/sharded routes (which bypass chunking entirely), so
+    # it can legitimately exceed the chunk count at toy scale.
+    assert p["dispatches_per_run"] >= 1
+    assert p["dispatch_overhead_us"] is not None
+    aot = p["aot"]
+    assert aot["mode"] in ("cold", "warm")
+    assert aot["compile_s"] >= 0
+    for k in ("hits", "misses", "exported", "rejected"):
+        assert aot[k] >= 0
+    # Routing-reason breakdown sums to the legacy counter.
+    cr = d["cpu_routed"]
+    assert (cr["oversize_w"] + cr["overflow"]
+            == d["cpu_routed_rows"])
+    assert cr["quarantine"] == 0

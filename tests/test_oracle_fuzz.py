@@ -179,6 +179,51 @@ def test_fuzz_tpu_engine_matches_oracle(blind_corpus, oracle_verdicts):
     assert bad == [], bad[:5]
 
 
+def test_fuzz_partitioned_matches_oracle(blind_corpus, oracle_verdicts):
+    """P-compositional pre-partition parity, corpus-wide: KV-valued
+    histories assembled from the blind register corpus (3 oracle-known
+    parts interleaved per merged history, ops.partition.
+    merge_kv_histories) check through the partitioned device path
+    (check_batch_tpu partition="auto"). Per history: the valid bit is
+    the AND of the parts' brute-oracle verdicts, the witness names an
+    invalid key (``independent_key`` + ``failures`` = every invalid
+    key), and the reported bad op maps back THROUGH the partition —
+    its index lands on an op of the witness key in the merged history
+    and equals the witness subhistory's own exact verdict."""
+    from jepsen_tpu.independent import is_kv, subhistory
+    from jepsen_tpu.ops.linearize import check_batch_tpu
+    from jepsen_tpu.ops.partition import merge_kv_histories
+    K = 3
+    n_invalid = 0
+    for family in ("cas", "cas-absent"):
+        model, hists = blind_corpus[family]
+        want = oracle_verdicts[family]
+        merged, truth = [], []
+        for i in range(0, len(hists) - K + 1, K):
+            merged.append(merge_kv_histories(
+                {k: hists[i + k] for k in range(K)}))
+            truth.append({k: want[i + k]["valid"] for k in range(K)})
+        rs = check_batch_tpu(model, merged, max_states=24)
+        for i, (h, t, r) in enumerate(zip(merged, truth, rs,
+                                          strict=True)):
+            assert (r["valid"] is True) == all(t.values()), (family, i)
+            if r["valid"] is not False:
+                continue
+            n_invalid += 1
+            wk = r["independent_key"]
+            assert t[wk] is False, (family, i, wk)
+            assert set(r["failures"]) == \
+                {k for k, v in t.items() if not v}, (family, i)
+            bad = h[r["op"]["index"]]
+            assert bad.index == r["op"]["index"], (family, i)
+            assert is_kv(bad.value) and bad.value.key == wk, (family, i)
+            exact = wgl_check(model, subhistory(wk, h))
+            assert exact["valid"] is False, (family, i)
+            assert r["op"]["index"] == exact["op"]["index"], (family, i)
+    assert n_invalid > 0, \
+        "no invalid merged history: the witness assertions were vacuous"
+
+
 def test_fuzz_streamed_scheduler_matches_exact_path(blind_corpus):
     """The streamed bucket scheduler (ops.schedule) vs the exact-W flow
     on the full blind corpus, field-for-field: valid, bad op index, and
