@@ -32,7 +32,14 @@ JT_BENCH_VPU_GOPS / JT_BENCH_HBM_PEAK_GBPS / JT_BENCH_MXU_TMACS
 figure; 0 skips), JT_BENCH_WAL_OPS (run-durability figure: live-WAL
 worker-loop overhead, group-commit flush percentiles, salvage
 throughput; 0 skips),
-JT_FUSE_KINDS (event-fusion vocabulary budget, ops/encode.py). Narrow
+JT_FUSE_KINDS (event-fusion vocabulary budget, ops/encode.py),
+JT_BENCH_SYNTH=device|host (headline workload generator: ``host`` is
+the legacy lockstep numpy generator, byte-identical to every earlier
+round; ``device`` synthesizes the headline batch with the jitted
+counter-PRNG generator of ops/synth_device.py — same logical
+parameters, its own stream), JT_BENCH_SYNTH_B (rows for the
+synth_device section's host-vs-device rate comparison; 0 skips it),
+JT_BENCH_FUZZ=0 (skip the fuzz-loop figure). Narrow
 buckets all stay on device (the scheduler consolidates them into W
 classes); only tiny wide buckets route to the native CPU engine. The
 encode runs the production shrink passes (event fusion + state
@@ -91,21 +98,47 @@ def main():
     # single-register history the exact engines understand).
     # JT_BENCH_KEYS=1 restores the literal unkeyed r05 run.
     n_keys = int(os.environ.get("JT_BENCH_KEYS", "8"))
-    t0 = time.time()
-    cols_raw = synth_cas_columnar(B, seed=1, n_procs=5, n_ops=n_ops,
-                                  n_values=5, corrupt=0.1, p_info=0.01,
-                                  n_keys=n_keys)
-    t_synth = time.time() - t0
+    synth_mode = os.environ.get("JT_BENCH_SYNTH", "host")
+    from dataclasses import replace as _dc_replace
+
+    from jepsen_tpu.ops.synth_device import SynthSpec, synthesize
+    headline_spec = SynthSpec(family="cas", n=B, seed=1, n_procs=5,
+                              n_ops=n_ops, n_values=5, corrupt=0.1,
+                              p_info=0.01, n_keys=n_keys)
+    synth_meta = None
+    if synth_mode == "device":
+        # Generate the headline batch ON DEVICE (ops/synth_device):
+        # born in the columnar layout, partition metadata included —
+        # the generate-where-you-check path. Compile warms outside the
+        # clock like every other section.
+        synthesize(headline_spec, "device")
+        t0 = time.time()
+        cols_raw, synth_meta = synthesize(headline_spec, "device")
+        t_synth = time.time() - t0
+    else:
+        # The legacy lockstep generator — byte-identical to r06.
+        t0 = time.time()
+        cols_raw = synth_cas_columnar(B, seed=1, n_procs=5,
+                                      n_ops=n_ops, n_values=5,
+                                      corrupt=0.1, p_info=0.01,
+                                      n_keys=n_keys)
+        t_synth = time.time() - t0
 
     from jepsen_tpu.ops.partition import (partition_columnar,
                                           pending_w_hist,
                                           recombine_verdicts)
+    # Device-synthesized batches answer both histograms from generator
+    # metadata (pending_w_hist consults cols.meta; the post hist comes
+    # straight off SynthMeta) — no full-batch line-grid re-scan.
     pre_w_hist = pending_w_hist(cols_raw)
     t0 = time.time()
     pb = partition_columnar(cols_raw)
     t_partition = time.time() - t0
     cols = pb.cols if pb is not None else cols_raw
-    post_w_hist = pending_w_hist(cols)
+    post_w_hist = (synth_meta.sub_w_hist()
+                   if synth_meta is not None
+                   and synth_meta.sub_w_hist() is not None
+                   else pending_w_hist(cols))
     S = cols.batch                    # sub-history rows (== B unkeyed)
 
     # Window headroom: the device wide path (data1wide / frontier mesh)
@@ -791,10 +824,12 @@ def main():
         # is where the partition pays twice — per-sub scan LENGTH
         # drops n_keys-fold (the sequential axis the long probe is
         # bound by) on top of the W collapse.
+        t0 = time.time()
         c_raw = synth_cas_columnar(n_hist, seed=seed, n_procs=5,
                                    n_ops=n_ops, n_values=5,
                                    corrupt=0.1, p_info=0.0,
                                    n_keys=n_keys)
+        t_probe_synth = time.time() - t0
         t0 = time.time()
         p = partition_columnar(c_raw)
         t_part = time.time() - t0
@@ -826,6 +861,7 @@ def main():
         bad = int(sum(int((~v).sum()) for v, _, _ in outs_p))
         return {"histories": n_hist,
                 "sub_histories": c.batch,
+                "synth_s": round(t_probe_synth, 3),
                 "rate": round(n_hist * (n / max(c.batch, 1))
                               / (t_part + t_enc + t), 2),
                 "events_per_s": round(ev / t, 1),
@@ -880,6 +916,107 @@ def main():
                 "chunk_events": echunk,
                 "device_s": round(t, 3),
                 "events_per_s": round(ev / t, 1)}
+
+    # ------------------------------------------- on-device synthesis
+    # Generate-where-you-check (ops/synth_device, doc/scaling.md): the
+    # host numpy generator vs the jitted counter-PRNG device generator
+    # at the headline shape, the streamed generate→partition→encode→
+    # dispatch source's time-to-first-dispatch, and the witness-guided
+    # fuzz loop's iteration rate. The CPU backend is a proxy — the
+    # generator is pure vmapped-style array code, so an accelerator
+    # backend scales it with its parallel throughput while the host
+    # generator stays a host generator.
+    synth_section = None
+    SDB = int(os.environ.get("JT_BENCH_SYNTH_B", str(B)))
+    if SDB:
+        from jepsen_tpu.ops.schedule import iter_synth_groups
+        from jepsen_tpu.workloads.synth import cas_kind_vocabulary
+        sd_spec = _dc_replace(headline_spec, n=SDB)
+        if synth_mode == "host" and SDB == B:
+            t_host_synth = t_synth
+        else:
+            t0 = time.time()
+            synth_cas_columnar(SDB, seed=1, n_procs=5, n_ops=n_ops,
+                               n_values=5, corrupt=0.1, p_info=0.01,
+                               n_keys=n_keys)
+            t_host_synth = time.time() - t0
+        # key_meta=False is the generator exactly as the check source
+        # consumes it (the per-key histograms are the headline device
+        # mode's extra), and it lets the rate, streamed, and fuzz
+        # figures below share ONE compiled generator shape — compiles
+        # here run uncached under the hermetic test contract.
+        synthesize(sd_spec, "device", key_meta=False)     # compile
+        sd_times = []
+        for _ in range(max(2, repeats)):
+            t0 = time.time()
+            synthesize(sd_spec, "device", key_meta=False)
+            sd_times.append(time.time() - t0)
+        t_dev_synth = statistics.median(sd_times)
+
+        # Streamed synth source: the scheduler pulls generated groups
+        # directly (zero host Op lists, zero full-batch materialize);
+        # t_first_dispatch is how long the device idles before the
+        # first generated chunk ships.
+        from jepsen_tpu.ops.linearize import WindowOverflow as _WO
+        from jepsen_tpu.ops.schedule import DIVERTED as _DIV
+        space_sd = enumerate_statespace(model,
+                                        cas_kind_vocabulary(5), 64)
+
+        def run_synth_streamed():
+            sch = BucketScheduler()
+            n = 0
+            for bt, out in sch.run(iter_synth_groups(space_sd, sd_spec,
+                                                     max_slots=eff_slots)):
+                if out is _DIV or isinstance(out, _WO):
+                    continue
+                n += bt.batch
+            return n, sch.stats
+
+        run_synth_streamed()                     # warm the shapes
+        t0 = time.time()
+        n_sd, sd_stats = run_synth_streamed()
+        t_sd_e2e = time.time() - t0
+
+        fuzz_section = None
+        if os.environ.get("JT_BENCH_FUZZ", "1") != "0":
+            from jepsen_tpu.fuzz import fuzz_campaign
+            fz_spec = _dc_replace(sd_spec, n=min(SDB, 256))
+            fuzz_campaign(fz_spec, rounds=1, neighborhood=2,
+                          max_witnesses=4, name=None)   # warm
+            t0 = time.time()
+            fz = fuzz_campaign(fz_spec, rounds=1, neighborhood=2,
+                               max_witnesses=4, name=None)
+            t_fz = time.time() - t0
+            fuzz_section = {
+                "histories": fz["checked"],
+                "neighborhoods": fz["neighborhoods"],
+                "neighborhood_invalid": fz["neighborhood_invalid"],
+                "iters_per_s": round((fz["checked"]
+                                      + fz["neighborhoods"]) / t_fz, 2),
+                "min_anomaly_lines": fz["min_anomaly_lines"],
+            }
+        synth_section = {
+            "histories": SDB,
+            "mode": synth_mode,
+            "host_s": round(t_host_synth, 3),
+            "device_s": round(t_dev_synth, 3),
+            "host_hist_per_s": round(SDB / t_host_synth, 1),
+            "device_hist_per_s": round(SDB / t_dev_synth, 1),
+            "host_ops_per_s": round(SDB * 2 * n_ops / t_host_synth, 1),
+            "device_ops_per_s": round(SDB * 2 * n_ops / t_dev_synth, 1),
+            "device_vs_host_speedup": round(t_host_synth / t_dev_synth,
+                                            2),
+            # Explicitly per SUB-history: the streamed source yields
+            # partitioned (history, key) rows, and normalizing back to
+            # original histories would need a second full-batch
+            # partition pass — so the unit is named instead of mixed
+            # in with the per-history figures above.
+            "streamed_gen_check_subs_per_s": round(n_sd / t_sd_e2e, 2)
+            if n_sd else None,
+            "streamed_subs_checked": n_sd,
+            "t_first_dispatch_s": sd_stats.get("t_first_dispatch_s"),
+            "fuzz": fuzz_section,
+        }
 
     print(json.dumps({
         "metric": "linearizability_check_throughput_1kop_cas_e2e",
@@ -994,6 +1131,14 @@ def main():
         "e2e_time_s": round(t_e2e, 3),
         "compile_time_s": round(t_compile, 2),
         "synth_time_s": round(t_synth, 2),
+        # Headline synth broken out: which generator produced the
+        # batch, and what share of the whole loop (synth + partition +
+        # encode + device) generation cost — the ~38%-to-<10% axis.
+        "synth": {
+            "mode": synth_mode,
+            "share_of_e2e": round(t_synth / (t_synth + t_e2e), 4),
+        },
+        "synth_device": synth_section,
     }))
 
 

@@ -611,9 +611,99 @@ def salvage_cmd() -> dict:
     return {"salvage": {"add_opts": add_opts, "run": run}}
 
 
+def fuzz_cmd() -> dict:
+    """``fuzz``: the witness-guided synthesis fuzz loop
+    (jepsen_tpu.fuzz): device-synthesize a seeded batch, check it, and
+    re-dispatch PRNG neighborhoods (op-order / value-collision /
+    nemesis-shift perturbations) around every invalid history —
+    resumable through the campaign checkpoint + chunk journals like
+    every other long-running campaign. ``--verify N`` re-checks every
+    Nth neighborhood history on the exact host engine (oracle fuzzing
+    of the checker itself); exit 1 iff any verdict disagreed — finding
+    invalid HISTORIES is the fuzz working, finding a checker
+    disagreement is the alarm."""
+    def add_opts(p):
+        p.add_argument("--name", default="fuzz",
+                       help="Campaign name (store/<name>/ holds the "
+                            "checkpoint, journals, and summaries)")
+        p.add_argument("--histories", type=int, default=1024,
+                       help="Histories per round")
+        p.add_argument("--rounds", type=int, default=1)
+        p.add_argument("--seed", type=int, default=0)
+        p.add_argument("--n-ops", dest="n_ops", type=int, default=40)
+        p.add_argument("--n-procs", dest="n_procs", type=int, default=5)
+        p.add_argument("--n-values", dest="n_values", type=int,
+                       default=5)
+        p.add_argument("--keys", dest="n_keys", type=int, default=1,
+                       help="Independent registers per history (the "
+                            "P-compositional partition strains them)")
+        p.add_argument("--corrupt", type=float, default=0.05,
+                       help="Per-history invalidation probability — "
+                            "the witness source")
+        p.add_argument("--p-info", dest="p_info", type=float,
+                       default=0.05)
+        p.add_argument("--crash-window", dest="crash_window",
+                       default=None, metavar="LO:HI:P",
+                       help="Nemesis window: ops in [LO, HI) crash "
+                            "with probability P (e.g. 5:20:0.3)")
+        p.add_argument("--neighborhood", type=int, default=4,
+                       help="Variants per (witness, mode)")
+        p.add_argument("--max-witnesses", dest="max_witnesses",
+                       type=int, default=8)
+        p.add_argument("--synth", default="device",
+                       choices=["device", "numpy"],
+                       help="Generator backend (numpy = the host twin)")
+        p.add_argument("--verify", type=int, default=None,
+                       help="Oracle-verify every Nth neighborhood "
+                            "history on the exact host engine")
+        p.add_argument("--resume", action="store_true", default=False,
+                       help="Resume a killed campaign from its "
+                            "checkpoint: finished rounds rehydrate, "
+                            "the in-flight round re-dispatches zero "
+                            "decided histories")
+        p.add_argument("--no-store", action="store_true",
+                       help="Ephemeral campaign (no checkpoint)")
+
+    def run(opts):
+        import json as _json
+
+        from .fuzz import fuzz_campaign
+        from .ops.synth_device import SynthSpec
+
+        crash_lo = crash_hi = 0
+        p_crash = 0.0
+        if opts.crash_window:
+            try:
+                lo, hi, p = opts.crash_window.split(":")
+                crash_lo, crash_hi, p_crash = int(lo), int(hi), float(p)
+            except ValueError:
+                print("--crash-window wants LO:HI:P (e.g. 5:20:0.3)")
+                return 254
+        spec = SynthSpec(family="cas", n=opts.histories, seed=opts.seed,
+                         n_procs=opts.n_procs, n_ops=opts.n_ops,
+                         n_values=opts.n_values, n_keys=opts.n_keys,
+                         corrupt=opts.corrupt, p_info=opts.p_info,
+                         crash_lo=crash_lo, crash_hi=crash_hi,
+                         p_crash=p_crash)
+        out = fuzz_campaign(spec, rounds=opts.rounds,
+                            neighborhood=opts.neighborhood,
+                            max_witnesses=opts.max_witnesses,
+                            synth=opts.synth,
+                            name=None if opts.no_store else opts.name,
+                            resume=opts.resume, verify=opts.verify)
+        line = {k: out[k] for k in
+                ("rounds", "checked", "invalid", "neighborhoods",
+                 "neighborhood_invalid", "verified", "disagreements",
+                 "min_anomaly_lines")}
+        print(_json.dumps(line, default=str))
+        return 1 if out["disagreements"] else 0
+
+    return {"fuzz": {"add_opts": add_opts, "run": run}}
+
+
 def main(argv: Optional[Sequence[str]] = None) -> None:
     run_cli({**suite_cmd(), **serve_cmd(), **recheck_cmd(),
-             **salvage_cmd()}, argv)
+             **salvage_cmd(), **fuzz_cmd()}, argv)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,285 @@
+"""Witness-guided synthesis fuzzing: generate → check → mutate, closed
+on device.
+
+The device generators (ops.synth_device) make re-synthesis cheaper than
+storing histories, which turns the checker pipeline into a fuzz loop:
+check a seeded batch, and for every INVALID history (the witness)
+re-synthesize its PRNG neighborhood — ``order`` (same ops, perturbed
+interleavings), ``values`` (same schedule, perturbed values — value
+collisions), ``nemesis`` (shifted crash window, re-drawn
+timeout/crash coins) — and re-dispatch the whole neighborhood as one
+batch. Two things fall out:
+
+  * **oracle fuzzing at scale** (``verify=``): every Nth neighborhood
+    history ALSO decodes to the host Op-list form and re-checks on the
+    exact host engine; a verdict disagreement is a checker bug, found
+    by millions of generated histories instead of a hand corpus.
+  * **minimal anomalies**: among the invalid neighbors the driver
+    tracks the smallest witness (fewest real lines) — mutating around
+    a failure hunts the cheapest history that still exhibits it.
+
+Durability rides the existing spine, nothing new: each round's base
+batch and neighborhood batch check under their own ChunkJournals keyed
+by ``store.spec_digest`` (the spec names the batch — no
+materialize-to-fingerprint), and rounds advance through a
+CampaignCheckpoint. A killed campaign resumed with ``resume=True``
+re-dispatches ZERO decided histories or neighborhoods: finished rounds
+rehydrate their saved summaries, the in-flight round's journals slice
+decided rows out before encoding (the PR-5/PR-6 resume discipline).
+
+``jepsen-tpu fuzz`` (cli.py) is the operator surface.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger("jepsen.fuzz")
+
+
+def _round_spec(spec, r: int):
+    """Round r's base spec: the campaign seed stream is seed + r (the
+    workloads.synth seed_stream discipline)."""
+    return dataclasses.replace(spec, seed=spec.seed + r)
+
+
+def fuzz_round(model, rspec, *, synth: str, neighborhood: int,
+               max_witnesses: int, modes: Sequence[str],
+               journal_dir: Optional[Path], resume: bool,
+               verify: Optional[int] = None,
+               check_kwargs: Optional[dict] = None) -> dict:
+    """One generate → check → mutate → re-dispatch round. Returns the
+    round summary; journals (when ``journal_dir`` is set) make it
+    resumable mid-round with zero re-dispatched rows."""
+    from .ops.linearize import check_synth, check_columnar
+    from .ops.synth_device import synth_cas_neighbors
+    from .store import ChunkJournal, spec_digest
+
+    # Neighborhoods are PRNG perturbations of the device family's
+    # stream: the legacy host stream's row r is an unrelated history,
+    # so fuzzing "around" its witnesses would be meaningless.
+    assert synth in ("device", "numpy"), \
+        "fuzz runs on the device generator family (device|numpy)"
+    kw = dict(check_kwargs or {})
+    base_j = neigh_j = None
+    if journal_dir is not None:
+        base_j = ChunkJournal(
+            journal_dir / f"fuzz-{rspec.seed}.base.jsonl",
+            {"spec": spec_digest(rspec, synth=synth, stage="base")},
+            resume=resume)
+    try:
+        valid, bad = check_synth(model, rspec, synth=synth,
+                                 journal=base_j, **kw)
+    finally:
+        if base_j is not None:
+            base_j.close()
+
+    witnesses = np.flatnonzero(~np.asarray(valid))[:max_witnesses]
+    neighbors = [(int(row), mode, var)
+                 for row in witnesses.tolist()
+                 for mode in modes
+                 for var in range(neighborhood)]
+    out = {
+        "seed": int(rspec.seed),
+        "checked": int(len(valid)),
+        "invalid": int((~np.asarray(valid)).sum()),
+        "witnesses": [int(w) for w in witnesses.tolist()],
+        "neighborhoods": len(neighbors),
+        "neighborhood_invalid": 0,
+        "min_anomaly_lines": None,
+        "verified": 0,
+        "disagreements": 0,
+    }
+    if not neighbors:
+        if base_j is not None:
+            base_j.finish()       # round complete: nothing to mutate
+        return out
+
+    ncols, _meta = synth_cas_neighbors(rspec, neighbors, backend=synth)
+    if journal_dir is not None:
+        neigh_j = ChunkJournal(
+            journal_dir / f"fuzz-{rspec.seed}.neigh.jsonl",
+            {"spec": spec_digest(rspec, synth=synth, stage="neigh",
+                                 neighborhood=neighborhood,
+                                 modes=list(modes),
+                                 witnesses=[int(w) for w in witnesses])},
+            resume=resume)
+    try:
+        nvalid, nbad = check_columnar(model, ncols, journal=neigh_j,
+                                      **kw)
+    finally:
+        if neigh_j is not None:
+            neigh_j.close()
+    nvalid = np.asarray(nvalid)
+    inv_rows = np.flatnonzero(~nvalid)
+    out["neighborhood_invalid"] = int(inv_rows.size)
+    if inv_rows.size:
+        from .history.columnar import PAD
+        lines = (ncols.type[inv_rows] != PAD).sum(axis=1)
+        wmin = int(inv_rows[int(lines.argmin())])
+        out["min_anomaly_lines"] = int(lines.min())
+        out["min_anomaly"] = {"neighbor": list(neighbors[wmin]),
+                              "bad": int(np.asarray(nbad)[wmin])}
+        by_mode: Dict[str, int] = {}
+        for r in inv_rows.tolist():
+            by_mode[neighbors[r][1]] = by_mode.get(neighbors[r][1], 0) + 1
+        out["invalid_by_mode"] = by_mode
+
+    if verify:
+        # Oracle-fuzz at scale: a deterministic stride of the
+        # neighborhood decodes back to Op lists and re-checks on the
+        # exact host engine; any verdict flip is a CHECKER bug. Keyed
+        # batches verify per key (linearizability is per register —
+        # Herlihy–Wing locality, the same strain the device path
+        # rides): the host verdict is the AND over the history's
+        # per-key sub-histories.
+        from .checkers.linearizable import wgl_check
+        from .history.columnar import columnar_to_ops
+        from .ops.partition import partition_columnar
+        cache: dict = {}
+        bad_rows = []
+        sample = list(range(0, len(neighbors), int(verify)))
+        pb = partition_columnar(ncols)
+        if pb is not None:
+            subs_of: Dict[int, List[int]] = {}
+            for s, h in enumerate(pb.sub_history.tolist()):
+                subs_of.setdefault(int(h), []).append(s)
+
+            def host_valid(r):
+                vs = [wgl_check(model, columnar_to_ops(pb.cols, s),
+                                space_cache=cache)["valid"]
+                      for s in subs_of.get(r, [])]
+                if any(v is False for v in vs):
+                    return False
+                return True if all(v is True for v in vs) else None
+        else:
+            def host_valid(r):
+                v = wgl_check(model, columnar_to_ops(ncols, r),
+                              space_cache=cache)["valid"]
+                return v if isinstance(v, bool) else None
+        for r in sample:
+            want = host_valid(r)
+            if want is None:
+                # The oracle punted ("unknown": config cap exhausted)
+                # — no verdict to disagree with, and counting it would
+                # raise a false checker-bug alarm.
+                continue
+            out["verified"] += 1
+            if want != bool(nvalid[r]):
+                bad_rows.append(
+                    {"neighbor": list(neighbors[r]),
+                     "host": want, "device": bool(nvalid[r])})
+        out["disagreements"] = len(bad_rows)
+        if bad_rows:
+            out["disagreement_sample"] = bad_rows[:5]
+            log.error("fuzz: %d device/host verdict disagreements "
+                      "(checker bug) — first: %r", len(bad_rows),
+                      bad_rows[0])
+    # Journals only outlive an interrupted round.
+    for j in (base_j, neigh_j):
+        if j is not None:
+            j.finish()
+    return out
+
+
+def fuzz_campaign(spec, *, rounds: int = 1, neighborhood: int = 4,
+                  max_witnesses: int = 8,
+                  modes: Optional[Sequence[str]] = None,
+                  synth: str = "device", model=None,
+                  store_root=None, name: str = "fuzz",
+                  resume: bool = False, verify: Optional[int] = None,
+                  check_kwargs: Optional[dict] = None) -> dict:
+    """Drive ``rounds`` fuzz rounds, durably. Campaign state lives
+    under ``store/<name>/`` — a CampaignCheckpoint over round ordinals
+    (finished rounds rehydrate their ``fuzz-round-N.json`` summary; a
+    killed campaign resumes the in-flight round from its chunk
+    journals with zero re-dispatched rows) plus one summary JSON at
+    the end. ``store_root=None`` with ``name=None`` runs ephemeral
+    (no durability). Exit surface: ``disagreements`` > 0 means the
+    checker itself is wrong somewhere — the one genuinely alarming
+    outcome."""
+    from .models.core import cas_register
+    from .ops.synth_device import NEIGHBOR_MODES
+    from .store import (CampaignCheckpoint, DEFAULT, atomic_write_json,
+                        spec_digest)
+
+    if modes:
+        modes = tuple(modes)
+    else:
+        # The nemesis mode re-draws the fault stream and shifts the
+        # crash window; a spec with NO fault surface (p_info == 0 and
+        # p_crash == 0) never reads either, so its "neighbors" would
+        # be bit-identical witness copies — drop the mode by default.
+        modes = tuple(m for m in NEIGHBOR_MODES
+                      if m != "nemesis"
+                      or spec.p_info > 0 or spec.p_crash > 0)
+    model = model if model is not None else cas_register()
+    cdir = ckpt = None
+    if name is not None:
+        root = store_root if store_root is not None else DEFAULT
+        cdir = Path(root.base) / name
+        cdir.mkdir(parents=True, exist_ok=True)
+        ckpt = CampaignCheckpoint(
+            cdir / "campaign.jsonl",
+            {"fuzz": name, "rounds": rounds,
+             "spec": spec_digest(spec, synth=synth, modes=list(modes),
+                                 neighborhood=neighborhood,
+                                 max_witnesses=max_witnesses)},
+            resume=resume)
+    round_outs: List[dict] = []
+    try:
+        for r in range(rounds):
+            state = ckpt.seed_state(r) if ckpt is not None else None
+            if state is not None and state["done"]:
+                try:
+                    round_outs.append(json.loads(
+                        (cdir / f"fuzz-round-{r}.json").read_text()))
+                    continue
+                except Exception:
+                    log.warning("fuzz resume: round %d marked done but "
+                                "its summary is unreadable; re-running",
+                                r)
+            if ckpt is not None:
+                ckpt.started(r, cdir)
+            out = fuzz_round(model, _round_spec(spec, r), synth=synth,
+                             neighborhood=neighborhood,
+                             max_witnesses=max_witnesses, modes=modes,
+                             journal_dir=cdir,
+                             resume=state is not None or resume,
+                             verify=verify, check_kwargs=check_kwargs)
+            out["round"] = r
+            if cdir is not None:
+                atomic_write_json(cdir / f"fuzz-round-{r}.json", out)
+            if ckpt is not None:
+                ckpt.done(r)
+            round_outs.append(out)
+        if ckpt is not None:
+            ckpt.finish()
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+
+    summary = {
+        "name": name, "rounds": rounds, "synth": synth,
+        "modes": list(modes),
+        "checked": sum(o["checked"] for o in round_outs),
+        "invalid": sum(o["invalid"] for o in round_outs),
+        "neighborhoods": sum(o["neighborhoods"] for o in round_outs),
+        "neighborhood_invalid": sum(o["neighborhood_invalid"]
+                                    for o in round_outs),
+        "verified": sum(o.get("verified", 0) for o in round_outs),
+        "disagreements": sum(o.get("disagreements", 0)
+                             for o in round_outs),
+        "min_anomaly_lines": min(
+            (o["min_anomaly_lines"] for o in round_outs
+             if o.get("min_anomaly_lines") is not None), default=None),
+        "round_results": round_outs,
+    }
+    if cdir is not None:
+        atomic_write_json(cdir / "fuzz-summary.json", summary)
+    return summary

@@ -63,6 +63,13 @@ class ColumnarOps:
               P-compositional pre-partition (ops.partition) strains a
               keyed batch into per-key sub-histories before encoding,
               and the sub-batches it produces carry no key column.
+    meta    — optional generator-side metadata
+              (ops.synth_device.SynthMeta): per-history (and per-key)
+              peak pending windows computed as part of generation, so
+              the partition stage's W histograms need no host re-scan
+              of the line grid (ops.partition.pending_w_hist consults
+              it). Purely advisory — every consumer must behave
+              identically with meta=None.
     """
 
     type: np.ndarray
@@ -71,6 +78,7 @@ class ColumnarOps:
     kinds: List[Tuple]
     index: Optional[np.ndarray] = None
     key: Optional[np.ndarray] = None
+    meta: Optional[object] = None
 
     @property
     def batch(self) -> int:

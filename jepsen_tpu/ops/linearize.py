@@ -1598,3 +1598,30 @@ def check_batch_columnar(model: Model, histories: Sequence[List[Op]], *,
                           min_device_batch=min_device_batch,
                           scheduler=scheduler, faults=faults,
                           journal=journal, scheduler_opts=scheduler_opts)
+
+
+def check_synth(model: Model, spec, *, synth: str = "device",
+                return_meta: bool = False, **kw):
+    """Generate-and-check a deterministic synthetic batch
+    (ops.synth_device.SynthSpec) — the campaign/fuzz workhorse: the
+    histories are born in the columnar layout on the chosen backend
+    (``synth="device"`` jitted JAX; ``"numpy"`` the bit-identical host
+    twin; ``"host"`` the legacy lockstep generators, byte-compatible
+    with earlier rounds), then ride the full check_columnar pipeline —
+    P-compositional partition via the batch's key column, streaming
+    scheduler, fault ladder, and ChunkJournal resume (key journals on
+    store.spec_digest(spec): the spec NAMES the batch, so a resumable
+    campaign never materializes histories just to fingerprint them).
+    Only the columnar families check here ("cas"/"wide"); "la" lowers
+    to dependency graphs (checkers.cycle) instead. Returns
+    check_columnar's shapes, plus the SynthMeta when
+    ``return_meta=True``."""
+    from .synth_device import synthesize
+    assert spec.family in ("cas", "wide"), spec.family
+    # The legacy host generators return Op lists for the wide family;
+    # only cas is columnar on every backend.
+    assert synth != "host" or spec.family == "cas", \
+        "host-mode check_synth supports the cas family"
+    cols, meta = synthesize(spec, synth, key_meta=False)
+    out = check_columnar(model, cols, **kw)
+    return (out, meta) if return_meta else out

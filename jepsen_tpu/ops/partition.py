@@ -75,8 +75,17 @@ def pending_w_hist(cols) -> Dict[int, int]:
     {peak window: rows}. The peak matches the encode walk's ``max_live``
     (invokes allocate, only ok-completions free — info ops stay pinned,
     exactly the 2^W axis the kernel pays). The bench's pre/post
-    partition comparison is two of these."""
+    partition comparison is two of these.
+
+    Device-synthesized batches (ops.synth_device) carry the answer as
+    generator metadata — the peaks were computed on device as part of
+    generation — so the full-batch cumsum re-scan is skipped (the
+    metadata-agreement tests pin the two paths field-for-field)."""
     from ..history.columnar import C_INVOKE, C_OK
+    meta = getattr(cols, "meta", None)
+    if meta is not None and getattr(meta, "peak_w", None) is not None \
+            and len(meta.peak_w) == cols.batch:
+        return meta.w_hist()
     delta = ((cols.type == C_INVOKE).astype(np.int32)
              - (cols.type == C_OK).astype(np.int32))
     peak = np.maximum(np.cumsum(delta, axis=1).max(axis=1), 1)

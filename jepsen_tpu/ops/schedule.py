@@ -735,7 +735,8 @@ class BucketScheduler:
             "input_buckets": 0, "classes": [], "chunks": 0,
             "dispatches": 0, "fused_groups": 0,
             "rows": 0, "pad_rows": 0, "compiled_shapes": 0,
-            "t_first_verdict_s": None, "wall_s": None,
+            "t_first_verdict_s": None, "t_first_dispatch_s": None,
+            "wall_s": None,
             "encode_busy_s": 0.0, "dispatch_busy_s": 0.0,
             "device_wait_s": 0.0, "overlap_ratio": None,
             "events": 0, "orig_events": 0, "fusion_ratio": None,
@@ -943,6 +944,12 @@ class BucketScheduler:
             outs, delay = e, 0.0
         if self._first_dispatch_t is None:
             self._first_dispatch_t = time.monotonic()
+            if self._t0 is not None:
+                # Time-to-first-dispatch: how long the device sat idle
+                # before the source (encode, or device synthesis)
+                # produced its first shippable chunk.
+                self.stats["t_first_dispatch_s"] = round(
+                    self._first_dispatch_t - self._t0, 4)
         self.stats["chunks"] += len(members)
         for _, lo, hi, Bp in members:
             self.stats["pad_rows"] += Bp - (hi - lo)
@@ -1902,4 +1909,61 @@ def iter_columnar_groups(space, cols, *, max_slots: int = 16,
             b.failures = []
         if failures is not None:
             failures.extend((i + lo, why) for i, why in fails)
+        yield buckets
+
+
+def iter_synth_groups(space, spec, *, synth: str = "device",
+                      max_slots: int = 16,
+                      rows_per_group: Optional[int] = None,
+                      partition: bool = True,
+                      failures: Optional[list] = None,
+                      fuse: bool = False, renumber: bool = False):
+    """Device synthesis as a first-class scheduler source: generate →
+    partition → encode in row groups, so group k+1 synthesizes while
+    the device still chews group k and no full batch (or host Op list)
+    ever materializes. ``spec`` is an ops.synth_device.SynthSpec of a
+    columnar family ("cas"/"wide"); ``synth`` picks the generator
+    backend ("device" | "numpy" twin | "host" legacy). The counter
+    PRNG keys by global row id, so grouped generation is bit-identical
+    to one-shot generation at any group size.
+
+    Keyed specs strain each group through the P-compositional
+    pre-partition; yielded bucket indices are then global SUB ordinals
+    (ascending (history, key) within a group, groups in row order) —
+    the deterministic namespace journals/resume would key on. Unkeyed
+    specs yield global history rows, like iter_columnar_groups.
+    ``space`` must be enumerated over the spec family's kind
+    vocabulary. Overflow failures append to ``failures`` in the same
+    index namespace as the yielded buckets."""
+    from .encode import encode_columnar
+    from .partition import partition_columnar
+    from .synth_device import synthesize
+    # Same input contract as check_synth, asserted up front — the la
+    # family (and host-mode wide) produce non-columnar batches that
+    # would otherwise fail deep inside partition/encode.
+    assert spec.family in ("cas", "wide"), spec.family
+    assert synth != "host" or spec.family == "cas", \
+        "host-mode synth groups support the cas family"
+    rows_per_group = rows_per_group or int(
+        os.environ.get("JT_SCHED_ENCODE_ROWS", "4096"))
+    fuse_registry = {} if fuse else None
+    base = 0
+    for lo in range(0, spec.n, rows_per_group):
+        hi = min(lo + rows_per_group, spec.n)
+        cols, _meta = synthesize(spec, synth, rows=(lo, hi),
+                                 key_meta=False)
+        if partition and getattr(cols, "key", None) is not None:
+            pb = partition_columnar(cols)
+            if pb is not None:
+                cols = pb.cols
+        buckets, fails = encode_columnar(space, cols,
+                                         max_slots=max_slots,
+                                         fuse=fuse, renumber=renumber,
+                                         fuse_registry=fuse_registry)
+        for b in buckets:
+            b.indices = [i + base for i in b.indices]
+            b.failures = []
+        if failures is not None:
+            failures.extend((i + base, why) for i, why in fails)
+        base += cols.batch
         yield buckets

@@ -1,0 +1,920 @@
+"""On-device history synthesis: generate where you check.
+
+The r05/r06 rounds left the checker far from hardware limits
+(``hbm_util`` 0.0018) while host-side numpy synthesis grew to ~38% of
+the e2e bench loop — campaign throughput is bounded by *generation*,
+not checking (ROADMAP open item 4). This module moves generation onto
+the device: seeded generators for the register/CAS
+(``synth_cas_columnar`` semantics), list-append (``synth_la_history``
+semantics) and wide-window workloads that emit histories **directly in
+the padded int32 columnar layout** the encode walk consumes
+(jepsen_tpu.history.columnar.ColumnarOps) — no per-op Python objects,
+no host round trip, and the existing ``columnar_to_ops`` /
+``decode_la`` walks recover the host ``Op``-list form on demand for
+witnesses and the web UI.
+
+Design (and why it beats the lockstep numpy generator — the measured
+ratio lands in the bench's ``synth_device`` section each round):
+
+  * **Counter-based PRNG.** Every random draw is a pure function of
+    ``(campaign seed, history, stream, counter)`` through a splitmix32
+    mixer (``fold_in``) — the JAX-PRNG key-splitting discipline (one
+    key per (seed, history), split per stream, a counter per op)
+    implemented in plain uint32 arithmetic so the SAME code runs under
+    ``jax.numpy`` (jitted, on device) and ``numpy`` (the host parity
+    twin). Device and twin are bit-identical by construction; the
+    parity gate (tests/test_synth_device.py) pins it with tensor
+    digests. Draw streams are split per CLASS — schedule, op values,
+    fault schedule, corruption — which is what makes fuzz
+    neighborhoods (below) semantic: perturbing the schedule stream
+    alone re-interleaves the SAME ops.
+
+  * **Parallel construction, not simulation.** The host generator
+    simulates a free-process scheduler line by line (a Python step
+    loop, ~40 numpy dispatches per step). Here the schedule is
+    *constructed* in closed form: op ``i`` runs on process ``i % P``,
+    completes in op order, and invokes a lag ``d_i`` completions
+    early, where ``d`` is a clipped ±1 random walk over
+    ``[0, min(i, P-1)]`` (bursty, temporally-correlated concurrency —
+    and, crucially, a NONDECREASING invoke-block sequence). With both
+    the invoke and completion orders monotone in the op index, every
+    line position is a two-term closed form (``inv = i + block_i``,
+    ``comp = 2i + 1 + jumped-ahead invokes``) and the line grid
+    assembles by pure gathers — no sort, no scatter, both of which
+    serialize on CPU XLA. The only sequential piece is one fused
+    ``lax.scan`` over the op axis carrying (lag walk, per-key
+    register); list-append needs only the lag half. Pending windows
+    are up to P live ops plus every pinned info/crashed op. The
+    op-order completion discipline is the one distributional
+    restriction vs the host generator — the blind oracle-fuzz corpus
+    (tests/test_oracle_fuzz.py) remains the adversarial net, and
+    ``JT_BENCH_SYNTH=host`` keeps the historical stream for
+    byte-compatible rounds.
+
+  * **Generator metadata instead of host re-scans.** The generated
+    batch carries a SynthMeta: per-history peak pending window and,
+    for keyed batches, per-(history, key) post-partition windows —
+    the pre/post W histograms the partition stage otherwise recomputes
+    with full-batch cumsums (``ops.partition.pending_w_hist`` consults
+    it), so W-class assignment needs no host re-scan of the line grid.
+
+  * **Fault schedules are part of the generator.** ``p_info`` times
+    out completions (the op possibly applied — pins the pending
+    window, the hard case), and a nemesis window
+    ``(crash_lo, crash_hi, p_crash)`` crashes ops outright (invoke
+    with no completion — pinned forever; crashed reads observed
+    nothing and drop under the shared identity rule). All seeded, all
+    deterministic, all replayable from the spec.
+
+Fuzz neighborhoods (``neighbor_keys``/``synth_cas_neighbors``) derive
+perturbed stream keys around one (seed, history): ``order`` re-draws
+only the schedule stream (same ops, new interleavings), ``values``
+re-draws only op values (value collisions against the same schedule),
+``nemesis`` shifts the crash window and re-draws the fault/timeout
+streams. The witness-guided fuzz driver (jepsen_tpu.fuzz) re-dispatches
+these around invalid histories.
+
+Host purity: importing this module and running ``backend="numpy"``
+never touches jax — the subprocess purity gate in
+tests/test_synth_device.py enforces it (the PR-2/PR-4 discipline).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..history.columnar import PAD, C_INVOKE, C_OK, C_INFO, ColumnarOps
+from ..history.ops import Op, invoke_op, ok_op
+from ..workloads.synth import cas_kind_vocabulary
+
+# splitmix32 finalizer constants (TheIronBorn's improved mix) + the
+# golden-ratio stream stride. All arithmetic is wrapping uint32 —
+# identical under numpy and jax.numpy, which is the whole parity story.
+_M1 = 0x21F0AAAD
+_M2 = 0x735A2D97
+_GOLD = 0x9E3779B9
+_ROOT = 0x6A09E667
+
+# Stream tags: one sub-key per draw class, split from the history key.
+# "fault" covers the whole fault schedule — timeout (:info) draws,
+# crash draws, and the applied? coin — so the nemesis fuzz mode
+# re-draws every fault decision by folding one key.
+_S_SCHED, _S_VALS, _S_FAULT, _S_CORR = 0x51, 0x52, 0x54, 0x55
+STREAMS = ("sched", "vals", "fault", "corr")
+
+
+def _mix(xp, x):
+    x = (x ^ (x >> 16)) * xp.uint32(_M1)
+    x = (x ^ (x >> 15)) * xp.uint32(_M2)
+    return x ^ (x >> 15)
+
+
+def fold_in(xp, key, data):
+    """Derive a child key/draw: ``mix(key + (data + 1) * GOLD)`` — the
+    splitmix discipline (jax.random.fold_in's role) in backend-neutral
+    uint32. ``key`` and ``data`` broadcast. Wrapping IS the algorithm:
+    numpy 2 warns on 0-d uint32 overflow, so the host twin computes
+    under an errstate that matches the device's silent modular
+    arithmetic."""
+    if xp is np:
+        with np.errstate(over="ignore"):
+            key = np.asarray(key).astype(np.uint32)
+            data = np.asarray(data).astype(np.uint32)
+            return _mix(np, key + (data + np.uint32(1)) * np.uint32(_GOLD))
+    key = xp.asarray(key).astype(xp.uint32)
+    data = xp.asarray(data).astype(xp.uint32)
+    return _mix(xp, key + (data + xp.uint32(1)) * xp.uint32(_GOLD))
+
+
+def history_keys_for(seed: int, rows, xp=np) -> Dict[str, object]:
+    """Per-history stream keys for global row ids ``rows`` under
+    campaign ``seed`` — the key-splitting root the generators and the
+    fuzz neighborhoods share. Chunked generation composes: rows
+    [lo, hi) of a batch are bit-identical to the same rows of the
+    full batch."""
+    root = fold_in(xp, xp.uint32(_ROOT), xp.uint32(seed & 0xFFFFFFFF))
+    hk = fold_in(xp, root, xp.asarray(rows))
+    return {name: fold_in(xp, hk, tag)
+            for name, tag in zip(STREAMS,
+                                 (_S_SCHED, _S_VALS, _S_FAULT,
+                                  _S_CORR))}
+
+
+def _thresh24(p: float) -> np.uint32:
+    """Probability -> 24-bit integer threshold: ``draw >> 8 < t`` is an
+    exact, float-free Bernoulli(p) identical on both backends."""
+    return np.uint32(int(min(max(float(p), 0.0), 1.0) * (1 << 24)))
+
+
+def _thresh14(p: float) -> np.uint32:
+    """14-bit Bernoulli threshold for the packed per-op draw fields."""
+    return np.uint32(int(min(max(float(p), 0.0), 1.0) * (1 << 14)))
+
+
+# ------------------------------------------------------------ the spec
+
+@dataclass(frozen=True)
+class SynthSpec:
+    """One deterministic synthetic batch: (spec, synth backend) ↦ the
+    histories, with no materialization needed to name them — journals
+    key on ``store.spec_digest(spec)`` instead of a content digest.
+    ``crash_lo/crash_hi/p_crash`` is the nemesis window (op-index
+    space): ops invoked inside it crash (no completion) with
+    probability ``p_crash``. ``width``/``invalid`` only apply to the
+    ``wide`` family."""
+
+    family: str = "cas"          # "cas" | "la" | "wide"
+    n: int = 1024
+    seed: int = 0
+    n_procs: int = 5
+    n_ops: int = 40
+    n_values: int = 5
+    n_keys: int = 1
+    corrupt: float = 0.0
+    p_info: float = 0.0
+    crash_lo: int = 0
+    crash_hi: int = 0
+    p_crash: float = 0.0
+    width: int = 17
+    invalid: bool = False
+
+
+@dataclass
+class SynthMeta:
+    """Generator-side partition metadata: what the partition stage
+    would otherwise re-derive by scanning the [B, N] line grid.
+    ``peak_w`` is each history's peak pending window (the encode
+    walk's ``max_live``: invokes allocate, only ok-completions free);
+    ``key_peak_w``/``key_present`` are the per-(history, key)
+    post-partition windows for keyed batches (None when unkeyed).
+    ``ops.partition.pending_w_hist`` consults a batch's meta before
+    scanning."""
+
+    peak_w: np.ndarray                       # [B] int32
+    key_peak_w: Optional[np.ndarray] = None  # [B, K] int32
+    key_present: Optional[np.ndarray] = None  # [B, K] bool
+    spec: Optional[SynthSpec] = None
+
+    def w_hist(self) -> Dict[int, int]:
+        """Pre-partition {peak window: rows} — pending_w_hist's shape."""
+        ws, counts = np.unique(self.peak_w, return_counts=True)
+        return {int(w): int(c) for w, c in zip(ws, counts)}
+
+    def sub_w_hist(self) -> Optional[Dict[int, int]]:
+        """Post-partition {peak window: sub rows} over present
+        (history, key) subs; None for unkeyed batches."""
+        if self.key_peak_w is None:
+            return None
+        peaks = self.key_peak_w[self.key_present]
+        ws, counts = np.unique(peaks, return_counts=True)
+        return {int(w): int(c) for w, c in zip(ws, counts)}
+
+
+# --------------------------------------------------- shared construction
+
+def _take_row(xp, arr, idx):
+    """Per-row gather: ``arr[b, idx[b, j]]`` for [B, n] index arrays."""
+    return xp.take_along_axis(arr, idx, axis=1)
+
+
+def _op_positions(xp, d, n: int, P: int):
+    """Closed-form line positions for the monotone-block schedule.
+
+    ``d`` [B, n] is the lag walk (``d_i <= min(i, P-1)``, and
+    ``d_{i+1} <= d_i + 1`` so invoke blocks ``j_i = i - d_i`` are
+    nondecreasing — invoke order IS op order). Lines run: block-0
+    invokes, completion 0, block-1 invokes, completion 1, ... so
+
+      inv_line(i)  = i + j_i                      (i earlier invokes
+                                                   + j_i earlier comps)
+      comp_line(i) = 2i + 1 + #{l in 1..P-1 : j_{i+l} <= i}
+                                                  (future invokes that
+                                                   jumped ahead)
+
+    Validity: op i's invoke sits after completion ``j_i - 1 >= i - P``
+    — the previous op on its process (``i % P``) completes at slot
+    ``i - P``. Both maps are strictly increasing; their merge is the
+    whole [0, 2n) grid, which is what lets `_line_decode` invert them
+    with a P/2-wide gather stencil instead of a scatter or sort."""
+    i32 = xp.arange(n, dtype=xp.int32)[None, :]
+    j = i32 - d
+    inv_line = i32 + j
+    ahead = xp.zeros(d.shape, xp.int32)
+    for off in range(1, P):
+        if off >= n:
+            break
+        # j_{i+off} <= i  <=>  d_{i+off} >= off
+        hop = (d[:, off:] >= off).astype(xp.int32)
+        pad = xp.zeros((d.shape[0], off), xp.int32)
+        ahead = ahead + xp.concatenate([hop, pad], axis=1)
+    comp_line = 2 * i32 + 1 + ahead
+    return inv_line, comp_line, j
+
+
+def _line_decode(xp, comp_line, n: int, P: int):
+    """Invert the monotone merge: for every line ``t`` of the [0, 2n)
+    grid, which op does it belong to and is it the completion line?
+    ``comp_line(i)`` is strictly increasing with ``2i + 1 <=
+    comp_line(i) <= 2i + P``, so the count of completions before line
+    t is ``i0 + (a few comparisons)`` over a window of ~P/2 candidate
+    ops — gathers, not a search. Invoke order is op order, so the
+    r-th invoke line simply belongs to op r: ``op = t - n_comp``."""
+    B = comp_line.shape[0]
+    N = 2 * n
+    t = xp.arange(N, dtype=xp.int32)[None, :]
+    # Every op below `base = ceil((t-P)/2)` surely completed before
+    # line t (comp_line <= 2i + P); ops at or past base + P//2 surely
+    # have not (comp_line >= 2i + 1). Count the exact P//2-wide
+    # uncertainty window by gathers.
+    base = xp.clip((t - P + 1) // 2, 0, n)
+    n_comp = xp.broadcast_to(base, (B, N)).astype(xp.int32)
+    for off in range(P // 2):
+        cand = base + off
+        hit = (cand < n) & (_take_row(
+            xp, comp_line,
+            xp.broadcast_to(xp.clip(cand, 0, n - 1), (B, N))) < t)
+        n_comp = n_comp + hit.astype(xp.int32)
+    is_comp = (n_comp < n) & (_take_row(
+        xp, comp_line, xp.clip(n_comp, 0, n - 1)) == t)
+    op = xp.where(is_comp, n_comp, t - n_comp)
+    return op.astype(xp.int32), is_comp
+
+
+# ------------------------------------------------------------ CAS family
+
+def _cas_scan(xp, step, k, a, b2, eff_w, eff_c, P: int, K: int):
+    """The one sequential piece, fused: the lag walk (clipped ±1 over
+    [0, min(i, P-1)]) and the per-key register evolution in completion
+    (= op) order. reg starts -1 (None); writes set, cas sets iff it
+    matches, reads observe. K is small, so the register update is a
+    one-hot select — XLA CPU scatter would serialize."""
+    B, n = k.shape
+    lim = np.minimum(np.arange(n, dtype=np.int32), P - 1)
+    if xp is np:
+        d_out = np.empty((B, n), np.int32)
+        obs = np.empty((B, n), np.int32)
+        match = np.empty((B, n), bool)
+        rowsB = np.arange(B)
+        d = np.zeros(B, np.int32)
+        reg = np.full((B, K), -1, np.int32)
+        for t in range(n):
+            d = np.clip(d + step[:, t], 0, lim[t])
+            d_out[:, t] = d
+            kt = k[:, t]
+            cur = reg[rowsB, kt]
+            mt = cur == a[:, t]
+            obs[:, t] = cur
+            match[:, t] = mt
+            reg[rowsB, kt] = np.where(
+                eff_w[:, t], a[:, t],
+                np.where(eff_c[:, t] & mt, b2[:, t], cur))
+        return d_out, obs, match
+    import jax
+    ar = xp.arange(K, dtype=xp.int32)[None, :]
+
+    def body(carry, x):
+        d, reg = carry
+        st, lm, kt, at, bt, ewt, ect = x
+        d = xp.clip(d + st, 0, lm)
+        cur = xp.take_along_axis(reg, kt[:, None], axis=1)[:, 0]
+        mt = cur == at
+        new = xp.where(ewt, at, xp.where(ect & mt, bt, cur))
+        reg = xp.where(ar == kt[:, None], new[:, None], reg)
+        return (d, reg), (d, cur, mt)
+
+    carry0 = (xp.zeros(k.shape[0], xp.int32),
+              xp.full((k.shape[0], K), -1, xp.int32))
+    xs = (step.T, xp.asarray(lim), k.T, a.T, b2.T, eff_w.T, eff_c.T)
+    # Unrolling pays at production op counts (amortizes loop overhead)
+    # but only bloats compile time for short histories.
+    _, (d, obs, match) = jax.lax.scan(body, carry0, xs,
+                                      unroll=8 if n >= 256 else 1)
+    return d.T, obs.T, match.T
+
+
+def _walk_scan(xp, step, P: int):
+    """Lag walk alone (the list-append family has no register)."""
+    B, n = step.shape
+    lim = np.minimum(np.arange(n, dtype=np.int32), P - 1)
+    if xp is np:
+        d_out = np.empty((B, n), np.int32)
+        d = np.zeros(B, np.int32)
+        for t in range(n):
+            d = np.clip(d + step[:, t], 0, lim[t])
+            d_out[:, t] = d
+        return d_out
+    import jax
+
+    def body(d, x):
+        st, lm = x
+        d = xp.clip(d + st, 0, lm)
+        return d, d
+
+    _, d = jax.lax.scan(body, xp.zeros(B, xp.int32),
+                        (step.T, xp.asarray(lim)),
+                        unroll=8 if n >= 256 else 1)
+    return d.T
+
+
+def _cas_core(xp, keys, crash_lo, crash_hi, p_info_t, corrupt_t,
+              p_crash_t, *, n_procs: int, n_ops: int, n_values: int,
+              n_keys: int, with_info: bool, with_crash: bool,
+              with_corrupt: bool, key_meta: bool):
+    """Backend-neutral CAS/register generator body. ``keys`` is the
+    stream-key dict ([B] uint32 each); crash windows are per-row int32
+    arrays; thresholds are integer scalars (dynamic — no recompile
+    across corruption/fault rates; the ``with_*`` statics only gate
+    whole streams on/off). Scatter/sort-free: op-level draws + one
+    fused scan, then the line grid assembles by gathers through the
+    closed-form schedule (_op_positions/_line_decode); per-op payload
+    and the per-key pending counters are bit-packed so each costs one
+    gather/cumsum, not four."""
+    P, n, V, K = n_procs, n_ops, n_values, n_keys
+    assert K <= 16 and 1 + 2 * V + V * V < (1 << 24), (K, V)
+    # The pend_peak metadata packs two counters into one int32 cumsum
+    # (ok completions in the high 16 bits): op counts must fit 15 bits.
+    assert n < (1 << 15), n
+    B = keys["sched"].shape[0]
+    iu = xp.arange(n, dtype=xp.uint32)[None, :]
+    i32 = xp.arange(n, dtype=xp.int32)[None, :]
+
+    bits_s = fold_in(xp, keys["sched"][:, None], iu)
+    bits_v = fold_in(xp, keys["vals"][:, None], iu)
+
+    step = (bits_s % xp.uint32(3)).astype(xp.int32) - 1
+    f = ((bits_v >> 2) % xp.uint32(3)).astype(xp.int32)
+    a = ((bits_v >> 4) % xp.uint32(V)).astype(xp.int32)
+    b2 = ((bits_v >> 12) % xp.uint32(V)).astype(xp.int32)
+    k = (((bits_v >> 20) % xp.uint32(K)).astype(xp.int32)
+         if K > 1 else xp.zeros((B, n), xp.int32))
+
+    if with_info or with_crash:
+        bits_f = fold_in(xp, keys["fault"][:, None], iu)
+        applies = (bits_f & xp.uint32(1)) == 1
+        info = ((((bits_f >> 2) & xp.uint32(0x3FFF)) < p_info_t)
+                if with_info else xp.zeros((B, n), bool))
+        if with_crash:
+            crash = ((i32 >= crash_lo[:, None])
+                     & (i32 < crash_hi[:, None])
+                     & (((bits_f >> 16) & xp.uint32(0x3FFF))
+                        < p_crash_t))
+            info = info & ~crash
+        else:
+            crash = xp.zeros((B, n), bool)
+    else:
+        info = crash = xp.zeros((B, n), bool)
+        applies = xp.zeros((B, n), bool)
+    ok_ = ~info & ~crash
+
+    is_r, is_w, is_c = f == 0, f == 1, f == 2
+    eff_w = is_w & (ok_ | applies)
+    eff_c = is_c & (ok_ | applies)       # applies iff it also matches
+
+    d, obs, match = _cas_scan(xp, step, k, a, b2, eff_w, eff_c, P, K)
+
+    READ0, WRITE0, CAS0 = 0, 1 + V, 1 + 2 * V
+    kind_read = xp.where(obs < 0, xp.int32(READ0),
+                         xp.int32(READ0 + 1) + obs)
+    kind_inv = xp.where(is_r, kind_read,
+                        xp.where(is_w, xp.int32(WRITE0) + a,
+                                 xp.int32(CAS0) + a * xp.int32(V) + b2))
+
+    # Retractions: failed cas never happened; never-ok reads (info or
+    # crashed — they observed nothing) are total identities and drop,
+    # keeping W proportional to real concurrency (the shared rule).
+    drop = (is_r & ~ok_) | (is_c & ok_ & ~match)
+    has_comp = ~crash & ~drop
+
+    if with_corrupt and V > 1:
+        # Corruption: perturb one observed read per hit row (the
+        # legacy formula: old -1 for read(None),
+        # new = 1 + (old + delta) % V). Masked-argmax pick in pure
+        # uint32 (int64 is unavailable under default jax; a silent
+        # downcast would diverge from the numpy twin).
+        hb = fold_in(xp, keys["corr"], xp.uint32(0))
+        sc = fold_in(xp, keys["corr"][:, None], iu + xp.uint32(1))
+        eligible = is_r & ~drop
+        m = xp.where(eligible, (sc >> 1) + xp.uint32(1), xp.uint32(0))
+        pick = xp.argmax(m, axis=1).astype(xp.int32)
+        do = ((hb >> 8) < corrupt_t) & eligible.any(axis=1)
+        delta = (xp.int32(1)
+                 + ((hb & xp.uint32(0xFF)) % xp.uint32(V - 1))
+                 .astype(xp.int32))
+        old = kind_inv - xp.int32(READ0 + 1)
+        newk = xp.int32(READ0 + 1) + (old + delta[:, None]) % xp.int32(V)
+        at_pick = (i32 == pick[:, None]) & do[:, None]
+        kind_inv = xp.where(at_pick, newk, kind_inv)
+
+    # Line assembly by gathers through the closed-form schedule; the
+    # per-op payload packs into one uint32 so the line grid costs one
+    # gather: kind+1 (24 bits) | drop | crash | info | key (4 bits).
+    _inv_line, comp_line, j = _op_positions(xp, d, n, P)
+    op_t, is_comp = _line_decode(xp, comp_line, n, P)
+    pay = ((kind_inv + 1).astype(xp.uint32)
+           | (drop.astype(xp.uint32) << 24)
+           | (crash.astype(xp.uint32) << 25)
+           | (info.astype(xp.uint32) << 26)
+           | (k.astype(xp.uint32) << 27))
+    pay_t = _take_row(xp, pay, op_t)
+    drop_t = (pay_t >> 24) & xp.uint32(1)
+    crash_t = (pay_t >> 25) & xp.uint32(1)
+    info_t = (pay_t >> 26) & xp.uint32(1)
+    dead = (drop_t | (is_comp & (crash_t == 1))) == 1
+    typ = xp.where(
+        dead, xp.int8(PAD),
+        xp.where(~is_comp, xp.int8(C_INVOKE),
+                 xp.where(info_t == 1, xp.int8(C_INFO),
+                          xp.int8(C_OK)))).astype(xp.int8)
+    real = typ != PAD
+    proc = xp.where(real, (op_t % xp.int32(P)).astype(xp.int16),
+                    xp.int16(0)).astype(xp.int16)
+    kind = xp.where(real & ~is_comp,
+                    (pay_t & xp.uint32(0xFFFFFF)).astype(xp.int32) - 1,
+                    xp.int32(-1))
+
+    # Metadata on the op axis: pending right after the invoke of op i
+    # is (real invokes <= i) - (ok completions among ops < j_i). The
+    # two counters pack into one int32 cumsum (invokes low 16 bits, ok
+    # completions high 16) — per key that is ONE cumsum + one gather.
+    okflag = has_comp & ~info
+    jm1 = xp.clip(j - 1, 0, n - 1)
+    j_pos = j > 0
+
+    def pend_peak(mine):
+        packed = xp.cumsum((mine & ~drop).astype(xp.int32)
+                           + ((mine & okflag).astype(xp.int32) << 16),
+                           axis=1)
+        okb = xp.where(j_pos, _take_row(xp, packed, jm1) >> 16, 0)
+        pend = xp.where(mine & ~drop,
+                        (packed & xp.int32(0xFFFF)) - okb, 0)
+        return xp.maximum(pend.max(axis=1), 1).astype(xp.int32)
+
+    every = xp.ones((B, n), bool)
+    out = {"type": typ, "process": proc, "kind": kind,
+           "peak_w": pend_peak(every)}
+    if K > 1:
+        out["key"] = xp.where(real,
+                              ((pay_t >> 27) & xp.uint32(0xF))
+                              .astype(xp.int32), xp.int32(-1))
+        if key_meta:
+            # Per-(history, key) post-partition windows: one packed
+            # cumsum per key. Opt-in — it costs K extra passes, which
+            # only pays when the caller would otherwise re-scan the
+            # strained sub-batch (the bench's pre/post histograms).
+            kp = [pend_peak(k == kk) for kk in range(K)]
+            pres = [((k == kk) & ~drop).any(axis=1) for kk in range(K)]
+            out["key_peak_w"] = xp.stack(kp, axis=1)
+            out["key_present"] = xp.stack(pres, axis=1)
+    return out
+
+
+_JIT_CACHE: Dict[Tuple, object] = {}
+
+
+def _jitted(family: str, core, static: Dict):
+    key = (family, tuple(sorted(static.items())))
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+        kw = dict(static)
+
+        def run(keys, *dyn):
+            return core(jnp, keys, *dyn, **kw)
+
+        fn = jax.jit(run)
+        _JIT_CACHE[key] = fn
+    return fn
+
+
+def _resolve_keys(spec: SynthSpec, rows, keys):
+    """Per-row stream keys (always derived host-side in numpy — a few
+    mixes over [B], trivially cheap, and the derivation must also run
+    jax-free for the numpy twin)."""
+    if keys is not None:
+        return {s: np.asarray(keys[s]).astype(np.uint32)
+                for s in STREAMS}
+    lo, hi = rows if rows is not None else (0, spec.n)
+    return history_keys_for(spec.seed, np.arange(lo, hi, dtype=np.uint32),
+                            xp=np)
+
+
+def _crash_arrays(spec: SynthSpec, B, crash_lo=None, crash_hi=None):
+    lo = (np.full(B, spec.crash_lo, np.int32) if crash_lo is None
+          else np.asarray(crash_lo, np.int32))
+    hi = (np.full(B, spec.crash_hi, np.int32) if crash_hi is None
+          else np.asarray(crash_hi, np.int32))
+    return lo, hi
+
+
+def synth_cas_device(spec: SynthSpec, *, rows=None, keys=None,
+                     crash_lo=None, crash_hi=None, key_meta: bool = True,
+                     backend: str = "device"
+                     ) -> Tuple[ColumnarOps, SynthMeta]:
+    """Generate ``spec`` (or its ``rows`` slice, or an explicit
+    ``keys`` neighborhood) in the prepared columnar layout.
+    ``backend="device"`` runs the jitted JAX program; ``"numpy"`` runs
+    the same code under numpy — the bit-identical host twin the parity
+    gate compares against (and the CPU fallback when jax is absent).
+    ``key_meta=False`` skips the per-key window metadata for callers
+    that never read the post-partition histograms."""
+    assert spec.family == "cas", spec.family
+    assert spec.n_keys <= 16, "packed key field is 4 bits"
+    kd = _resolve_keys(spec, rows, keys)
+    B = int(np.asarray(kd["sched"]).shape[0])
+    lo, hi = _crash_arrays(spec, B, crash_lo, crash_hi)
+    dyn = (lo, hi, _thresh14(spec.p_info), _thresh24(spec.corrupt),
+           _thresh14(spec.p_crash))
+    static = dict(n_procs=spec.n_procs, n_ops=spec.n_ops,
+                  n_values=spec.n_values, n_keys=spec.n_keys,
+                  with_info=spec.p_info > 0,
+                  with_crash=spec.p_crash > 0,
+                  with_corrupt=spec.corrupt > 0,
+                  key_meta=key_meta)
+    if backend == "device":
+        out = _jitted("cas", _cas_core, static)(kd, *dyn)
+        out = {kk: np.asarray(v) for kk, v in out.items()}
+    else:
+        out = _cas_core(np, kd, *dyn, **static)
+    meta = SynthMeta(peak_w=out["peak_w"],
+                     key_peak_w=out.get("key_peak_w"),
+                     key_present=out.get("key_present"), spec=spec)
+    cols = ColumnarOps(type=out["type"], process=out["process"],
+                       kind=out["kind"],
+                       kinds=cas_kind_vocabulary(spec.n_values),
+                       key=out.get("key"), meta=meta)
+    return cols, meta
+
+
+# ----------------------------------------------------- list-append family
+
+@dataclass
+class LaBatch:
+    """A batch of list-append histories in a compact int32 layout:
+    ``fn`` 0 = append / 1 = read; ``val`` carries the globally-unique
+    element on append lines, the observed PREFIX LENGTH on ok-read
+    lines (lists are append-only, so every observation — including the
+    corrupted stale read, a strict prefix truncation — is a prefix of
+    the key's final list), and -1 on read invokes. ``decode_la``
+    recovers the ``synth_la_history``-shaped Op lists."""
+
+    type: np.ndarray      # [B, N] int8
+    process: np.ndarray   # [B, N] int16
+    fn: np.ndarray        # [B, N] int8
+    key: np.ndarray       # [B, N] int32
+    val: np.ndarray       # [B, N] int32
+    n_keys: int
+    corrupted: np.ndarray = None   # [B] bool
+
+    @property
+    def batch(self) -> int:
+        return int(self.type.shape[0])
+
+    @property
+    def n_lines(self) -> int:
+        return int(self.type.shape[1])
+
+
+def _la_core(xp, keys, corrupt_t, *, n_procs: int, n_ops: int,
+             n_keys: int):
+    P, n, K = n_procs, n_ops, n_keys
+    B = keys["sched"].shape[0]
+    iu = xp.arange(n, dtype=xp.uint32)[None, :]
+    i32 = xp.arange(n, dtype=xp.int32)[None, :]
+
+    bits_s = fold_in(xp, keys["sched"][:, None], iu)
+    bits_v = fold_in(xp, keys["vals"][:, None], iu)
+    step = (bits_s % xp.uint32(3)).astype(xp.int32) - 1
+    d = _walk_scan(xp, step, P)
+    _inv_line, comp_line, j = _op_positions(xp, d, n, P)
+
+    is_app = (bits_v >> 8) < xp.uint32(int(0.55 * (1 << 24)))
+    key = (((bits_v >> 4) % xp.uint32(K)).astype(xp.int32)
+           if K > 1 else xp.zeros((B, n), xp.int32))
+    elem = xp.cumsum(is_app.astype(xp.int32), axis=1)   # 1-based ids
+
+    # Per-key append cumsums: observed length at a read's completion
+    # (appends with smaller op index) and at its invoke block (the
+    # droppable prefix for the stale-read corruption) — pure gathers.
+    obs_len = xp.zeros((B, n), xp.int32)
+    len_inv = xp.zeros((B, n), xp.int32)
+    jm1 = xp.clip(j - 1, 0, n - 1)
+    for kk in range(K):
+        ac = xp.cumsum((is_app & (key == kk)).astype(xp.int32), axis=1)
+        mine = (key == kk)
+        obs_len = obs_len + xp.where(mine, ac, 0)
+        at_inv = _take_row(xp, ac, jm1) * (j > 0).astype(xp.int32)
+        len_inv = len_inv + xp.where(mine, at_inv, 0)
+    # A read op is not an append, so the inclusive cumsum at the read
+    # already counts only earlier appends.
+
+    hb = fold_in(xp, keys["corr"], xp.uint32(0))
+    db = fold_in(xp, keys["corr"], xp.uint32(0xD00D))
+    sc = fold_in(xp, keys["corr"][:, None], iu + xp.uint32(1))
+    eligible = ~is_app & (len_inv >= 1)
+    m = xp.where(eligible, (sc >> 1) + xp.uint32(1), xp.uint32(0))
+    pick = xp.argmax(m, axis=1).astype(xp.int32)
+    do = ((hb >> 8) < corrupt_t) & eligible.any(axis=1)
+    rowsB = xp.arange(B, dtype=xp.int32)
+    lai = xp.maximum(len_inv[rowsB, pick], 1).astype(xp.uint32)
+    j_drop = (db % lai).astype(xp.int32)
+    at_pick = (i32 == pick[:, None]) & do[:, None]
+    obs_len = xp.where(at_pick, j_drop[:, None], obs_len)
+
+    # Line assembly — every op invokes and completes ok in la.
+    op_t, is_comp = _line_decode(xp, comp_line, n, P)
+
+    def g(arr):
+        return _take_row(xp, arr, op_t)
+
+    typ = xp.where(is_comp, xp.int8(C_OK),
+                   xp.int8(C_INVOKE)).astype(xp.int8)
+    proc = (op_t % xp.int32(P)).astype(xp.int16)
+    fn_l = xp.where(g(is_app), xp.int8(0), xp.int8(1)).astype(xp.int8)
+    keyc = g(key)
+    val = xp.where(g(is_app), g(elem),
+                   xp.where(is_comp, g(obs_len), xp.int32(-1)))
+    return {"type": typ, "process": proc, "fn": fn_l, "key": keyc,
+            "val": val, "corrupted": do}
+
+
+def synth_la_device(spec: SynthSpec, *, rows=None, keys=None,
+                    backend: str = "device") -> LaBatch:
+    """Seeded list-append batch (``synth_la_history`` semantics: unique
+    elements, reads observe the key's full list at completion, and the
+    corruption is a stale read — a truncation dropping an element whose
+    append completed before the read invoked, i.e. a guaranteed G2
+    anti-dependency cycle)."""
+    assert spec.family == "la", spec.family
+    kd = _resolve_keys(spec, rows, keys)
+    dyn = (_thresh24(spec.corrupt),)
+    static = dict(n_procs=spec.n_procs, n_ops=spec.n_ops,
+                  n_keys=spec.n_keys)
+    if backend == "device":
+        out = _jitted("la", _la_core, static)(kd, *dyn)
+        out = {kk: np.asarray(v) for kk, v in out.items()}
+    else:
+        out = _la_core(np, kd, *dyn, **static)
+    return LaBatch(type=out["type"], process=out["process"],
+                   fn=out["fn"], key=out["key"], val=out["val"],
+                   n_keys=spec.n_keys, corrupted=out["corrupted"])
+
+
+def decode_la(batch: LaBatch, row: int) -> List[Op]:
+    """One row back to the host Op-list form (the decode-back path the
+    graph checker and the web UI consume) — ``synth_la_history`` value
+    shapes: append [k, elem]; ok read [k, [elements...]]."""
+    from ..history.core import index as index_history
+    lists: Dict[int, list] = {k: [] for k in range(batch.n_keys)}
+    out: List[Op] = []
+    for jl in range(batch.n_lines):
+        t = int(batch.type[row, jl])
+        if t == PAD:
+            continue
+        p = int(batch.process[row, jl])
+        k = int(batch.key[row, jl])
+        v = int(batch.val[row, jl])
+        if t == C_INVOKE:
+            if batch.fn[row, jl] == 0:
+                out.append(invoke_op(p, "append", [k, v]))
+            else:
+                out.append(invoke_op(p, "read", [k, None]))
+        else:
+            if batch.fn[row, jl] == 0:
+                lists[k].append(v)
+                out.append(ok_op(p, "append", [k, v]))
+            else:
+                out.append(ok_op(p, "read", [k, list(lists[k][:v])]))
+    return index_history(out)
+
+
+# ----------------------------------------------------- wide-window family
+
+def _wide_core(xp, vals_key, *, width: int, n_values: int,
+               invalid: bool):
+    B = vals_key.shape[0]
+    w1 = width - 1
+    N = width + 1
+    vbits = fold_in(xp, vals_key[:, None],
+                    xp.arange(w1, dtype=xp.uint32)[None, :])
+    v = (vbits % xp.uint32(n_values)).astype(xp.int32)
+    WRITE0 = 1 + n_values
+    typ = xp.full((B, N), xp.int8(C_INVOKE), xp.int8)
+    typ = typ.at[:, N - 1].set(xp.int8(C_OK)) if xp is not np \
+        else _np_setcol(typ, N - 1, C_OK)
+    proc = xp.broadcast_to(
+        xp.minimum(xp.arange(N, dtype=xp.int16),
+                   xp.int16(w1))[None, :], (B, N))
+    # The impossible observation rides as an EXTRA kind appended after
+    # the full cas vocabulary: read(None)=0, reads, writes, V^2 cas
+    # pairs, then ("read", n_values + 5) at 1 + 2V + V^2.
+    read_kind = 1 + 2 * n_values + n_values * n_values if invalid else 0
+    kind = xp.concatenate(
+        [xp.int32(WRITE0) + v,
+         xp.full((B, 1), xp.int32(read_kind), xp.int32),
+         xp.full((B, 1), xp.int32(-1), xp.int32)], axis=1)
+    return {"type": typ, "process": proc.astype(xp.int16), "kind": kind,
+            "peak_w": xp.full(B, xp.int32(width), xp.int32)}
+
+
+def _np_setcol(arr, col, val):
+    arr[:, col] = val
+    return arr
+
+
+def synth_wide_device(spec: SynthSpec, *, rows=None,
+                      backend: str = "device"
+                      ) -> Tuple[ColumnarOps, SynthMeta]:
+    """Seeded wide-window batch: per history, width-1 crashed writes
+    (seeded values) pin slots forever, then one read completes ok
+    while all are pending — the frontier-sharded shape
+    (``synth_wide_window_history`` semantics; ``invalid=True`` makes
+    the read observe a value no write could produce)."""
+    assert spec.family == "wide", spec.family
+    kd = _resolve_keys(spec, rows, None)
+    static = ("wide", spec.width, spec.n_values, spec.invalid)
+    if backend == "device":
+        fn = _JIT_CACHE.get(static)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+            fn = jax.jit(lambda kk: _wide_core(
+                jnp, kk, width=spec.width, n_values=spec.n_values,
+                invalid=spec.invalid))
+            _JIT_CACHE[static] = fn
+        out = {kk: np.asarray(v) for kk, v in fn(kd["vals"]).items()}
+    else:
+        out = _wide_core(np, kd["vals"], width=spec.width,
+                         n_values=spec.n_values, invalid=spec.invalid)
+    kinds = cas_kind_vocabulary(spec.n_values)
+    if spec.invalid:
+        kinds = kinds + [("read", spec.n_values + 5)]
+    meta = SynthMeta(peak_w=out["peak_w"], spec=spec)
+    cols = ColumnarOps(type=out["type"], process=out["process"],
+                       kind=out["kind"], kinds=kinds, meta=meta)
+    return cols, meta
+
+
+# ------------------------------------------------------------- synthesize
+
+def synthesize(spec: SynthSpec, synth: str = "device", *, rows=None,
+               key_meta: bool = True):
+    """The one batch-source entry the check/campaign/fuzz paths share.
+
+    ``synth="device"`` / ``"numpy"``: the generator family above (the
+    two are bit-identical; "numpy" is the host twin). ``synth="host"``:
+    the LEGACY lockstep generators (workloads.synth) — the historical
+    stream, byte-compatible with every earlier bench round. Returns
+    ``(ColumnarOps, SynthMeta-or-None)`` for cas/wide, ``(LaBatch,
+    None)`` for la under the device family (host la returns Op
+    lists)."""
+    assert synth in ("device", "numpy", "host"), synth
+    if synth in ("device", "numpy"):
+        if spec.family == "cas":
+            return synth_cas_device(spec, rows=rows, backend=synth,
+                                    key_meta=key_meta)
+        if spec.family == "la":
+            return synth_la_device(spec, rows=rows, backend=synth), None
+        return synth_wide_device(spec, rows=rows, backend=synth)
+    from ..workloads import synth as hsynth
+    lo, hi = rows if rows is not None else (0, spec.n)
+    if spec.family == "cas":
+        # The legacy batch generator's stream depends only on (seed,
+        # n): a rows-slice re-generates the prefix and slices — host
+        # mode is the compatibility path, not the fast one.
+        cols = hsynth.synth_cas_columnar(
+            hi, seed=spec.seed, n_procs=spec.n_procs, n_ops=spec.n_ops,
+            n_values=spec.n_values, corrupt=spec.corrupt,
+            p_info=spec.p_info, n_keys=spec.n_keys)
+        if lo:
+            cols = ColumnarOps(
+                type=cols.type[lo:], process=cols.process[lo:],
+                kind=cols.kind[lo:], kinds=cols.kinds,
+                key=cols.key[lo:] if cols.key is not None else None)
+        return cols, None
+    if spec.family == "la":
+        return [hsynth.synth_la_history(
+            s, n_procs=spec.n_procs, n_ops=spec.n_ops,
+            n_keys=spec.n_keys, corrupt=spec.corrupt)
+            for s in hsynth.seed_stream(spec.seed, hi)[lo:]], None
+    return [hsynth.synth_wide_window_history(
+        width=spec.width, n_values=spec.n_values,
+        invalid=spec.invalid, seed=s)
+        for s in hsynth.seed_stream(spec.seed, hi)[lo:]], None
+
+
+# --------------------------------------------------- fuzz neighborhoods
+
+NEIGHBOR_MODES = ("order", "values", "nemesis")
+
+
+def neighbor_keys(spec: SynthSpec, neighbors: Sequence[Tuple[int, str,
+                                                             int]]):
+    """Stream keys + crash windows for a neighborhood batch: each
+    entry is ``(history_row, mode, variant)`` around ``spec``'s batch.
+    ``order`` perturbs only the schedule stream (same ops, new
+    interleavings), ``values`` only the op-value stream (value
+    collisions against the same schedule), ``nemesis`` shifts the
+    crash window and re-draws the fault stream (timeouts, crashes and
+    the applied? coins — the fault-schedule neighborhood).
+    Deterministic: the same (spec, row, mode, variant) always names
+    the same history."""
+    rows = np.asarray([r for r, _, _ in neighbors], np.uint32)
+    base = history_keys_for(spec.seed, rows, xp=np)
+    keys = {s: np.array(base[s], np.uint32, copy=True) for s in STREAMS}
+    lo = np.full(len(neighbors), spec.crash_lo, np.int32)
+    hi = np.full(len(neighbors), spec.crash_hi, np.int32)
+    step = max(1, spec.n_ops // 16)
+    for i, (_, mode, variant) in enumerate(neighbors):
+        salt = np.uint32(0xF00D + variant)
+        if mode == "order":
+            keys["sched"][i] = fold_in(np, keys["sched"][i], salt)
+        elif mode == "values":
+            keys["vals"][i] = fold_in(np, keys["vals"][i], salt)
+        elif mode == "nemesis":
+            keys["fault"][i] = fold_in(np, keys["fault"][i], salt)
+            shift = ((variant // 2) + 1) * step * (1 if variant % 2 else -1)
+            lo[i] = max(0, int(lo[i]) + shift)
+            hi[i] = max(int(lo[i]), int(hi[i]) + shift)
+        else:
+            raise ValueError(f"unknown neighborhood mode {mode!r}")
+    return keys, lo, hi
+
+
+def synth_cas_neighbors(spec: SynthSpec,
+                        neighbors: Sequence[Tuple[int, str, int]],
+                        backend: str = "device"
+                        ) -> Tuple[ColumnarOps, SynthMeta]:
+    """One batch holding every neighborhood history (row i of the
+    output is ``neighbors[i]``) — the fuzz loop's re-dispatch unit.
+    The generator batch pads to a power of two and slices back, so a
+    long fuzz campaign's varying witness counts reuse a handful of
+    compiled shapes instead of recompiling per round."""
+    keys, lo, hi = neighbor_keys(spec, neighbors)
+    R = len(neighbors)
+    Rp = 1 << max(R - 1, 1).bit_length()
+    if backend == "device" and Rp != R:
+        pad = Rp - R
+        keys = {s: np.concatenate([v, np.zeros(pad, np.uint32)])
+                for s, v in keys.items()}
+        lo = np.concatenate([lo, np.zeros(pad, np.int32)])
+        hi = np.concatenate([hi, np.zeros(pad, np.int32)])
+    cols, meta = synth_cas_device(spec, keys=keys, crash_lo=lo,
+                                  crash_hi=hi, backend=backend,
+                                  key_meta=False)
+    if cols.batch != R:
+        meta = SynthMeta(
+            peak_w=meta.peak_w[:R],
+            key_peak_w=(meta.key_peak_w[:R]
+                        if meta.key_peak_w is not None else None),
+            key_present=(meta.key_present[:R]
+                         if meta.key_present is not None else None),
+            spec=meta.spec)
+        cols = ColumnarOps(
+            type=cols.type[:R], process=cols.process[:R],
+            kind=cols.kind[:R], kinds=cols.kinds,
+            key=cols.key[:R] if cols.key is not None else None,
+            meta=meta)
+    return cols, meta

@@ -767,3 +767,97 @@ def run_seeds(builder: Callable[[int], dict], seeds,
         for handle in handles:
             handle.stop_logging()
     return tests
+
+
+def run_synth_seeds(spec, seeds, *, synth: str = "device", model=None,
+                    name: str = "synth-campaign", store_root=None,
+                    checkpoint: bool = True, resume: bool = False,
+                    check_kwargs: Optional[dict] = None) -> dict:
+    """run_seeds' synthesis twin: a seed campaign whose histories are
+    GENERATED (ops.synth_device / the legacy host generators via
+    ``synth=``) instead of executed against a cluster — the batch mode
+    at millions-of-histories scale, with zero host Op-list
+    materialization on the device path. Each seed checks one
+    ``spec``-shaped batch (seed folded in); generation, partition
+    (key column → P-compositional strain), encode, and dispatch all
+    ride check_synth.
+
+    Durability mirrors run_seeds: a CampaignCheckpoint over the seed
+    list plus one ChunkJournal per seed batch keyed by
+    store.spec_digest — a killed campaign resumed with ``resume=True``
+    re-runs ZERO completed seeds (their summaries rehydrate from disk)
+    and the in-flight seed resumes its journal with zero re-dispatched
+    histories. Returns {"seeds": {seed: {checked, invalid,
+    bad_sample}}, "invalid": total, "valid": bool}.
+    """
+    import dataclasses
+    import json as _json
+
+    import numpy as np
+
+    from .store import atomic_write_json
+    from .models.core import cas_register
+    from .ops.linearize import check_synth
+    from .store import ChunkJournal, CampaignCheckpoint, DEFAULT, \
+        spec_digest
+
+    seeds = [int(s) for s in seeds]
+    model = model if model is not None else cas_register()
+    root = store_root if store_root is not None else DEFAULT
+    cdir = Path(root.base) / name
+    ckpt = None
+    if checkpoint:
+        cdir.mkdir(parents=True, exist_ok=True)
+        ckpt = CampaignCheckpoint(
+            cdir / "campaign.jsonl",
+            {"name": name, "seeds": seeds,
+             "spec": spec_digest(spec, synth=synth)},
+            resume=resume)
+    out: dict = {"seeds": {}, "invalid": 0, "valid": True}
+    try:
+        for s in seeds:
+            sspec = dataclasses.replace(spec, seed=s)
+            state = ckpt.seed_state(s) if ckpt is not None else None
+            summary_path = cdir / f"seed-{s}.json" if checkpoint else None
+            if state is not None and state["done"]:
+                try:
+                    summ = _json.loads(summary_path.read_text())
+                    summ["resumed"] = True
+                    out["seeds"][str(s)] = summ
+                    out["invalid"] += summ["invalid"]
+                    continue
+                except Exception:
+                    log.warning("synth campaign resume: seed %s done "
+                                "but summary unreadable; re-running", s)
+            journal = None
+            if checkpoint:
+                ckpt.started(s, cdir)
+                journal = ChunkJournal(
+                    cdir / f"seed-{s}.journal.jsonl",
+                    {"spec": spec_digest(sspec, synth=synth)},
+                    resume=state is not None or resume)
+            try:
+                valid, bad = check_synth(model, sspec, synth=synth,
+                                         journal=journal,
+                                         **(check_kwargs or {}))
+            finally:
+                if journal is not None:
+                    journal.close()
+            inv = np.flatnonzero(~np.asarray(valid))
+            summ = {"checked": int(len(valid)),
+                    "invalid": int(inv.size),
+                    "bad_sample": [[int(r), int(np.asarray(bad)[r])]
+                                   for r in inv[:10].tolist()]}
+            if checkpoint:
+                atomic_write_json(summary_path, summ)
+                journal.finish()
+                ckpt.done(s)
+            out["seeds"][str(s)] = summ
+            out["invalid"] += summ["invalid"]
+        if ckpt is not None:
+            ckpt.finish()
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+    out["valid"] = out["invalid"] == 0
+    return out

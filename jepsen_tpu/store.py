@@ -906,6 +906,41 @@ def columnar_digest(cols) -> str:
     return h.hexdigest()[:16]
 
 
+def atomic_write_json(path, obj) -> None:
+    """Durable small-JSON write: fsynced temp file + atomic rename, so
+    a crash mid-write never leaves a torn artifact — the summary-file
+    primitive the synth/fuzz campaigns persist per-unit progress
+    through (their resume paths trust these files blindly). The temp
+    name carries the pid (the _aot_store discipline): two concurrent
+    writers of one path must not interleave into a shared tmp."""
+    path = Path(path)
+    tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def spec_digest(spec, **extra) -> str:
+    """Fingerprint of a deterministic generator spec (any dataclass,
+    e.g. ops.synth_device.SynthSpec) plus labeling kwargs — the
+    chunk-journal key for synthesized batches. A spec NAMES its batch
+    completely ((spec, backend) ↦ histories), so journals for
+    device-synthesized campaigns key on it without materializing a
+    single row, where stored batches pay a content digest
+    (columnar_digest)."""
+    import dataclasses
+    import hashlib
+
+    d = dataclasses.asdict(spec) if dataclasses.is_dataclass(spec) \
+        else dict(spec)
+    d.update(extra)
+    return hashlib.sha256(
+        json.dumps(d, sort_keys=True, default=str).encode()
+    ).hexdigest()[:16]
+
+
 def _kinds_from_json(text: str) -> list:
     """Decode a kinds vocabulary from JSON, restoring the tuple
     structure JSON flattens to lists (kinds are (f, value) tuples whose

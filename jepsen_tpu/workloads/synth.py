@@ -20,9 +20,28 @@ from ..history.core import index
 from ..history.ops import Op, invoke_op, ok_op, fail_op, info_op
 
 
+def seed_stream(seed0: int, n: int) -> List[int]:
+    """THE per-history seed sequence every batch entry point shares:
+    ``seed0 .. seed0 + n - 1``. One definition — the host batch
+    generators, the device family's ``synth="host"`` expansion
+    (ops.synth_device.synthesize), and tests all derive sub-seeds here
+    instead of re-inventing the stream, and it is pinned to the
+    historical contiguous range so every earlier round's fixtures stay
+    byte-identical."""
+    return [seed0 + i for i in range(n)]
+
+
+def seeded_rngs(seed0: int, n: int):
+    """(seed, random.Random) pairs down ``seed_stream`` — the RNG state
+    is derived once per history here rather than re-derived inside
+    every generator call."""
+    return [(s, random.Random(s)) for s in seed_stream(seed0, n)]
+
+
 def synth_cas_history(seed: int, *, n_procs: int = 5, n_ops: int = 40,
                       n_values: int = 5, corrupt: float = 0.0,
-                      p_info: float = 0.0, p_fail_read=None) -> List[Op]:
+                      p_info: float = 0.0, p_fail_read=None,
+                      rng: Optional[random.Random] = None) -> List[Op]:
     """One simulated CAS-register history (read/write/cas over n_values).
 
     corrupt — probability the history is made invalid by perturbing one
@@ -30,8 +49,10 @@ def synth_cas_history(seed: int, *, n_procs: int = 5, n_ops: int = 40,
     p_info  — probability a completion is indeterminate (timeout), the op
               possibly (50%) having taken effect; these ops stay pending
               to the end of the history, the hard case for checkers.
+    rng     — pre-seeded generator state (seeded_rngs); default derives
+              it from ``seed``.
     """
-    rng = random.Random(seed)
+    rng = rng if rng is not None else random.Random(seed)
     reg: Optional[int] = None
     h: List[Op] = []
     live = {}
@@ -91,12 +112,14 @@ def synth_cas_history(seed: int, *, n_procs: int = 5, n_ops: int = 40,
 
 
 def synth_cas_batch(n: int, seed0: int = 0, **kw) -> List[List[Op]]:
-    """n seeded histories: seeds seed0..seed0+n-1."""
-    return [synth_cas_history(seed0 + i, **kw) for i in range(n)]
+    """n seeded histories down the shared ``seed_stream``."""
+    return [synth_cas_history(s, rng=rng, **kw)
+            for s, rng in seeded_rngs(seed0, n)]
 
 
 def synth_la_history(seed: int, *, n_procs: int = 4, n_ops: int = 24,
-                     n_keys: int = 2, corrupt: float = 0.0) -> List[Op]:
+                     n_keys: int = 2, corrupt: float = 0.0,
+                     rng: Optional[random.Random] = None) -> List[Op]:
     """One simulated serializable list-append history (Elle's workhorse
     workload, the dependency-graph checker's native shape): ``append``
     ops carry ``[k, element]`` with globally unique elements, ok
@@ -111,7 +134,7 @@ def synth_la_history(seed: int, *, n_procs: int = 4, n_ops: int = 24,
     lower to graphs whose every edge points forward in completion
     order and are therefore acyclic.
     """
-    rng = random.Random(seed)
+    rng = rng if rng is not None else random.Random(seed)
     counter = 0
     lists: dict = {k: [] for k in range(n_keys)}
     applied_at: dict = {}            # element -> append completion line
@@ -157,22 +180,29 @@ def synth_la_history(seed: int, *, n_procs: int = 4, n_ops: int = 24,
 
 
 def synth_la_batch(n: int, seed0: int = 0, **kw) -> List[List[Op]]:
-    """n seeded list-append histories: seeds seed0..seed0+n-1."""
-    return [synth_la_history(seed0 + i, **kw) for i in range(n)]
+    """n seeded list-append histories down the shared ``seed_stream``."""
+    return [synth_la_history(s, rng=rng, **kw)
+            for s, rng in seeded_rngs(seed0, n)]
 
 
 def synth_wide_window_history(*, width: int = 17, n_values: int = 2,
-                              invalid: bool = False) -> List[Op]:
+                              invalid: bool = False,
+                              seed: Optional[int] = None) -> List[Op]:
     """A history whose pending window is exactly ``width``: width-1
     crashed writes pin slots forever, then one read completes ok while
     all of them are pending. The checker must close the frontier over
     2^(width-1) linearization subsets — the shape that exceeds a single
     device's window and exercises the frontier-sharded path
     (jepsen_tpu.parallel.frontier). ``invalid=True`` makes the read
-    observe a value no write could have produced."""
+    observe a value no write could have produced. ``seed`` draws the
+    pinned write values deterministically from the seed (the batch/
+    device-synth form); None keeps the historical ``p % n_values``
+    pattern."""
+    rng = random.Random(seed) if seed is not None else None
     h: List[Op] = []
     for p in range(width - 1):
-        h.append(invoke_op(p, "write", p % n_values))
+        v = rng.randrange(n_values) if rng is not None else p % n_values
+        h.append(invoke_op(p, "write", v))
     h.append(invoke_op(width - 1, "read", None))
     h.append(ok_op(width - 1, "read", n_values + 5 if invalid else None))
     return index(h)

@@ -51,3 +51,9 @@ def pytest_configure(config):
                    "dispatch — per-key W collapse, verdict "
                    "recombination, and partitioned-vs-exact parity "
                    "(deterministic; runs in tier-1)")
+    config.addinivalue_line(
+        "markers", "synthdev: on-device history synthesis — "
+                   "device/numpy-twin tensor parity, seeded fault "
+                   "schedules, partition-metadata agreement, dispatch "
+                   "budget, and fuzz kill-and-resume (deterministic; "
+                   "runs in tier-1)")

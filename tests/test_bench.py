@@ -18,16 +18,17 @@ REPO = Path(__file__).resolve().parent.parent
 def test_bench_emits_contract_json():
     env = dict(os.environ,
                JT_BENCH_B="200", JT_BENCH_OPS="100",
-               JT_BENCH_REPEATS="1", JT_BENCH_FOLD_B="50",
-               JT_BENCH_GRAPH_B="40",
-               JT_BENCH_STORE_B="20", JT_BENCH_CONVERTED="200",
-               JT_BENCH_FULL_PARITY="0", JT_BENCH_WAL_OPS="400",
+               JT_BENCH_REPEATS="1", JT_BENCH_FOLD_B="32",
+               JT_BENCH_GRAPH_B="32",
+               JT_BENCH_STORE_B="12", JT_BENCH_CONVERTED="120",
+               JT_BENCH_FULL_PARITY="0", JT_BENCH_WAL_OPS="300",
                # Per-op commits: 400 toy ops can finish inside one
                # 50 ms window, which would leave zero time-triggered
                # group commits to measure.
                JT_WAL_FLUSH_MS="0",
-               JT_BENCH_LONG_B="50", JT_BENCH_LONG_OPS="500",
-               JT_BENCH_XLONG_B="8", JT_BENCH_XLONG_OPS="2000")
+               JT_BENCH_LONG_B="32", JT_BENCH_LONG_OPS="500",
+               JT_BENCH_XLONG_B="6", JT_BENCH_XLONG_OPS="2000",
+               JT_BENCH_SYNTH_B="64")
     r = subprocess.run([sys.executable, str(REPO / "bench.py")],
                        capture_output=True, text=True, env=env,
                        cwd=REPO, timeout=900)
@@ -44,9 +45,9 @@ def test_bench_emits_contract_json():
     assert d["parity"]["full"] is False
     assert d["parity"]["valid"] is True          # sampled check ran
     assert d["converted_verdict_match"] is True
-    assert d["store_recheck_runs"] == 20
+    assert d["store_recheck_runs"] == 12
     assert d["store_recheck_rate"] > 0
-    assert d["fold_histories"] == 50
+    assert d["fold_histories"] == 32
     # Fused/renumbered-scan instrumentation (ISSUE 2 acceptance).
     assert d["fusion_ratio"] >= 1.0
     assert d["mean_live_slots"] > 0
@@ -56,7 +57,7 @@ def test_bench_emits_contract_json():
     # Graph-checker section (ISSUE 4 acceptance): MXU op-model figures
     # next to the WGL VPU metrics.
     g = d["graph_checker"]
-    assert g["graphs"] == 40 and g["graphs_per_s"] > 0
+    assert g["graphs"] == 32 and g["graphs_per_s"] > 0
     assert g["closure_matmuls"] > 0 and g["mxu_util"] >= 0
     assert g["anomalies"] >= 1
     assert g["vertex_buckets"]
@@ -64,7 +65,7 @@ def test_bench_emits_contract_json():
     # Run-durability section (ISSUE 5 acceptance): live-WAL worker-loop
     # overhead, group-commit flush percentiles, salvage throughput.
     rd = d["run_durability"]
-    assert rd["wal_ops"] == 400
+    assert rd["wal_ops"] == 300
     assert rd["ops_per_s_wal_on"] > 0 and rd["ops_per_s_wal_off"] > 0
     assert rd["group_commits"] > 0 and rd["flush_p99_ms"] is not None
     assert rd["salvage_ops_per_s"] > 0
@@ -97,3 +98,21 @@ def test_bench_emits_contract_json():
     assert (cr["oversize_w"] + cr["overflow"]
             == d["cpu_routed_rows"])
     assert cr["quarantine"] == 0
+    # On-device synthesis section (ISSUE 7 acceptance): host vs device
+    # generator rates, streamed generate→check source, fuzz loop —
+    # and the headline synth share broken out per section.
+    assert d["synth"]["mode"] in ("host", "device")
+    assert 0 <= d["synth"]["share_of_e2e"] <= 1
+    sd = d["synth_device"]
+    assert sd["histories"] == 64
+    assert sd["host_hist_per_s"] > 0 and sd["device_hist_per_s"] > 0
+    assert sd["host_ops_per_s"] > 0 and sd["device_ops_per_s"] > 0
+    assert sd["device_vs_host_speedup"] > 0
+    assert sd["t_first_dispatch_s"] is not None
+    assert sd["streamed_gen_check_subs_per_s"] > 0
+    assert sd["streamed_subs_checked"] > 0
+    fz = sd["fuzz"]
+    assert fz["iters_per_s"] > 0 and fz["neighborhoods"] >= 0
+    # Per-section synth breakdown on the probes.
+    assert d["long_history"]["long"]["synth_s"] >= 0
+    assert d["xlong_history"]["synth_s"] >= 0

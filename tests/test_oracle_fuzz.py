@@ -443,6 +443,35 @@ def test_mutation_fusion_map_corruption_is_caught(monkeypatch,
         "corrupted fusion map escaped the streamed-vs-exact parity net"
 
 
+def test_fuzz_device_synth_corpus_matches_oracle():
+    """Device-SYNTHESIZED corpus vs the brute oracle: histories born
+    in the columnar layout on device (ops.synth_device) decode back to
+    Op lists, the brute permutation-search oracle decides them, and
+    the born-columnar device check (check_synth) must agree with it
+    verdict-for-verdict — closing the generate-where-you-check loop
+    against an oracle that shares no machinery with either the
+    generator or the WGL engines. (The decoded Op-list checking path
+    is already oracle-pinned corpus-wide by the blind-fuzz tests
+    above, generator-independently.)"""
+    import numpy as np
+
+    from jepsen_tpu.history.columnar import columnar_to_ops
+    from jepsen_tpu.ops.linearize import check_synth
+    from jepsen_tpu.ops.synth_device import SynthSpec, synthesize
+
+    model = cas_register()
+    spec = SynthSpec(family="cas", n=120, seed=9090, n_procs=3,
+                     n_ops=6, n_values=2, corrupt=0.5, p_info=0.2,
+                     crash_lo=1, crash_hi=4, p_crash=0.3)
+    cols, _ = synthesize(spec, "device", key_meta=False)
+    hists = [columnar_to_ops(cols, r) for r in range(cols.batch)]
+    want = [brute_check(model, h)["valid"] for h in hists]
+    assert want.count(False) >= 10 and want.count(True) >= 10, \
+        "corpus must exercise both verdicts"
+    v, _b = check_synth(model, spec, max_slots=16)
+    assert [bool(x) for x in np.asarray(v)] == want, "born-columnar"
+
+
 def test_oracle_refuses_big_histories():
     h = index([op for p in range(16)
                for op in (invoke_op(p, "write", p), ok_op(p, "write", p))])
