@@ -39,7 +39,13 @@ round; ``device`` synthesizes the headline batch with the jitted
 counter-PRNG generator of ops/synth_device.py — same logical
 parameters, its own stream), JT_BENCH_SYNTH_B (rows for the
 synth_device section's host-vs-device rate comparison; 0 skips it),
-JT_BENCH_FUZZ=0 (skip the fuzz-loop figure). Narrow
+JT_BENCH_FUZZ=0 (skip the fuzz-loop figure), JT_BENCH_TRACE=0 (skip
+the telemetry section) / JT_BENCH_TRACE_B (its workload size; the
+section measures span-tracing overhead against the ≤5% budget and the
+device-busy vs host-gap breakdown — doc/observability.md). JT_TRACE=1
+traces the WHOLE bench through the flight recorder and exports a
+Chrome-trace ``trace.json`` ($JT_TRACE_EXPORT overrides the path).
+Narrow
 buckets all stay on device (the scheduler consolidates them into W
 classes); only tiny wide buckets route to the native CPU engine. The
 encode runs the production shrink passes (event fusion + state
@@ -1018,6 +1024,89 @@ def main():
             "fuzz": fuzz_section,
         }
 
+    # ------------------------------------------------ telemetry (spans)
+    # The observability spine (jepsen_tpu/telemetry.py,
+    # doc/observability.md): a headline-shaped workload runs untraced
+    # then traced (the ≤5% overhead budget, measured), a journaled
+    # traced pass proves span coverage (encode / dispatch / decode /
+    # journal per chunk), and the dispatch-gap analyzer reports
+    # device-busy vs host-gap fractions with the top gap causes — the
+    # direct diagnostic for the dispatch-latency plateau. With
+    # JT_TRACE=1 on the whole process the HEADLINE run's spans are in
+    # the flight recorder too, and everything exports as a
+    # Chrome-trace/Perfetto trace.json ($JT_TRACE_EXPORT, default
+    # ./trace.json). JT_BENCH_TRACE=0 skips; JT_BENCH_TRACE_B sizes
+    # the section's workload.
+    tel_section = None
+    if os.environ.get("JT_BENCH_TRACE", "1") != "0":
+        import tempfile as _tel_tf
+
+        from jepsen_tpu import telemetry as _tel
+        from jepsen_tpu.ops.linearize import check_columnar as _tel_cc
+        from jepsen_tpu.store import ChunkJournal as _TelCJ
+
+        ambient = _tel.enabled()
+        headline_spans = _tel.spans() if ambient else []
+        TB = min(int(os.environ.get("JT_BENCH_TRACE_B", "512")), B)
+        tcols = synth_cas_columnar(TB, seed=11, n_procs=5, n_ops=n_ops,
+                                   n_values=5, corrupt=0.1, p_info=0.01,
+                                   n_keys=n_keys)
+
+        def tel_run(journal=None):
+            return _tel_cc(model, tcols, journal=journal)
+
+        tel_run()                             # warm the shapes
+        _tel.configure(False)
+        off_ts = []
+        for _ in range(max(2, repeats)):
+            t0 = time.time()
+            tel_run()
+            off_ts.append(time.time() - t0)
+        t_tr_off = statistics.median(off_ts)
+        _tel.configure(True)
+        on_ts = []
+        for _ in range(max(2, repeats)):
+            _tel.reset()
+            t0 = time.time()
+            tel_run()
+            on_ts.append(time.time() - t0)
+        t_tr_on = statistics.median(on_ts)
+        gap = _tel.gaps()                     # the last traced pass
+        # One journaled traced pass: the ChunkJournal sink adds the
+        # journal span per retired chunk — full coverage proof.
+        _tel.reset()
+        with _tel_tf.TemporaryDirectory() as td:
+            tj = _TelCJ(os.path.join(td, "bench-tel.journal.jsonl"),
+                        {"bench": "telemetry"})
+            tel_run(journal=tj)
+            tj.finish()
+        journaled = _tel.spans()
+        kinds = sorted({r["name"] for r in journaled
+                        if r.get("ph") == "X"})
+        trace_json = None
+        trace_events = 0
+        if ambient:
+            trace_json = os.environ.get("JT_TRACE_EXPORT", "trace.json")
+            trace_events = _tel.export_chrome(
+                trace_json, headline_spans + journaled)
+        _tel.configure("env")                 # restore the ambient mode
+        tel_section = {
+            "histories": TB,
+            "untraced_s": round(t_tr_off, 3),
+            "traced_s": round(t_tr_on, 3),
+            "overhead_pct": round(100.0 * (t_tr_on - t_tr_off)
+                                  / max(t_tr_off, 1e-9), 2),
+            "span_kinds": kinds,
+            "spans": len(journaled),
+            "device_busy_frac": gap["device_busy_frac"],
+            "host_gap_frac": gap["host_gap_frac"],
+            "n_gaps": gap["n_gaps"],
+            "top_gap_causes": gap["top_gap_causes"][:5],
+            "ambient_trace": ambient,
+            "trace_json": trace_json,
+            "trace_events": trace_events,
+        }
+
     print(json.dumps({
         "metric": "linearizability_check_throughput_1kop_cas_e2e",
         "value": round(rate, 2),
@@ -1139,6 +1228,7 @@ def main():
             "share_of_e2e": round(t_synth / (t_synth + t_e2e), 4),
         },
         "synth_device": synth_section,
+        "telemetry": tel_section,
     }))
 
 

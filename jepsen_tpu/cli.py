@@ -701,9 +701,56 @@ def fuzz_cmd() -> dict:
     return {"fuzz": {"add_opts": add_opts, "run": run}}
 
 
+def trace_cmd() -> dict:
+    """``trace --file trace.jsonl``: summarize / export a recorded
+    span trace (the JSONL sink ``JT_TRACE=<path>`` streams — see
+    jepsen_tpu.telemetry and doc/observability.md). Prints one JSON
+    line: per-name span totals, optional dispatch-gap report
+    (``--gaps`` — device-busy vs host-gap fractions and the top gap
+    causes, the plateau diagnostic), and ``--export OUT`` writes the
+    Chrome-trace/Perfetto ``trace.json`` form (load at
+    chrome://tracing or ui.perfetto.dev)."""
+    def add_opts(p):
+        p.add_argument("--file", required=True,
+                       help="JSONL trace file (a JT_TRACE=<path> sink)")
+        p.add_argument("--export", default=None, metavar="OUT",
+                       help="Also write Chrome-trace trace.json here")
+        p.add_argument("--gaps", action="store_true", default=False,
+                       help="Include the dispatch-gap report")
+        p.add_argument("--top", type=int, default=12,
+                       help="Span names in the summary (by total time)")
+
+    def run(opts):
+        import json as _json
+
+        from . import telemetry
+
+        try:
+            records = telemetry.read_trace(opts.file)
+        except OSError as e:
+            print(f"can't read {opts.file}: {e}")
+            return 254
+        summary = telemetry.summarize(records)
+        by = summary["by_name"]
+        top = sorted(by, key=lambda k: -by[k]["total_s"])[:opts.top]
+        out = {"file": opts.file, "spans": summary["spans"],
+               "events": summary["events"],
+               "by_name": {k: by[k] for k in top}}
+        if opts.gaps:
+            out["gaps"] = telemetry.gaps(records)
+        if opts.export:
+            out["exported"] = opts.export
+            out["trace_events"] = telemetry.export_chrome(
+                opts.export, records)
+        print(_json.dumps(out, default=str))
+        return 0
+
+    return {"trace": {"add_opts": add_opts, "run": run}}
+
+
 def main(argv: Optional[Sequence[str]] = None) -> None:
     run_cli({**suite_cmd(), **serve_cmd(), **recheck_cmd(),
-             **salvage_cmd(), **fuzz_cmd()}, argv)
+             **salvage_cmd(), **fuzz_cmd(), **trace_cmd()}, argv)
 
 
 if __name__ == "__main__":

@@ -56,6 +56,27 @@ def fuzz_round(model, rspec, *, synth: str, neighborhood: int,
     """One generate → check → mutate → re-dispatch round. Returns the
     round summary; journals (when ``journal_dir`` is set) make it
     resumable mid-round with zero re-dispatched rows."""
+    from . import telemetry
+    with telemetry.span("fuzz.round", seed=int(rspec.seed),
+                        histories=int(rspec.n)) as _sp:
+        out = _fuzz_round_impl(
+            model, rspec, synth=synth, neighborhood=neighborhood,
+            max_witnesses=max_witnesses, modes=modes,
+            journal_dir=journal_dir, resume=resume, verify=verify,
+            check_kwargs=check_kwargs)
+        _sp.set(invalid=out["invalid"],
+                neighborhoods=out["neighborhoods"])
+    reg = telemetry.REGISTRY
+    reg.counter("fuzz.checked").inc(out["checked"])
+    reg.counter("fuzz.invalid").inc(out["invalid"])
+    reg.counter("fuzz.neighborhoods").inc(out["neighborhoods"])
+    reg.counter("fuzz.disagreements").inc(out.get("disagreements", 0))
+    return out
+
+
+def _fuzz_round_impl(model, rspec, *, synth, neighborhood,
+                     max_witnesses, modes, journal_dir, resume, verify,
+                     check_kwargs):
     from .ops.linearize import check_synth, check_columnar
     from .ops.synth_device import synth_cas_neighbors
     from .store import ChunkJournal, spec_digest

@@ -37,6 +37,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 from pathlib import Path
 from typing import List, Optional, Tuple
@@ -112,7 +113,15 @@ class HistoryWAL:
         self._f.flush()
         os.fsync(self._f.fileno())
         if self._record_sync:
-            self.sync_ns.append(time.monotonic_ns() - t0)
+            dt = time.monotonic_ns() - t0
+            self.sync_ns.append(dt)
+            # Group-commit latency also lands on the unified registry
+            # (results.json telemetry carries the p50/p99 the bench's
+            # run_durability section used to be the only home of).
+            from .. import telemetry
+            telemetry.REGISTRY.histogram("wal.flush_ms").observe(
+                dt / 1e6)
+            telemetry.REGISTRY.counter("wal.group_commits").inc()
         self._dirty = False
         self._last_sync = time.monotonic()
 
@@ -167,6 +176,84 @@ class HistoryWAL:
 
 
 # ------------------------------------------------------------ reading
+
+# Bounded per-path cursor cache for wal_progress: an always-on /live
+# poller must not grow one entry per run forever (finished runs stop
+# being polled but their entries would otherwise persist). LRU via
+# dict insertion order — re-inserting on touch keeps hot paths warm.
+_PROGRESS_CACHE: dict = {}
+_PROGRESS_CACHE_MAX = 256
+_PROGRESS_READ_BUDGET = 32 << 20          # bytes scanned per call
+_PROGRESS_LOCK = threading.Lock()
+
+
+def wal_progress(path) -> Optional[dict]:
+    """Cheap live-run probe: header + latest phase + op count, WITHOUT
+    materializing a single Op — what the web UI's ``/live`` view polls
+    per in-flight run (read_wal builds the full Op list; on a
+    million-op campaign that is the difference between a page load and
+    a stall). Incremental: the per-path cursor scans only bytes
+    appended since the last call, so a 2-second poll loop costs the
+    tail, not a full re-read of a multi-GB segment every tick (a
+    shrunken/replaced file resets the cursor). A torn final line (the
+    in-flight group commit) is left for the next poll to complete.
+    None when there is no durable header yet."""
+    p = Path(path)
+    try:
+        size = p.stat().st_size
+    except OSError:
+        return None
+    key = str(p)
+    with _PROGRESS_LOCK:
+        st = _PROGRESS_CACHE.pop(key, None)       # re-insert = LRU touch
+        if st is None or size < st["pos"]:
+            st = {"pos": 0, "ops": 0, "phase": None, "header": None}
+        _PROGRESS_CACHE[key] = st
+        while len(_PROGRESS_CACHE) > _PROGRESS_CACHE_MAX:
+            _PROGRESS_CACHE.pop(next(iter(_PROGRESS_CACHE)))
+        if size > st["pos"]:
+            # Bounded per-call read: the first poll of a multi-GB
+            # segment must not materialize the whole file in RAM under
+            # the global lock — the cursor catches up over successive
+            # polls instead (32 MB/tick ≫ any live append rate).
+            budget = min(size - st["pos"], _PROGRESS_READ_BUDGET)
+            try:
+                with open(p, "rb") as f:
+                    f.seek(st["pos"])
+                    data = f.read(budget)
+            except OSError:
+                return None
+            pos = consumed = 0
+            while pos < len(data):
+                nl = data.find(b"\n", pos)
+                if nl < 0:
+                    break          # torn tail: next poll completes it
+                line = data[pos:nl].strip()
+                try:
+                    if st["header"] is None:
+                        if line:
+                            d = json.loads(line)
+                            if d.get("wal") != WAL_MAGIC:
+                                del _PROGRESS_CACHE[key]
+                                return None
+                            st["header"] = d
+                    elif b'"type"' in line:
+                        st["ops"] += 1
+                    elif line:
+                        st["phase"] = json.loads(line).get(
+                            "phase", st["phase"])
+                except Exception:
+                    break          # corrupt line: the prefix stands
+                pos = nl + 1
+                consumed = pos     # only whole parsed lines advance
+            st["pos"] += consumed
+        header = st["header"]
+        if header is None:
+            return None
+        return {"header": header, "ops": st["ops"],
+                "phase": st["phase"] or header.get("phase", "setup"),
+                "seed": header.get("seed"), "bytes": size}
+
 
 def wal_header(path) -> Optional[dict]:
     """Just the (fsynced-first) header line — the cheap probe for

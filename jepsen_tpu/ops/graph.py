@@ -379,18 +379,20 @@ def encode_graphs(graphs: Sequence[DepGraph],
                   ) -> List[GraphBucket]:
     """Bucket a batch of graphs by padded vertex count (powers of two,
     floor GRAPH_MIN_V) and pack each bucket's adjacency bitsets."""
+    from .. import telemetry
     if indices is None:
         indices = list(range(len(graphs)))
-    by_v: Dict[int, List[int]] = {}
-    for j, g in enumerate(graphs):
-        by_v.setdefault(bucket_v(g.n), []).append(j)
-    out = []
-    for V in sorted(by_v):
-        js = by_v[V]
-        out.append(GraphBucket(
-            adj=np.stack([pack_graph(graphs[j], V) for j in js]),
-            V=V, indices=[indices[j] for j in js]))
-    return out
+    with telemetry.span("graph.pack", graphs=len(graphs)):
+        by_v: Dict[int, List[int]] = {}
+        for j, g in enumerate(graphs):
+            by_v.setdefault(bucket_v(g.n), []).append(j)
+        out = []
+        for V in sorted(by_v):
+            js = by_v[V]
+            out.append(GraphBucket(
+                adj=np.stack([pack_graph(graphs[j], V) for j in js]),
+                V=V, indices=[indices[j] for j in js]))
+        return out
 
 
 # ------------------------------------------------------------ the kernel
@@ -546,6 +548,8 @@ def refine_witness(g: DepGraph, level_index: int) -> List[dict]:
     """Host refinement of a device-flagged cyclic graph into the
     minimal witness cycle, annotated with per-vertex op descriptors and
     the edge types carrying each hop (the fused_refine pattern)."""
+    from .. import telemetry
+    telemetry.event("graph.refine", vertices=g.n, level=level_index)
     succ = _succ_lists(g, LEVEL_TYPES[level_index])
     cyc = shortest_cycle(g.n, succ)
     if cyc is None:                  # defensive: caller said cyclic

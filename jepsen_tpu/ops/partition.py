@@ -104,9 +104,20 @@ def partition_columnar(cols) -> Optional[PartitionedBatch]:
     O(keys * batch * lines) numpy, far below the encode walk it feeds.
     """
     from ..history.columnar import PAD, ColumnarOps
+    from .. import telemetry
     key = getattr(cols, "key", None)
     if key is None:
         return None
+    with telemetry.span("partition.strain", rows=cols.batch) as _sp:
+        pb = _partition_columnar_impl(cols, key, PAD, ColumnarOps)
+        if pb is not None:
+            _sp.set(subs=pb.n_subs)
+            telemetry.REGISTRY.counter("partition.batches").inc()
+            telemetry.REGISTRY.counter("partition.subs").inc(pb.n_subs)
+        return pb
+
+
+def _partition_columnar_impl(cols, key, PAD, ColumnarOps):
     real = cols.type != PAD
     keyed = real & (key >= 0)
     uniq = np.unique(key[keyed]) if keyed.any() else np.empty(0, np.int64)

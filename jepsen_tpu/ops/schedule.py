@@ -82,6 +82,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import telemetry
 from .encode import EncodedBatch, merge_batches
 from .faults import (CorruptOutput, FaultInjector, WatchdogExpired,
                      classify_failure, corrupt_arrays, validate_decoded)
@@ -399,6 +400,13 @@ AOT_STATS = {"hits": 0, "misses": 0, "exported": 0, "rejected": 0}
 _AOT_MISSING: set = set()      # keys probed on disk and absent
 
 
+def _aot_bump(key: str) -> None:
+    """One AOT-shipping stat event: the legacy module dict (bench/test
+    surface) plus the unified registry (results.json telemetry)."""
+    AOT_STATS[key] += 1
+    telemetry.REGISTRY.counter(f"aot.{key}").inc()
+
+
 def aot_dir() -> Optional[str]:
     if os.environ.get("JT_COMPILE_CACHE") == "0":
         return None
@@ -450,16 +458,16 @@ def _aot_load(key: Tuple):
     try:
         if not os.path.exists(path):
             _AOT_MISSING.add(key)
-            AOT_STATS["misses"] += 1
+            _aot_bump("misses")
             return None
         compiled = _aot_read(path)
         if compiled is None:
-            AOT_STATS["rejected"] += 1
+            _aot_bump("rejected")
             return None
-        AOT_STATS["hits"] += 1
+        _aot_bump("hits")
         return compiled
     except Exception:
-        AOT_STATS["rejected"] += 1
+        _aot_bump("rejected")
         return None
 
 
@@ -485,7 +493,7 @@ def _aot_store(key: Tuple, compiled) -> None:
             pickle.dump((_aot_env_tag(), payload, in_tree, out_tree), f)
         os.replace(tmp, path)
         _AOT_MISSING.discard(key)
-        AOT_STATS["exported"] += 1
+        _aot_bump("exported")
     except Exception:
         pass
 
@@ -610,6 +618,22 @@ def prewarm_kernels(specs: Iterable[Tuple]) -> List[threading.Thread]:
     return threads
 
 
+
+def _stat_inc(sch, family: str, key: str, n) -> None:
+    """Shared locked stats+registry increment for both schedulers:
+    bump the instance stats dict under its lock and mirror into the
+    process registry as ``scheduler.<key>{family=...}`` through a
+    memoized counter handle (the per-chunk hot path must not rebuild
+    key strings)."""
+    with sch._stats_lock:
+        sch.stats[key] = sch.stats.get(key, 0) + n
+        c = sch._mirrors.get(key)
+        if c is None:
+            c = sch._mirrors[key] = telemetry.REGISTRY.counter(
+                f"scheduler.{key}", family=family)
+    c.inc(n)
+
+
 # --------------------------------------------------------------- scheduler
 
 class _Run:
@@ -731,6 +755,14 @@ class BucketScheduler:
         self.row_provenance: Dict[int, str] = {}
         self._safe_bp: Dict[Tuple[int, int], int] = {}
         self._awaited_shapes: set = set()
+        # ``stats`` is read by callers as a plain dict, but increments
+        # go through _inc: chunks of concurrent fused groups retire on
+        # executor/retire threads, and an unlocked read-modify-write
+        # would drop counts (they also mirror into the process-wide
+        # telemetry registry — the results.json telemetry block).
+        self._stats_lock = threading.Lock()
+        self._mirrors: dict = {}       # key -> registry counter handle
+        self._chunk_seq = 0            # trace chunk ordinals
         self.stats: dict = {
             "input_buckets": 0, "classes": [], "chunks": 0,
             "dispatches": 0, "fused_groups": 0,
@@ -748,6 +780,9 @@ class BucketScheduler:
         self._t0 = None
         self._first_dispatch_t = None
         self._last_retire_t = None
+
+    def _inc(self, key: str, n=1) -> None:
+        _stat_inc(self, "wgl", key, n)
 
     # ------------------------------------------------------------ plumbing
     def _class_chunk(self, V: int, W: int) -> int:
@@ -822,7 +857,7 @@ class BucketScheduler:
                     "pre-warm compile for kernel shape %s wedged past "
                     "%.0fs; falling back to a duplicate jit compile",
                     key, PREWARM_WAIT_S)
-                self.stats["prewarm_wedged"] += 1
+                self._inc("prewarm_wedged")
         return compiled
 
     def _resolve(self, batch: EncodedBatch, Bp: int, Np: int):
@@ -841,21 +876,30 @@ class BucketScheduler:
         kernel launch (async) — so the retried path can never drift
         from the path it is retrying. Returns (lazy out, decode
         delay)."""
-        if self.faults is not None:
-            self.faults.fire("encode")
-        ev_type, ev_slot, ev_slots, target = self._pad_chunk(
-            batch, lo, hi, Bp, Np)
+        with self._stats_lock:
+            ordinal = self._chunk_seq
+            self._chunk_seq += 1
+        with telemetry.span("encode", V=batch.V, W=batch.W,
+                            rows=hi - lo, chunk=ordinal, tag=tag):
+            if self.faults is not None:
+                self.faults.fire("encode")
+            ev_type, ev_slot, ev_slots, target = self._pad_chunk(
+                batch, lo, hi, Bp, Np)
         delay = 0.0
         if self.faults is not None:
             delay = self.faults.sleep_for(self.faults.fire("dispatch"))
-        kern = self._resolve(batch, Bp, Np)
-        log_kernel_shapes(batch.V, batch.W, "data1", batch.shared_target,
-                          self.donate, Bp, Np, batch.eff_w_live)
-        DISPATCH_LOG.append((tag, batch.V, batch.W, hi - lo))
-        self.stats["dispatches"] += 1
-        out = kern(ev_type, ev_slot, ev_slots,
-                   np.ascontiguousarray(batch.target[0])
-                   if batch.shared_target else target)
+        with telemetry.span("dispatch", cat="device", V=batch.V,
+                            W=batch.W, rows=hi - lo, chunk=ordinal,
+                            tag=tag):
+            kern = self._resolve(batch, Bp, Np)
+            log_kernel_shapes(batch.V, batch.W, "data1",
+                              batch.shared_target, self.donate, Bp, Np,
+                              batch.eff_w_live)
+            DISPATCH_LOG.append((tag, batch.V, batch.W, hi - lo))
+            self._inc("dispatches")
+            out = kern(ev_type, ev_slot, ev_slots,
+                       np.ascontiguousarray(batch.target[0])
+                       if batch.shared_target else target)
         return out, delay
 
     def _member_spec(self, batch: EncodedBatch, Bp: int,
@@ -895,8 +939,13 @@ class BucketScheduler:
                 flat: List = []
                 specs: List[Tuple] = []
                 delay = 0.0
+                with self._stats_lock:
+                    group_id = self.stats["fused_groups"]
                 for run, lo, hi, Bp in members:
                     b = run.batch
+                    with self._stats_lock:
+                        ordinal = self._chunk_seq
+                        self._chunk_seq += 1
                     Np = _round_up(b.n_events, EVENT_QUANTUM)
                     # Fault hooks fire once per MEMBER, not per group:
                     # the nemesis ordinals (FaultPlan chunk=N) count
@@ -904,10 +953,13 @@ class BucketScheduler:
                     # fault-schedule parity tests pin the pre-fusion
                     # ordinals. Member delays accumulate (each would
                     # have stalled its own decode).
-                    if self.faults is not None:
-                        self.faults.fire("encode")
-                    ev_type, ev_slot, ev_slots, target = \
-                        self._pad_chunk(b, lo, hi, Bp, Np)
+                    with telemetry.span("encode", V=b.V, W=b.W,
+                                        rows=hi - lo, chunk=ordinal,
+                                        fuse_group=group_id):
+                        if self.faults is not None:
+                            self.faults.fire("encode")
+                        ev_type, ev_slot, ev_slots, target = \
+                            self._pad_chunk(b, lo, hi, Bp, Np)
                     if self.faults is not None:
                         delay += self.faults.sleep_for(
                             self.faults.fire("dispatch"))
@@ -932,10 +984,15 @@ class BucketScheduler:
                     # racing a duplicate jit compile.
                     self._warmed_groups.add(gspec)
                     prewarm_kernels([gspec])
-                kern = self._resolve_group(spec_t)
-                self.stats["dispatches"] += 1
-                self.stats["fused_groups"] += 1
-                out_flat = kern(*flat)
+                with telemetry.span(
+                        "dispatch", cat="device", fused=True,
+                        fuse_group=group_id, members=len(members),
+                        rows=sum(hi - lo for _, lo, hi, _ in members),
+                        ws=[m[1] for m in specs]):
+                    kern = self._resolve_group(spec_t)
+                    self._inc("dispatches")
+                    self._inc("fused_groups")
+                    out_flat = kern(*flat)
                 outs = [tuple(out_flat[3 * i:3 * i + 3])
                         for i in range(len(members))]
         except Exception as e:
@@ -950,10 +1007,10 @@ class BucketScheduler:
                 # produced its first shippable chunk.
                 self.stats["t_first_dispatch_s"] = round(
                     self._first_dispatch_t - self._t0, 4)
-        self.stats["chunks"] += len(members)
+        self._inc("chunks", len(members))
         for _, lo, hi, Bp in members:
-            self.stats["pad_rows"] += Bp - (hi - lo)
-        self.stats["dispatch_busy_s"] += time.monotonic() - t0
+            self._inc("pad_rows", Bp - (hi - lo))
+        self._inc("dispatch_busy_s", time.monotonic() - t0)
         return (members, outs, delay)
 
     # ------------------------------------------------ watchdog + ladder
@@ -983,29 +1040,30 @@ class BucketScheduler:
         a corrupt fault, validate (corrupt output becomes a retryable
         fault, never a wrong verdict), and shape the frontier per
         return_frontier."""
-        kind = None
-        if self.faults is not None:
-            kind = self.faults.fire("decode")
-            s = self.faults.sleep_for(kind)
-            if s:
-                time.sleep(s)
-        valid, bad, front = out
-        v = np.asarray(valid)[:nb]
-        b = np.asarray(bad)[:nb]
-        if kind == "corrupt":
-            v, b = corrupt_arrays(v, b)
-        validate_decoded(v, b, batch.n_events)
-        fr = None
-        if self.return_frontier is True:
-            fr = np.asarray(front)[:nb]
-        elif self.return_frontier == "invalid":
-            fr = {}
-            rows = np.nonzero(~v)[0]
-            if rows.size:
-                sel = np.asarray(front[rows])      # device gather
-                for i, r in enumerate(rows):
-                    fr[int(r)] = sel[i]
-        return v, b, fr
+        with telemetry.span("decode", V=batch.V, W=batch.W, rows=nb):
+            kind = None
+            if self.faults is not None:
+                kind = self.faults.fire("decode")
+                s = self.faults.sleep_for(kind)
+                if s:
+                    time.sleep(s)
+            valid, bad, front = out
+            v = np.asarray(valid)[:nb]
+            b = np.asarray(bad)[:nb]
+            if kind == "corrupt":
+                v, b = corrupt_arrays(v, b)
+            validate_decoded(v, b, batch.n_events)
+            fr = None
+            if self.return_frontier is True:
+                fr = np.asarray(front)[:nb]
+            elif self.return_frontier == "invalid":
+                fr = {}
+                rows = np.nonzero(~v)[0]
+                if rows.size:
+                    sel = np.asarray(front[rows])      # device gather
+                    for i, r in enumerate(rows):
+                        fr[int(r)] = sel[i]
+            return v, b, fr
 
     def _await(self, out, nb: int, batch: EncodedBatch,
                deadline: float, delay: float = 0.0):
@@ -1031,7 +1089,9 @@ class BucketScheduler:
         try:
             r, err = q.get(timeout=deadline)
         except queue.Empty:
-            self.stats["watchdog_fired"] += 1
+            self._inc("watchdog_fired")
+            telemetry.event("scheduler.watchdog", V=batch.V,
+                            W=batch.W, rows=nb)
             raise WatchdogExpired(
                 f"chunk (V={batch.V}, W={batch.W}, rows={nb}) exceeded "
                 f"its {deadline:.2f}s decode deadline") from None
@@ -1060,7 +1120,9 @@ class BucketScheduler:
         last: Optional[BaseException] = None
         for attempt in range(self.max_retries + 1):
             if attempt:
-                self.stats["retries"] += 1
+                self._inc("retries")
+                telemetry.event("scheduler.retry", V=batch.V,
+                                W=batch.W, attempt=attempt)
                 time.sleep(self.backoff_s * (2 ** (attempt - 1)))
             try:
                 return self._exec_once(batch, lo, hi, Bp)
@@ -1069,7 +1131,7 @@ class BucketScheduler:
                 if c is None or c == "oom":
                     raise
                 if isinstance(e, CorruptOutput):
-                    self.stats["corrupt_chunks"] += 1
+                    self._inc("corrupt_chunks")
                 last = e
         raise _ChunkFailed(last)
 
@@ -1109,7 +1171,9 @@ class BucketScheduler:
         reason = f"{type(cause).__name__}: {cause}"
         self.quarantined[i] = reason
         self.row_provenance[i] = "host-fallback"
-        self.stats["quarantined_rows"] += 1
+        self._inc("quarantined_rows")
+        telemetry.event("scheduler.quarantine", row=int(i),
+                        reason=reason)
         log.warning("quarantining history %s after exhausting the "
                     "device ladder (%s); the host engine decides it", i,
                     reason)
@@ -1162,7 +1226,7 @@ class BucketScheduler:
                 except Exception as e:
                     if classify_failure(e) != "oom":
                         raise
-                    self.stats["oom_events"] += 1
+                    self._inc("oom_events")
                     oom = True
                     continue
             if Bp > BISECT_FLOOR_ROWS:
@@ -1171,7 +1235,9 @@ class BucketScheduler:
                 # chunks of the run start from it instead of
                 # rediscovering the wall.
                 Bp = max(BISECT_FLOOR_ROWS, Bp // 2)
-                self.stats["bisections"] += 1
+                self._inc("bisections")
+                telemetry.event("scheduler.bisection", V=batch.V,
+                                W=batch.W, rows_per_dispatch=Bp)
                 self._safe_bp[cls] = Bp
                 log.warning("OOM on chunk (V=%s, W=%s): bisecting to "
                             "%s rows/dispatch", batch.V, batch.W, Bp)
@@ -1191,9 +1257,12 @@ class BucketScheduler:
         tagged host-fallback)."""
         c = classify_failure(cause)
         if c == "oom":
-            self.stats["oom_events"] += 1
+            self._inc("oom_events")
         if isinstance(cause, CorruptOutput):
-            self.stats["corrupt_chunks"] += 1
+            self._inc("corrupt_chunks")
+        telemetry.event("scheduler.retry", V=batch.V, W=batch.W,
+                        rows=hi - lo,
+                        cause=type(cause).__name__)
         log.warning("chunk (V=%s, W=%s, rows %s:%s) failed in the "
                     "pipeline (%s: %s); entering the degradation "
                     "ladder", batch.V, batch.W, lo, hi,
@@ -1201,7 +1270,7 @@ class BucketScheduler:
         # The ladder's first synchronous pass re-dispatches work the
         # pipeline already shipped once: that IS a retry, whatever
         # happens after.
-        self.stats["retries"] += 1
+        self._inc("retries")
         out = self._exec_range(batch, lo, hi, Bp, first_cause=cause)
         for r in range(lo, hi):
             self.row_provenance.setdefault(batch.indices[r],
@@ -1243,7 +1312,9 @@ class BucketScheduler:
         try:
             r, err = q.get(timeout=deadline)
         except queue.Empty:
-            self.stats["watchdog_fired"] += 1
+            self._inc("watchdog_fired")
+            telemetry.event("scheduler.watchdog",
+                            members=len(members))
             rows = sum(hi - lo for _, lo, hi, _ in members)
             raise WatchdogExpired(
                 f"fused group ({len(members)} chunks, {rows} rows) "
@@ -1257,8 +1328,15 @@ class BucketScheduler:
         members, outs, delay = item
         t0 = time.monotonic()
         if isinstance(outs, BaseException):
+            # The dispatch itself already failed: there is no device
+            # work to wait on, so no device-category span — a phantom
+            # zero-length interval here would pollute the gap
+            # analyzer's device-busy union under fault injection.
             results, cause = None, outs
         else:
+            wait_sp = telemetry.span(
+                "device.wait", cat="device", members=len(members),
+                rows=sum(hi - lo for _, lo, hi, _ in members))
             try:
                 if len(members) == 1:
                     run, lo, hi, Bp = members[0]
@@ -1271,6 +1349,8 @@ class BucketScheduler:
                 if classify_failure(e) is None:
                     raise
                 results, cause = None, e
+            finally:
+                wait_sp.end()
         if results is None:
             # The group failed as a unit: every member walks the
             # degradation ladder individually — the resilience spine is
@@ -1278,7 +1358,7 @@ class BucketScheduler:
             results = [self._recover(run.batch, lo, hi, Bp, cause)
                        for run, lo, hi, Bp in members]
         wait = time.monotonic() - t0
-        self.stats["device_wait_s"] += wait
+        self._inc("device_wait_s", wait)
         self._last_retire_t = time.monotonic()
         if self.stats["t_first_verdict_s"] is None:
             self.stats["t_first_verdict_s"] = round(
@@ -1296,13 +1376,16 @@ class BucketScheduler:
         last: Optional[BaseException] = None
         for attempt in range(self.max_retries + 1):
             if attempt:
-                self.stats["retries"] += 1
+                self._inc("retries")
                 time.sleep(self.backoff_s * (2 ** (attempt - 1)))
             try:
                 # One XLA call per attempt — the wide/frontier routes
                 # count toward dispatch economics like any other ship.
-                self.stats["dispatches"] += 1
-                out = run_encoded_batch(mb, self.return_frontier)
+                self._inc("dispatches")
+                with telemetry.span("dispatch", cat="device",
+                                    route="wide", V=mb.V, W=mb.W,
+                                    rows=mb.batch):
+                    out = run_encoded_batch(mb, self.return_frontier)
                 if attempt:
                     for i in mb.indices:
                         self.row_provenance.setdefault(i, "device-retried")
@@ -1313,7 +1396,7 @@ class BucketScheduler:
                 if classify_failure(e) is None:
                     raise
                 last = e
-        self.stats["abandoned_buckets"] += 1
+        self._inc("abandoned_buckets")
         for i in mb.indices:
             self.row_provenance[i] = "host-fallback"
         log.warning("wide bucket (V=%s, W=%s, %s rows) abandoned after "
@@ -1367,6 +1450,15 @@ class BucketScheduler:
         return self._drive(source)
 
     def _drive(self, source):
+        run_sp = telemetry.begin("scheduler.run")
+        try:
+            yield from self._drive_inner(source)
+        finally:
+            run_sp.set(chunks=self.stats["chunks"],
+                       dispatches=self.stats["dispatches"],
+                       rows=self.stats["rows"]).end()
+
+    def _drive_inner(self, source):
         self._t0 = time.monotonic()
         shapes0 = len(KERNEL_SHAPE_LOG)
         groups = ([list(source)]
@@ -1402,7 +1494,7 @@ class BucketScheduler:
             yield from yield_done()
 
         def feed(mb: EncodedBatch):
-            self.stats["rows"] += mb.batch
+            self._inc("rows", mb.batch)
             mesh = production_mesh(1)
             wide = mb.W > DATA_MAX_SLOTS
             if (mb.W >= DATA_MAX_SLOTS
@@ -1410,10 +1502,10 @@ class BucketScheduler:
                 yield mb, DIVERTED
                 return
             ev = int((mb.ev_type != 0).sum())        # != EV_PAD
-            self.stats["events"] += ev
-            self.stats["orig_events"] += (
-                int(mb.orig_n_events.sum())
-                if mb.orig_n_events is not None else ev)
+            self._inc("events", ev)
+            self._inc("orig_events",
+                      int(mb.orig_n_events.sum())
+                      if mb.orig_n_events is not None else ev)
             shard = mesh is not None and mb.batch >= (
                 mesh.shape["data"] * MIN_ROWS_PER_DEVICE
                 if self.shard_min_rows is None else self.shard_min_rows)
@@ -1469,9 +1561,9 @@ class BucketScheduler:
                 group = next(it)
             except StopIteration:
                 break
-            self.stats["encode_busy_s"] += time.monotonic() - te
+            self._inc("encode_busy_s", time.monotonic() - te)
             group = [b for b in group if b.batch]
-            self.stats["input_buckets"] += len(group)
+            self._inc("input_buckets", len(group))
             if class_map is None and group:
                 # Freeze on the first NON-empty group: an all-failures
                 # prefix must not freeze an empty plan and silently
@@ -1634,6 +1726,8 @@ class GraphScheduler:
         self.row_provenance: Dict[int, str] = {}
         self._safe_bp: Dict[int, int] = {}
         self._awaited_shapes: set = set()
+        self._stats_lock = threading.Lock()
+        self._mirrors: dict = {}       # key -> registry counter handle
         self.stats: dict = {
             "graphs": 0, "buckets": 0, "chunks": 0,
             "closure_matmuls": 0, "mxu_macs": 0.0, "wall_s": None,
@@ -1641,6 +1735,9 @@ class GraphScheduler:
             "oom_events": 0, "corrupt_chunks": 0, "quarantined_rows": 0,
             "faults_injected": 0,
         }
+
+    def _inc(self, key: str, n=1) -> None:
+        _stat_inc(self, "graph", key, n)
 
     # ------------------------------------------------------------ plumbing
     def _deadline(self, b, rows: int) -> float:
@@ -1660,19 +1757,22 @@ class GraphScheduler:
         ladder re-dispatch: fault hooks, zero-pad to Bp rows (padding
         graphs are edgeless, never cyclic), async kernel launch."""
         from .graph import graph_kernel, mxu_op_model
-        if self.faults is not None:
-            self.faults.fire("encode")
         nb = hi - lo
-        adj = np.zeros((Bp,) + b.adj.shape[1:], np.uint32)
-        adj[:nb] = b.adj[lo:hi]
+        with telemetry.span("encode", family="graph", V=b.V, rows=nb):
+            if self.faults is not None:
+                self.faults.fire("encode")
+            adj = np.zeros((Bp,) + b.adj.shape[1:], np.uint32)
+            adj[:nb] = b.adj[lo:hi]
         delay = 0.0
         if self.faults is not None:
             delay = self.faults.sleep_for(self.faults.fire("dispatch"))
-        out = graph_kernel(b.V)(adj)
+        with telemetry.span("dispatch", cat="device", family="graph",
+                            V=b.V, rows=nb):
+            out = graph_kernel(b.V)(adj)
         m = mxu_op_model(b.V)
-        self.stats["chunks"] += 1
-        self.stats["closure_matmuls"] += Bp * int(m["matmuls"])
-        self.stats["mxu_macs"] += Bp * m["macs"]
+        self._inc("chunks")
+        self._inc("closure_matmuls", Bp * int(m["matmuls"]))
+        self._inc("mxu_macs", Bp * m["macs"])
         return out, delay
 
     def _await(self, out, nb: int, b, deadline: float,
@@ -1689,18 +1789,20 @@ class GraphScheduler:
             try:
                 if delay:
                     time.sleep(delay)
-                kind = None
-                if self.faults is not None:
-                    kind = self.faults.fire("decode")
-                    s = self.faults.sleep_for(kind)
-                    if s:
-                        time.sleep(s)
-                cyc, node = out
-                c = np.asarray(cyc)[:nb]
-                nd = np.asarray(node)[:nb]
-                if kind == "corrupt":
-                    c, nd = corrupt_arrays(c, nd)
-                validate_graph_decoded(c, nd, b.V)
+                with telemetry.span("decode", family="graph", V=b.V,
+                                    rows=nb):
+                    kind = None
+                    if self.faults is not None:
+                        kind = self.faults.fire("decode")
+                        s = self.faults.sleep_for(kind)
+                        if s:
+                            time.sleep(s)
+                    cyc, node = out
+                    c = np.asarray(cyc)[:nb]
+                    nd = np.asarray(node)[:nb]
+                    if kind == "corrupt":
+                        c, nd = corrupt_arrays(c, nd)
+                    validate_graph_decoded(c, nd, b.V)
                 q.put(((c, nd), None))
             except BaseException as e:   # noqa: BLE001 — relayed below
                 q.put((None, e))
@@ -1710,7 +1812,9 @@ class GraphScheduler:
         try:
             r, err = q.get(timeout=deadline)
         except queue.Empty:
-            self.stats["watchdog_fired"] += 1
+            self._inc("watchdog_fired")
+            telemetry.event("scheduler.watchdog", family="graph",
+                            V=b.V, rows=nb)
             raise WatchdogExpired(
                 f"graph chunk (V={b.V}, rows={nb}) exceeded its "
                 f"{deadline:.2f}s decode deadline") from None
@@ -1732,7 +1836,9 @@ class GraphScheduler:
         last: Optional[BaseException] = None
         for attempt in range(self.max_retries + 1):
             if attempt:
-                self.stats["retries"] += 1
+                self._inc("retries")
+                telemetry.event("scheduler.retry", family="graph",
+                                V=b.V, attempt=attempt)
                 time.sleep(self.backoff_s * (2 ** (attempt - 1)))
             try:
                 return self._exec_once(b, lo, hi, Bp)
@@ -1741,7 +1847,7 @@ class GraphScheduler:
                 if c is None or c == "oom":
                     raise
                 if isinstance(e, CorruptOutput):
-                    self.stats["corrupt_chunks"] += 1
+                    self._inc("corrupt_chunks")
                 last = e
         raise _ChunkFailed(last)
 
@@ -1755,7 +1861,9 @@ class GraphScheduler:
         reason = f"{type(cause).__name__}: {cause}"
         self.quarantined[i] = reason
         self.row_provenance[i] = "host-fallback"
-        self.stats["quarantined_rows"] += 1
+        self._inc("quarantined_rows")
+        telemetry.event("scheduler.quarantine", family="graph",
+                        row=int(i), reason=reason)
         log.warning("quarantining graph %s after exhausting the device "
                     "ladder (%s); the host DFS oracle decides it", i,
                     reason)
@@ -1800,12 +1908,14 @@ class GraphScheduler:
                 except Exception as e:
                     if classify_failure(e) != "oom":
                         raise
-                    self.stats["oom_events"] += 1
+                    self._inc("oom_events")
                     oom = True
                     continue
             if Bp > 1:
                 Bp = max(1, Bp // 2)
-                self.stats["bisections"] += 1
+                self._inc("bisections")
+                telemetry.event("scheduler.bisection", family="graph",
+                                V=b.V, rows_per_dispatch=Bp)
                 self._safe_bp[b.V] = Bp
                 log.warning("OOM on graph chunk (V=%s): bisecting to %s "
                             "rows/dispatch", b.V, Bp)
@@ -1817,13 +1927,15 @@ class GraphScheduler:
                  cause: BaseException):
         c = classify_failure(cause)
         if c == "oom":
-            self.stats["oom_events"] += 1
+            self._inc("oom_events")
         if isinstance(cause, CorruptOutput):
-            self.stats["corrupt_chunks"] += 1
+            self._inc("corrupt_chunks")
+        telemetry.event("scheduler.retry", family="graph", V=b.V,
+                        rows=hi - lo, cause=type(cause).__name__)
         log.warning("graph chunk (V=%s, rows %s:%s) failed (%s: %s); "
                     "entering the degradation ladder", b.V, lo, hi,
                     type(cause).__name__, cause)
-        self.stats["retries"] += 1
+        self._inc("retries")
         out = self._exec_range(b, lo, hi, Bp, first_cause=cause)
         for r in range(lo, hi):
             self.row_provenance.setdefault(b.indices[r],
@@ -1838,8 +1950,8 @@ class GraphScheduler:
         for b in buckets:
             if not b.batch:
                 continue
-            self.stats["buckets"] += 1
-            self.stats["graphs"] += b.batch
+            self._inc("buckets")
+            self._inc("graphs", b.batch)
             pieces = []
             for lo in range(0, b.batch, self.chunk_rows):
                 hi = min(lo + self.chunk_rows, b.batch)

@@ -810,7 +810,17 @@ def synthesize(spec: SynthSpec, synth: str = "device", *, rows=None,
     ``(ColumnarOps, SynthMeta-or-None)`` for cas/wide, ``(LaBatch,
     None)`` for la under the device family (host la returns Op
     lists)."""
+    from .. import telemetry
     assert synth in ("device", "numpy", "host"), synth
+    with telemetry.span("synth.generate", family=spec.family,
+                        backend=synth,
+                        rows=(rows[1] - rows[0]) if rows is not None
+                        else spec.n):
+        return _synthesize_impl(spec, synth, rows=rows,
+                                key_meta=key_meta)
+
+
+def _synthesize_impl(spec: SynthSpec, synth: str, *, rows, key_meta):
     if synth in ("device", "numpy"):
         if spec.family == "cas":
             return synth_cas_device(spec, rows=rows, backend=synth,
@@ -892,6 +902,9 @@ def synth_cas_neighbors(spec: SynthSpec,
     The generator batch pads to a power of two and slices back, so a
     long fuzz campaign's varying witness counts reuse a handful of
     compiled shapes instead of recompiling per round."""
+    from .. import telemetry
+    telemetry.event("synth.neighbors", n=len(neighbors),
+                    backend=backend)
     keys, lo, hi = neighbor_keys(spec, neighbors)
     R = len(neighbors)
     Rp = 1 << max(R - 1, 1).bit_length()

@@ -34,6 +34,7 @@ from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional
 
 from . import gen as generator
+from . import telemetry
 from .checkers.core import check_safe
 from .client import Client
 from .history.core import History
@@ -59,6 +60,7 @@ def _bump(test: dict, key: str, n: int = 1) -> None:
         return
     with _COUNTER_LOCK:
         res[key] = res.get(key, 0) + n
+    telemetry.REGISTRY.counter(f"run.{key}").inc(n)
 
 
 # The process-wide run-fault nemesis ($JT_RUN_FAULT) — one injector so
@@ -149,6 +151,8 @@ class DeadlineBarrier:
         if self.counters is not None and n:
             with _COUNTER_LOCK:
                 self.counters[key] = self.counters.get(key, 0) + n
+            telemetry.REGISTRY.counter(f"run.{key}").inc(n)
+            telemetry.event(f"run.{key}", n=n)
 
 
 def synchronize(test: dict) -> None:
@@ -463,27 +467,37 @@ def run(test: dict, analyze: bool = True) -> dict:
     test["wal"] = wal
 
     from contextlib import ExitStack
+    run_sp = telemetry.begin("run.lifecycle",
+                             name=test.get("name", "noname"),
+                             seed=test.get("seed"))
     try:
         with ExitStack() as stack:
             if test.get("ssh") is not None:
                 from .control.core import with_ssh
                 stack.enter_context(with_ssh(test))
             try:
-                if os_ is not None:
-                    _on_nodes_local(test, os_.setup)
+                with telemetry.span("run.setup",
+                                    seed=test.get("seed")):
+                    if os_ is not None:
+                        _on_nodes_local(test, os_.setup)
                 try:
                     if db is not None:
-                        _on_nodes_local(test, db.cycle)
-                        if hasattr(db, "setup_primary") and nodes:
-                            db.setup_primary(test, primary(test))
+                        with telemetry.span("run.db_cycle"):
+                            _on_nodes_local(test, db.cycle)
+                            if hasattr(db, "setup_primary") and nodes:
+                                db.setup_primary(test, primary(test))
                     test["clock"] = Relatime()
                     if wal is not None:
                         wal.stamp_phase("run")
-                    history = run_case(test)
+                    with telemetry.span("run.case",
+                                        seed=test.get("seed")):
+                        history = run_case(test)
                     test["history"] = history
                     if store is not None:
-                        store.save_history(history,
-                                           model=test.get("model"))
+                        with telemetry.span("run.save_history",
+                                            ops=len(history)):
+                            store.save_history(history,
+                                               model=test.get("model"))
                     if wal is not None:
                         wal.stamp_phase("teardown")
                 except BaseException:
@@ -491,7 +505,8 @@ def run(test: dict, analyze: bool = True) -> dict:
                     raise
                 finally:
                     if db is not None:
-                        _on_nodes_local(test, db.teardown)
+                        with telemetry.span("run.teardown"):
+                            _on_nodes_local(test, db.teardown)
             finally:
                 if os_ is not None:
                     _on_nodes_local(test, os_.teardown)
@@ -512,7 +527,9 @@ def run(test: dict, analyze: bool = True) -> dict:
                 pass
         if wal is not None:
             wal.close()
+        run_sp.set(error=type(e).__name__).end()
         raise
+    run_sp.end()
 
     if not analyze:
         return test
@@ -526,8 +543,10 @@ def analyze_run(test: dict) -> dict:
     Completing it stamps the WAL ``analyzed`` — the run is no longer
     salvageable because there is nothing left to lose."""
     store = test.get("store_handle")
-    results = check_safe(test.get("checker"), test,
-                         test.get("model"), test["history"])
+    with telemetry.span("run.analyze", seed=test.get("seed"),
+                        ops=len(test.get("history") or ())):
+        results = check_safe(test.get("checker"), test,
+                             test.get("model"), test["history"])
     if test.get("resilience") and any(test["resilience"].values()):
         results.setdefault("resilience", dict(test["resilience"]))
     test["results"] = results
@@ -693,6 +712,14 @@ def run_seeds(builder: Callable[[int], dict], seeds,
                 if state is not None:
                     re = _rehydrate_seed(t, s, state, root, ckpt)
                     if re is not None:
+                        telemetry.event(
+                            "campaign.resume", seed=int(s),
+                            salvaged=not state["done"])
+                        # A rehydrated seed ran no fresh cluster work:
+                        # freeze its (empty) delta so its deferred
+                        # save_results doesn't claim later seeds'
+                        # traffic.
+                        re["store_handle"].freeze_telemetry()
                         handles.append(re["store_handle"])
                         tests.append(re)
                         continue
@@ -705,13 +732,18 @@ def run_seeds(builder: Callable[[int], dict], seeds,
             if h is not None:
                 handles.append(h)
             try:
-                tests.append(run(t, analyze=False))
+                with telemetry.span("campaign.seed", seed=int(s)):
+                    tests.append(run(t, analyze=False))
             finally:
                 # Detach THIS run's handler as soon as its execution
                 # completes — handlers stack on the root logger, so
                 # leaving it attached would duplicate every later
-                # seed's lines into this run's run.log.
+                # seed's lines into this run's run.log. The telemetry
+                # delta freezes here too: save_results runs only after
+                # the whole campaign, and seed k's block must not
+                # absorb seeds k+1..N's traffic.
                 if h is not None:
+                    h.freeze_telemetry()
                     h.stop_logging()
             if ckpt is not None:
                 ckpt.done(int(s))
@@ -740,7 +772,9 @@ def run_seeds(builder: Callable[[int], dict], seeds,
             # from what each run's checker would have computed itself
             # (per-key artifacts included) — pooling changes the
             # dispatch count, never the outputs.
-            rs = check_batch_columnar(model, units, details=True)
+            with telemetry.span("campaign.pooled_check",
+                                units=len(units), seeds=len(tests)):
+                rs = check_batch_columnar(model, units, details=True)
             pool.results = dict(zip(labels, rs))
             log.info("Pooled linearizability dispatch: %d units across "
                      "%d seeded runs", len(units), len(tests))
@@ -823,6 +857,8 @@ def run_synth_seeds(spec, seeds, *, synth: str = "device", model=None,
                 try:
                     summ = _json.loads(summary_path.read_text())
                     summ["resumed"] = True
+                    telemetry.event("campaign.resume", seed=s,
+                                    synth=True)
                     out["seeds"][str(s)] = summ
                     out["invalid"] += summ["invalid"]
                     continue
@@ -837,9 +873,11 @@ def run_synth_seeds(spec, seeds, *, synth: str = "device", model=None,
                     {"spec": spec_digest(sspec, synth=synth)},
                     resume=state is not None or resume)
             try:
-                valid, bad = check_synth(model, sspec, synth=synth,
-                                         journal=journal,
-                                         **(check_kwargs or {}))
+                with telemetry.span("campaign.seed", seed=s,
+                                    synth=True):
+                    valid, bad = check_synth(model, sspec, synth=synth,
+                                             journal=journal,
+                                             **(check_kwargs or {}))
             finally:
                 if journal is not None:
                     journal.close()

@@ -29,6 +29,7 @@ import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence
 
+from . import telemetry
 from .history.codec import read_jsonl, write_jsonl, write_txt
 from .history.ops import Op
 from .history.wal import WAL_FILE
@@ -83,6 +84,23 @@ class StoreHandle:
         self.store = store
         self.test_name = test_name
         self._log_handler: Optional[logging.Handler] = None
+        # Telemetry baseline: the registry is process-cumulative, so
+        # save_results reports this RUN's counter deltas, not every
+        # earlier run's traffic re-counted (handles are created at run
+        # start — Store.create / salvage / rehydrate all come through
+        # here).
+        self._tel_base = telemetry.snapshot()
+        self._tel_frozen: Optional[dict] = None
+
+    def freeze_telemetry(self) -> None:
+        """Capture this run's counter delta NOW. Pooled campaigns
+        (runtime.run_seeds) defer every seed's save_results until
+        after the whole campaign plus the shared pooled dispatch;
+        freezing at the seed's execution boundary keeps seed k's
+        results.json block from absorbing seeds k+1..N's traffic (the
+        baseline alone only protects the backward direction)."""
+        self._tel_frozen = telemetry.counters_delta(
+            self._tel_base, telemetry.snapshot())
 
     # ---------------------------------------------------------- paths
     def path(self, *parts: str) -> str:
@@ -171,7 +189,25 @@ class StoreHandle:
 
     def save_results(self, results: dict) -> None:
         """Phase 2: analysis output (save-2!, store.clj:292-302).
-        Completing phase 2 is what promotes this run to ``latest``."""
+        Completing phase 2 is what promotes this run to ``latest``.
+
+        The process-wide telemetry snapshot (scheduler/AOT/WAL/run
+        counters — jepsen_tpu.telemetry) merges in as one canonical
+        ``telemetry`` block when non-empty, tagged with its
+        ``source``: ``salvaged`` for runs reconstructed from a crashed
+        WAL (salvage.json present), else ``live`` — so crashed-run
+        verdicts stay distinguishable downstream. Counters are deltas
+        since this handle was created (the registry is
+        process-cumulative; a campaign's seed N must not re-report
+        seeds 0..N-1's traffic as its own), histograms stay cumulative
+        distributions. A caller-provided block wins untouched."""
+        snap = self._tel_frozen if self._tel_frozen is not None \
+            else telemetry.counters_delta(self._tel_base,
+                                          telemetry.snapshot())
+        if snap and "telemetry" not in results:
+            src = "salvaged" if (self.dir / "salvage.json").exists() \
+                else "live"
+            results["telemetry"] = {"source": src, **snap}
         self.write_json("results.json", results)
         if self.store is not None and self.test_name is not None:
             self.store.update_symlinks(self.test_name, self.dir)
@@ -467,7 +503,21 @@ class Store:
                 lambda journal: check_batch_columnar(
                     model, units, details="invalid", journal=journal,
                     faults=faults))
-        return group_unit_results(labels, rs)
+        out = group_unit_results(labels, rs)
+        self._tag_recheck(out, test_name, ts)
+        return out
+
+    def _tag_recheck(self, out: dict, test_name: str, ts) -> None:
+        """Stamp a recheck result with its telemetry source: verdicts
+        here came from REPLAY, not a live run, and runs reconstructed
+        from a crashed WAL (salvage.json present) are named — the
+        downstream distinguishability contract."""
+        salvaged = [t for t in ts
+                    if (self.run_dir(test_name, t)
+                        / "salvage.json").exists()]
+        out["telemetry"] = {"source": "recheck",
+                            **({"salvaged_runs": salvaged}
+                               if salvaged else {})}
 
     def _journaled_recheck(self, test_name: str, header: dict,
                            resume: bool, labels, call):
@@ -499,6 +549,8 @@ class Store:
         out = group_unit_results(labels, rs)
         if resume:
             out["resume_hits"] = resume_hits
+        self._tag_recheck(out, test_name,
+                          sorted({t for t, _ in labels}))
         return out
 
     def _load_machine_forms(self, test_name: str, ts, model):
@@ -719,15 +771,17 @@ class ChunkJournal:
             raise ValueError(
                 f"chunk journal: rows decided twice (double dispatch): "
                 f"{dup[:5]}")
-        valid = [bool(v) for v in valid]
-        bad = [None if b is None else int(b) for b in bad]
-        prov = [str(p) for p in prov]
-        for r, v, b, p in zip(rows, valid, bad, prov):
-            self._decided[r] = (v, b, p)
-        self._f.write(json.dumps(
-            {"rows": rows, "valid": valid, "bad": bad, "prov": prov})
-            + "\n")
-        self._flush()
+        with telemetry.span("journal", rows=len(rows)):
+            valid = [bool(v) for v in valid]
+            bad = [None if b is None else int(b) for b in bad]
+            prov = [str(p) for p in prov]
+            for r, v, b, p in zip(rows, valid, bad, prov):
+                self._decided[r] = (v, b, p)
+            self._f.write(json.dumps(
+                {"rows": rows, "valid": valid, "bad": bad,
+                 "prov": prov}) + "\n")
+            self._flush()
+        telemetry.REGISTRY.counter("journal.rows").inc(len(rows))
 
     def close(self) -> None:
         try:

@@ -1,0 +1,628 @@
+"""Pipeline telemetry spine: span tracing + the unified metrics registry.
+
+The r05 roofline said the checker is dispatch/latency-bound (`hbm_util`
+0.0018) — yet nothing could *show* where wall-clock goes between XLA
+calls: timing lived in ad-hoc per-subsystem stats dicts
+(``BucketScheduler.stats``, ``AOT_STATS``, WAL flush deques, run
+resilience counters) that never composed into one picture. Following
+the trace-level-observability argument of OmniLink (arXiv:2601.11836)
+— live validation of an unmodified system needs its traces — this
+module is the one spine every stage reports through:
+
+  * **span tracer** — a process-wide, thread-local span stack. A span
+    is an interval with a name, a category (``"device"`` for dispatch
+    launches and device waits, ``"host"`` for everything else), and
+    attributes (W class, rows, chunk ordinal, fuse-group id,
+    provenance...). Completed spans land in a monotonic-clock
+    ring-buffer flight recorder (bounded; the newest ``ring`` spans
+    survive) and, when a sink path is configured, append to a JSONL
+    trace file. ``export_chrome`` writes the standard Chrome-trace /
+    Perfetto ``trace.json`` (load it at chrome://tracing or
+    ui.perfetto.dev). Instant ``event``s record point occurrences
+    (retries, bisections, quarantines, campaign resumes).
+
+  * **metrics registry** — counters / gauges / histograms with labels,
+    lock-protected, snapshot-to-dict (``REGISTRY``). The registry is
+    ALWAYS on (increments are a dict bump under a lock — the
+    scattered per-subsystem counters it replaces cost the same without
+    the thread safety); only the span tracer is gated.
+
+  * **dispatch-gap analyzer** (``gaps``) — the direct diagnostic for
+    the 1.9k/s plateau: over a window of recorded spans, the union of
+    ``"device"``-category intervals is the device-active time; the
+    complement is host gap, and each gap is attributed to the host
+    spans overlapping it. The bench's ``telemetry`` section reports
+    the fractions and the top gap causes.
+
+Enabling: ``JT_TRACE=1`` turns the tracer on (flight recorder only);
+``JT_TRACE=<path>`` additionally streams every record to ``<path>`` as
+JSONL (the ``jepsen-tpu trace`` subcommand summarizes/exports such a
+file). Unset or ``0``: every ``span()``/``event()`` call is a no-op
+returning a shared singleton — no Span object, no record, nothing
+retained — so the instrumented hot paths cost one predicate each.
+``JT_TRACE_RING`` sizes the flight recorder (default 65536 spans).
+
+Metric naming scheme (doc/observability.md): dotted
+``subsystem.metric`` names plus sorted ``{label=value}`` suffixes —
+``scheduler.retries{family=wgl}``, ``aot.hits``, ``wal.flush_ms``
+(histogram), ``run.barrier_timeouts``, ``journal.rows``. Snapshots are
+deterministic: keys sort, floats round, and two snapshots of the same
+state compare equal — ``store.save_results`` merges one canonical
+``telemetry`` block into ``results.json`` from it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence
+
+# ------------------------------------------------------------- config
+
+_CONF_LOCK = threading.Lock()
+_ENABLED = False
+_SINK_PATH: Optional[str] = None
+_SINK = None                    # open file handle (lazy)
+_RING: deque = deque(maxlen=65536)
+_CONFIGURED = False
+
+# Trace epoch: all timestamps are monotonic ns relative to this, so
+# records from one process compose and export without clock skew.
+_EPOCH_NS = time.monotonic_ns()
+
+_TLS = threading.local()
+_IDS = iter(range(1, 1 << 62)).__next__
+_ID_LOCK = threading.Lock()
+
+
+def _next_id() -> int:
+    with _ID_LOCK:
+        return _IDS()
+
+
+def _ring_size() -> int:
+    try:
+        return max(16, int(os.environ.get("JT_TRACE_RING", "65536")))
+    except ValueError:
+        return 65536
+
+
+def configure(trace=None, ring: Optional[int] = None) -> None:
+    """(Re)configure the tracer. ``trace``: True (recorder only), a
+    path (recorder + JSONL sink), False/None/"0" (off), or "env" to
+    re-read $JT_TRACE. Reconfiguring swaps in a fresh ring buffer and
+    closes any open sink — the test/bench seam."""
+    global _ENABLED, _SINK_PATH, _SINK, _RING, _CONFIGURED
+    with _CONF_LOCK:
+        if trace == "env":
+            trace = os.environ.get("JT_TRACE")
+            if trace in (None, "", "0"):
+                trace = False
+            elif trace == "1":
+                trace = True
+        if _SINK is not None:
+            try:
+                _SINK.close()
+            except Exception:
+                pass
+            _SINK = None
+        _SINK_PATH = None
+        if trace in (None, False, "", "0"):
+            _ENABLED = False
+        elif trace is True or trace == "1":
+            _ENABLED = True
+        else:
+            _ENABLED = True
+            _SINK_PATH = str(trace)
+        _RING = deque(maxlen=_ring_size() if ring is None else max(16,
+                                                                   ring))
+        _CONFIGURED = True
+
+
+def _ensure_config() -> None:
+    if not _CONFIGURED:
+        configure("env")
+
+
+def enabled() -> bool:
+    """Is the span tracer on? The one predicate the instrumented hot
+    paths pay when tracing is off."""
+    _ensure_config()
+    return _ENABLED
+
+
+def _emit(rec: dict) -> None:
+    """Record one completed span/event: ring buffer always, sink when
+    configured. Sink writes are whole-line appends under the config
+    lock — records from retire/prewarm threads never interleave."""
+    global _SINK
+    _RING.append(rec)
+    if _SINK_PATH is None:
+        return
+    with _CONF_LOCK:
+        try:
+            if _SINK is None:
+                _SINK = open(_SINK_PATH, "a")
+            _SINK.write(json.dumps(rec, default=str) + "\n")
+            _SINK.flush()
+        except Exception:
+            pass                 # tracing is diagnostics, never a fault
+
+
+def flush() -> None:
+    """Flush/close the JSONL sink (idempotent; reopens on next emit)."""
+    global _SINK
+    with _CONF_LOCK:
+        if _SINK is not None:
+            try:
+                _SINK.close()
+            except Exception:
+                pass
+            _SINK = None
+
+
+# --------------------------------------------------------------- spans
+
+class Span:
+    """One in-flight interval. Created by ``begin``/``span``; ``end``
+    completes it and emits the record. Attribute updates before end
+    ride ``set(**attrs)`` (e.g. a count only known at the end)."""
+
+    __slots__ = ("name", "cat", "t0", "attrs", "sid", "parent", "_done")
+
+    def __init__(self, name: str, cat: str, attrs: Optional[dict],
+                 parent: Optional[int]):
+        self.name = name
+        self.cat = cat
+        self.t0 = time.monotonic_ns()
+        self.attrs = attrs
+        self.sid = _next_id()
+        self.parent = parent
+        self._done = False
+
+    def set(self, **attrs) -> "Span":
+        if self.attrs is None:
+            self.attrs = attrs
+        else:
+            self.attrs.update(attrs)
+        return self
+
+    def end(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        t1 = time.monotonic_ns()
+        stack = getattr(_TLS, "stack", None)
+        if stack and stack[-1] is self:
+            stack.pop()
+        t = threading.current_thread()
+        rec = {"ph": "X", "name": self.name, "cat": self.cat,
+               "ts": (self.t0 - _EPOCH_NS) / 1e3,
+               "dur": (t1 - self.t0) / 1e3,
+               "tid": t.ident, "tname": t.name,
+               "id": self.sid}
+        if self.parent is not None:
+            rec["parent"] = self.parent
+        if self.attrs:
+            rec["args"] = self.attrs
+        _emit(rec)
+
+    # context-manager protocol
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.end()
+
+
+class _NopSpan:
+    """The disabled-tracer singleton: every operation is a no-op, and
+    ``span()``/``begin()`` return THIS object — no allocation, no
+    record, no state. ``set`` discards its kwargs."""
+
+    __slots__ = ()
+
+    def set(self, **attrs) -> "_NopSpan":
+        return self
+
+    def end(self) -> None:
+        pass
+
+    def __enter__(self) -> "_NopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NOP = _NopSpan()
+
+
+def begin(name: str, /, cat: str = "host", **attrs):
+    """Open a span (pushes the thread-local stack); caller must
+    ``end()`` it. Use for intervals that outlive a lexical scope (a
+    generator's whole drive); ``span`` is the with-statement form."""
+    if not enabled():
+        return NOP
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    parent = stack[-1].sid if stack else None
+    sp = Span(name, cat, attrs or None, parent)
+    stack.append(sp)
+    return sp
+
+
+def span(name: str, /, cat: str = "host", **attrs):
+    """Context manager: ``with telemetry.span("encode", W=9): ...``.
+    Nested spans record their parent (the enclosing span on THIS
+    thread). When tracing is off, returns the shared no-op singleton."""
+    if not enabled():
+        return NOP
+    return begin(name, cat, **attrs)
+
+
+def event(name: str, /, cat: str = "event", **attrs) -> None:
+    """Instant occurrence (retry, bisection, quarantine, resume...)."""
+    if not enabled():
+        return
+    t = threading.current_thread()
+    rec = {"ph": "i", "name": name, "cat": cat,
+           "ts": (time.monotonic_ns() - _EPOCH_NS) / 1e3,
+           "tid": t.ident, "tname": t.name}
+    if attrs:
+        rec["args"] = attrs
+    _emit(rec)
+
+
+def spans() -> List[dict]:
+    """The flight recorder's current contents (oldest first)."""
+    _ensure_config()
+    return list(_RING)
+
+
+def reset() -> None:
+    """Drop recorded spans (keeps the enabled/sink configuration)."""
+    _ensure_config()
+    _RING.clear()
+
+
+# ------------------------------------------------------------- export
+
+def export_chrome(path, records: Optional[Sequence[dict]] = None) -> int:
+    """Write records (default: the flight recorder) as a Chrome-trace /
+    Perfetto ``trace.json``. Returns the number of trace events."""
+    recs = list(records) if records is not None else spans()
+    pid = os.getpid()
+    evs = []
+    tnames = {}
+    for r in recs:
+        ev = {"name": r.get("name", "?"), "cat": r.get("cat", "host"),
+              "ph": r.get("ph", "X"), "ts": r.get("ts", 0.0),
+              "pid": pid, "tid": r.get("tid", 0),
+              "args": r.get("args") or {}}
+        if r.get("ph", "X") == "X":
+            ev["dur"] = r.get("dur", 0.0)
+        else:
+            ev["s"] = "t"              # thread-scoped instant
+        evs.append(ev)
+        if r.get("tname") and r.get("tid") not in tnames:
+            tnames[r["tid"]] = r["tname"]
+    for tid, tname in tnames.items():
+        evs.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": tname}})
+    with open(path, "w") as f:
+        # default=str matches the JSONL sink's _emit: attrs may carry
+        # numpy scalars or other non-JSON-native values, and an export
+        # must degrade them to strings, never crash.
+        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f,
+                  default=str)
+    return len(evs)
+
+
+def read_trace(path) -> List[dict]:
+    """Load a JSONL trace file (the sink format), tolerating a torn
+    final line the way every other log reader here does."""
+    out: List[dict] = []
+    with open(path, "rb") as f:
+        for line in f:
+            if not line.endswith(b"\n"):
+                break
+            try:
+                out.append(json.loads(line))
+            except Exception:
+                break
+    return out
+
+
+def summarize(records: Optional[Sequence[dict]] = None) -> dict:
+    """Per-name span totals over a record set (default: the flight
+    recorder) — the ``jepsen-tpu trace`` summary body."""
+    recs = list(records) if records is not None else spans()
+    by: Dict[str, dict] = {}
+    n_spans = n_events = 0
+    for r in recs:
+        if r.get("ph") == "i":
+            n_events += 1
+            continue
+        n_spans += 1
+        d = by.setdefault(r.get("name", "?"),
+                          {"count": 0, "total_us": 0.0, "max_us": 0.0})
+        d["count"] += 1
+        dur = float(r.get("dur", 0.0))
+        d["total_us"] += dur
+        if dur > d["max_us"]:
+            d["max_us"] = dur
+    for d in by.values():
+        d["total_s"] = round(d.pop("total_us") / 1e6, 6)
+        d["max_us"] = round(d["max_us"], 1)
+        d["mean_us"] = round(d["total_s"] * 1e6 / max(d["count"], 1), 1)
+    return {"spans": n_spans, "events": n_events,
+            "by_name": {k: by[k] for k in sorted(by)}}
+
+
+# ------------------------------------------------- dispatch-gap report
+
+def gaps(records: Optional[Sequence[dict]] = None, *,
+         top: int = 8) -> dict:
+    """Device-busy vs host-gap breakdown — the plateau diagnostic.
+
+    Over the window spanned by ``"device"``-category spans (dispatch
+    launches + device waits — the honest proxy for device activity
+    this side of a hardware profiler), the union of those intervals is
+    device-busy time; the complement is host gap. Each gap interval is
+    attributed to the LEAF host spans overlapping it: wrapper spans
+    that fully contain a device interval (``scheduler.run``,
+    ``campaign.seed``, ``run.case``...) are excluded — they enclose
+    every gap by construction and would always top the ranking while
+    naming nothing actionable. Time no leaf span covers is
+    ``(untraced)``. Returns fractions, gap count, and the top causes
+    by attributed seconds."""
+    recs = list(records) if records is not None else spans()
+    dev = []
+    host = []
+    for r in recs:
+        if r.get("ph") != "X":
+            continue
+        t0 = float(r.get("ts", 0.0))
+        t1 = t0 + float(r.get("dur", 0.0))
+        if r.get("cat") == "device":
+            dev.append((t0, t1))
+        else:
+            host.append((t0, t1, r.get("name", "?")))
+    if not dev:
+        return {"window_s": 0.0, "device_busy_s": 0.0, "host_gap_s": 0.0,
+                "device_busy_frac": None, "host_gap_frac": None,
+                "n_gaps": 0, "top_gap_causes": []}
+    dev.sort()
+    merged = [list(dev[0])]
+    for t0, t1 in dev[1:]:
+        if t0 <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], t1)
+        else:
+            merged.append([t0, t1])
+    # Leaf filter by bisect against the merged device intervals (a
+    # full pairwise scan is O(hosts x devices) — minutes of CPU on a
+    # default-size ring): a host span is a wrapper iff the first
+    # merged interval starting at/after it also ends inside it.
+    import bisect
+    starts = [a for a, _ in merged]
+
+    def _wrapper(h0, h1):
+        i = bisect.bisect_left(starts, h0)
+        return i < len(merged) and merged[i][1] <= h1
+
+    host = [(h0, h1, name) for h0, h1, name in host
+            if not _wrapper(h0, h1)]
+    w0, w1 = merged[0][0], merged[-1][1]
+    window = w1 - w0
+    busy = sum(b - a for a, b in merged)
+    gap_ivs = [(merged[i][1], merged[i + 1][0])
+               for i in range(len(merged) - 1)]
+    # Attribution by one event sweep (near-linear): walk gap and host
+    # interval edges in time order; inside a gap, each time slice is
+    # charged once to every distinct active leaf-span name, or to
+    # ``(untraced)`` when none is active.
+    evs: List[tuple] = []
+    for a, b in gap_ivs:
+        evs.append((a, 1, "\x00gap"))
+        evs.append((b, 0, "\x00gap"))
+    for h0, h1, name in host:
+        evs.append((h0, 1, name))
+        evs.append((h1, 0, name))
+    evs.sort(key=lambda e: (e[0], e[1]))      # ends before starts
+    causes: Dict[str, float] = {}
+    active: Dict[str, int] = {}
+    in_gap = 0
+    gap_total = 0.0
+    last_t = evs[0][0] if evs else 0.0
+    for t, kind, name in evs:
+        dt = t - last_t
+        if dt > 0 and in_gap:
+            gap_total += dt
+            if active:
+                for n in active:
+                    causes[n] = causes.get(n, 0.0) + dt
+            else:
+                causes["(untraced)"] = \
+                    causes.get("(untraced)", 0.0) + dt
+        last_t = t
+        if name == "\x00gap":
+            in_gap += 1 if kind else -1
+        elif kind:
+            active[name] = active.get(name, 0) + 1
+        else:
+            if active.get(name, 0) <= 1:
+                active.pop(name, None)
+            else:
+                active[name] -= 1
+    order = sorted(causes.items(), key=lambda kv: -kv[1])[:top]
+    return {
+        "window_s": round(window / 1e6, 6),
+        "device_busy_s": round(busy / 1e6, 6),
+        "host_gap_s": round(gap_total / 1e6, 6),
+        "device_busy_frac": round(busy / window, 4) if window else None,
+        "host_gap_frac": round(gap_total / window, 4) if window else None,
+        "n_gaps": len(gap_ivs),
+        "top_gap_causes": [[name, round(s / 1e6, 6)]
+                           for name, s in order],
+    }
+
+
+# ---------------------------------------------------- metrics registry
+
+def _key(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class _Counter:
+    __slots__ = ("_reg", "_k")
+
+    def __init__(self, reg, k):
+        self._reg, self._k = reg, k
+
+    def inc(self, n=1) -> None:
+        with self._reg._lock:
+            self._reg._counters[self._k] = \
+                self._reg._counters.get(self._k, 0) + n
+
+
+class _Gauge:
+    __slots__ = ("_reg", "_k")
+
+    def __init__(self, reg, k):
+        self._reg, self._k = reg, k
+
+    def set(self, v) -> None:
+        with self._reg._lock:
+            self._reg._gauges[self._k] = v
+
+
+class _Histogram:
+    __slots__ = ("_reg", "_k")
+
+    RESERVOIR = 4096
+
+    def __init__(self, reg, k):
+        self._reg, self._k = reg, k
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with self._reg._lock:
+            h = self._reg._hists.get(self._k)
+            if h is None:
+                h = self._reg._hists[self._k] = {
+                    "count": 0, "sum": 0.0, "min": v, "max": v,
+                    "_res": deque(maxlen=self.RESERVOIR)}
+            h["count"] += 1
+            h["sum"] += v
+            if v < h["min"]:
+                h["min"] = v
+            if v > h["max"]:
+                h["max"] = v
+            h["_res"].append(v)
+
+
+class Registry:
+    """Lock-protected metrics store. Handles are cheap stateless views;
+    every mutation takes the one registry lock, so concurrent bucket
+    executor threads can't drop counts (the BucketScheduler.stats race
+    this replaces). ``snapshot()`` is deterministic: sorted keys,
+    rounded floats, plain JSON types."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, object] = {}
+        self._hists: Dict[str, dict] = {}
+
+    def counter(self, name: str, **labels) -> _Counter:
+        return _Counter(self, _key(name, labels))
+
+    def gauge(self, name: str, **labels) -> _Gauge:
+        return _Gauge(self, _key(name, labels))
+
+    def histogram(self, name: str, **labels) -> _Histogram:
+        return _Histogram(self, _key(name, labels))
+
+    def get(self, name: str, **labels):
+        k = _key(name, labels)
+        with self._lock:
+            if k in self._counters:
+                return self._counters[k]
+            if k in self._gauges:
+                return self._gauges[k]
+            h = self._hists.get(k)
+            return dict(h, _res=None) if h is not None else None
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+    def snapshot(self) -> dict:
+        """JSON-friendly deterministic state: {} when nothing was ever
+        recorded (the save_results merge-only-when-non-empty rule)."""
+        def _pct(xs: List[float], p: float):
+            if not xs:
+                return None
+            i = min(len(xs) - 1,
+                    max(0, int(round(p / 100.0 * len(xs) + 0.5)) - 1))
+            return round(xs[i], 6)
+
+        with self._lock:
+            out: dict = {}
+            if self._counters:
+                out["counters"] = {k: self._counters[k]
+                                   for k in sorted(self._counters)}
+            if self._gauges:
+                out["gauges"] = {k: self._gauges[k]
+                                 for k in sorted(self._gauges)}
+            if self._hists:
+                hs = {}
+                for k in sorted(self._hists):
+                    h = self._hists[k]
+                    xs = sorted(h["_res"])
+                    hs[k] = {"count": h["count"],
+                             "sum": round(h["sum"], 6),
+                             "min": round(h["min"], 6),
+                             "max": round(h["max"], 6),
+                             "p50": _pct(xs, 50), "p99": _pct(xs, 99)}
+                out["histograms"] = hs
+            return out
+
+
+REGISTRY = Registry()
+
+
+def snapshot() -> dict:
+    """The process-wide registry snapshot (store.save_results' source
+    for the results.json ``telemetry`` block)."""
+    return REGISTRY.snapshot()
+
+
+def counters_delta(base: Optional[dict], now: dict) -> dict:
+    """``now`` with its counters re-expressed as deltas over ``base``
+    (zero deltas dropped). The registry is process-cumulative; a
+    per-RUN results.json block must not re-report the previous runs'
+    traffic as this run's — StoreHandle captures ``base`` at create
+    time and save_results diffs against it. Gauges stay current-value;
+    histograms stay process-cumulative distributions (documented as
+    such — their p50/p99 describe latency, which doesn't double-count).
+    Returns {} when nothing beyond stale counters remains."""
+    out = dict(now)
+    b = (base or {}).get("counters") or {}
+    if "counters" in out:
+        c = {k: v - b.get(k, 0) for k, v in out["counters"].items()
+             if v - b.get(k, 0)}
+        if c:
+            out["counters"] = c
+        else:
+            del out["counters"]
+    return out

@@ -3,8 +3,12 @@
 Mirrors jepsen/src/jepsen/web.clj on the stdlib http.server: a test
 table with validity color coding (web.clj:47-128), a store-dir browser
 with text/image previews (130-229), zip export of a run (231-271), and
-the path-escape guard (273-278).
-"""
+the path-escape guard (273-278). On top of the reference: incomplete
+(crashed, pre-salvage) runs carry a distinct badge on the index — a
+campaign's crash is visible without shell access — and ``/live``
+renders the current process's telemetry snapshot plus per-run phase/op
+progress straight off each in-flight run's WAL (the live-introspection
+seam the always-on checking service will poll)."""
 from __future__ import annotations
 
 import html
@@ -17,6 +21,7 @@ from pathlib import Path
 from typing import Optional
 from urllib.parse import quote, unquote, urlparse
 
+from . import telemetry
 from .store import Store, DEFAULT
 
 TEXT_EXT = {".txt", ".json", ".jsonl", ".log", ".edn", ".html", ".c"}
@@ -29,6 +34,10 @@ td, th { padding: .3em .8em; border: 1px solid #ccc; text-align: left; }
 .valid-true  { background: #c3e6c3; }
 .valid-false { background: #f2b2b2; }
 .valid-unknown { background: #f5e6a9; }
+.valid-incomplete { background: #dfe7f5; }
+.badge { padding: .1em .5em; border-radius: .6em; font-size: .85em; }
+.badge-live { background: #2d7dd2; color: #fff; }
+.badge-crashed { background: #666; color: #fff; }
 a { text-decoration: none; }
 pre { background: #f7f7f7; padding: 1em; overflow-x: auto; }
 """
@@ -80,20 +89,52 @@ class Handler(BaseHTTPRequestHandler):
         path = unquote(url.path)
         if path == "/":
             return self.index()
+        if path == "/live":
+            return self.live()
         if path.startswith("/files/"):
             return self.files(path[len("/files/"):])
         if path.startswith("/zip/"):
             return self.zip(path[len("/zip/"):])
         self._send("not found", code=404, ctype="text/plain")
 
+    @staticmethod
+    def _writer_live(header) -> bool:
+        """Liveness for DISPLAY: writer_alive() excludes this process's
+        own pid (the salvage sweep must never treat its own runs as
+        salvageable), but a server riding inside a campaign process IS
+        the writer — its in-flight runs are live, not crashed."""
+        import os as _os
+
+        from .history.wal import writer_alive
+        if (header or {}).get("pid") == _os.getpid():
+            return True
+        return writer_alive(header)
+
+    def _incomplete_badge(self, name: str, ts: str) -> str:
+        """Distinct badge for a crashed/in-flight (pre-salvage) run:
+        ``live`` when the WAL's writer pid is still alive on this
+        host, ``crashed`` otherwise — the index answers "did my
+        campaign die?" without shell access."""
+        from .history.wal import WAL_FILE, wal_header
+        wal = self.store.run_dir(name, ts) / WAL_FILE
+        if self._writer_live(wal_header(wal)):
+            return ' <span class="badge badge-live">live</span>'
+        return ' <span class="badge badge-crashed">crashed</span>'
+
     def index(self):
+        incomplete = set(self.store.incomplete(include_salvaged=False))
         rows = []
         for name, runs in sorted(self.store.tests().items()):
             for ts in sorted(runs, reverse=True):
                 d = self.store.run_dir(name, ts)
                 v = _validity(d)
-                cls = {True: "valid-true", False: "valid-false"}.get(
-                    v, "valid-unknown")
+                badge = ""
+                if (name, ts) in incomplete:
+                    cls = "valid-incomplete"
+                    badge = self._incomplete_badge(name, ts)
+                else:
+                    cls = {True: "valid-true",
+                           False: "valid-false"}.get(v, "valid-unknown")
                 vtxt = {True: "valid", False: "INVALID"}.get(
                     v, "unknown" if v is not None else "—")
                 rel = f"{name}/{ts}"
@@ -102,11 +143,63 @@ class Handler(BaseHTTPRequestHandler):
                     f"<td>{html.escape(name)}</td>"
                     f'<td><a href="/files/{quote(rel)}/">'
                     f"{html.escape(ts)}</a></td>"
-                    f"<td>{vtxt}</td>"
+                    f"<td>{vtxt}{badge}</td>"
                     f'<td><a href="/zip/{quote(rel)}">zip</a></td></tr>')
-        table = ("<table><tr><th>test</th><th>run</th><th>valid?</th>"
+        table = ('<p><a href="/live">live view</a></p>'
+                 "<table><tr><th>test</th><th>run</th><th>valid?</th>"
                  "<th>export</th></tr>" + "".join(rows) + "</table>")
         self._page("Jepsen-TPU results", table)
+
+    def live(self):
+        """Live run introspection: per-seed phase/op progress off each
+        in-flight run's WAL, plus this process's telemetry registry
+        snapshot (meaningful when the server rides inside a campaign
+        process). Auto-refreshes."""
+        from .history.wal import WAL_FILE, wal_header, wal_progress
+        rows = []
+        for name, ts in self.store.incomplete(include_salvaged=True):
+            wal = self.store.run_dir(name, ts) / WAL_FILE
+            p = wal_progress(wal)
+            alive = self._writer_live(wal_header(wal))
+            badge = ('<span class="badge badge-live">live</span>'
+                     if alive else
+                     '<span class="badge badge-crashed">crashed</span>')
+            rel = f"{name}/{ts}"
+            rows.append(
+                "<tr>"
+                f"<td>{html.escape(name)}</td>"
+                f'<td><a href="/files/{quote(rel)}/">'
+                f"{html.escape(ts)}</a></td>"
+                f"<td>{badge}</td>"
+                f"<td>{html.escape(str((p or {}).get('phase', '?')))}"
+                f"</td>"
+                f"<td>{(p or {}).get('ops', '?')}</td>"
+                f"<td>{html.escape(str((p or {}).get('seed', '')))}"
+                f"</td></tr>")
+        runs_tbl = ("<h2>in-flight runs</h2>"
+                    "<table><tr><th>test</th><th>run</th><th>state</th>"
+                    "<th>phase</th><th>ops</th><th>seed</th></tr>"
+                    + "".join(rows) + "</table>"
+                    if rows else "<p>no in-flight runs</p>")
+        snap = telemetry.snapshot()
+        parts = []
+        for kind in ("counters", "gauges"):
+            for k, v in (snap.get(kind) or {}).items():
+                parts.append(f"<tr><td>{html.escape(k)}</td>"
+                             f"<td>{html.escape(str(v))}</td></tr>")
+        for k, h in (snap.get("histograms") or {}).items():
+            parts.append(
+                f"<tr><td>{html.escape(k)}</td>"
+                f"<td>n={h['count']} p50={h['p50']} p99={h['p99']}"
+                f"</td></tr>")
+        metrics_tbl = ("<h2>process metrics</h2>"
+                       "<table><tr><th>metric</th><th>value</th></tr>"
+                       + "".join(parts) + "</table>"
+                       if parts else
+                       "<p>no metrics recorded in this process</p>")
+        body = ('<meta http-equiv="refresh" content="2">'
+                '<p><a href="/">index</a></p>' + runs_tbl + metrics_tbl)
+        self._page("Jepsen-TPU live", body)
 
     def files(self, rel: str):
         p = self._resolve(rel.rstrip("/"))

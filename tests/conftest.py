@@ -27,6 +27,12 @@ os.environ.setdefault("JT_WAL_FLUSH_MS", "250")
 # itself pass ``overhead=`` explicitly.
 os.environ.setdefault("JT_DISPATCH_OVERHEAD_US", "0")
 
+# Tier-1 runs untraced: the span tracer stays a no-op unless a test
+# opts in explicitly (telemetry.configure) — tracing every suite run
+# would tax the whole gate to exercise one subsystem. The metrics
+# registry is always on (it replaced the unlocked stats dicts).
+os.environ.setdefault("JT_TRACE", "0")
+
 provision_in_process(8)
 
 
@@ -57,3 +63,9 @@ def pytest_configure(config):
                    "schedules, partition-metadata agreement, dispatch "
                    "budget, and fuzz kill-and-resume (deterministic; "
                    "runs in tier-1)")
+    config.addinivalue_line(
+        "markers", "telemetry: span tracer + metrics registry — "
+                   "nesting/attributes, ring wraparound, Chrome-trace "
+                   "export, snapshot determinism, no-op-when-off, and "
+                   "the traced-overhead gate (deterministic; runs in "
+                   "tier-1)")

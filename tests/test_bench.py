@@ -28,7 +28,10 @@ def test_bench_emits_contract_json():
                JT_WAL_FLUSH_MS="0",
                JT_BENCH_LONG_B="32", JT_BENCH_LONG_OPS="500",
                JT_BENCH_XLONG_B="6", JT_BENCH_XLONG_OPS="2000",
-               JT_BENCH_SYNTH_B="64")
+               JT_BENCH_SYNTH_B="64", JT_BENCH_TRACE_B="64",
+               # Tracing stays ambient-off: the section flips the
+               # flight recorder on for its own traced passes only.
+               JT_TRACE="0")
     r = subprocess.run([sys.executable, str(REPO / "bench.py")],
                        capture_output=True, text=True, env=env,
                        cwd=REPO, timeout=900)
@@ -116,3 +119,18 @@ def test_bench_emits_contract_json():
     # Per-section synth breakdown on the probes.
     assert d["long_history"]["long"]["synth_s"] >= 0
     assert d["xlong_history"]["synth_s"] >= 0
+    # Telemetry section (ISSUE 8 acceptance): the traced-overhead
+    # measurement, span coverage of the checked path, and the
+    # dispatch-gap (device-busy vs host-gap) breakdown.
+    tl = d["telemetry"]
+    assert tl["histories"] == 64
+    assert tl["untraced_s"] > 0 and tl["traced_s"] > 0
+    assert tl["overhead_pct"] is not None
+    assert {"encode", "dispatch", "decode",
+            "journal"} <= set(tl["span_kinds"])
+    assert tl["spans"] > 0
+    assert 0 <= tl["device_busy_frac"] <= 1
+    assert 0 <= tl["host_gap_frac"] <= 1
+    assert isinstance(tl["top_gap_causes"], list)
+    # JT_TRACE unset/0: no ambient trace, no trace.json emitted.
+    assert tl["ambient_trace"] is False and tl["trace_json"] is None
