@@ -39,7 +39,10 @@ round; ``device`` synthesizes the headline batch with the jitted
 counter-PRNG generator of ops/synth_device.py — same logical
 parameters, its own stream), JT_BENCH_SYNTH_B (rows for the
 synth_device section's host-vs-device rate comparison; 0 skips it),
-JT_BENCH_FUZZ=0 (skip the fuzz-loop figure), JT_BENCH_TRACE=0 (skip
+JT_BENCH_FUZZ=0 (skip the fuzz-loop figure), JT_BENCH_ONLINE=0 (skip
+the online-checker-daemon figure: time-to-first-verdict percentiles,
+verdicts/s while writing, and the forced-overload-burst shed fraction;
+JT_BENCH_ONLINE_TENANTS / JT_BENCH_ONLINE_OPS size it), JT_BENCH_TRACE=0 (skip
 the telemetry section) / JT_BENCH_TRACE_B (its workload size; the
 section measures span-tracing overhead against the ≤5% budget and the
 device-busy vs host-gap breakdown — doc/observability.md). JT_TRACE=1
@@ -55,6 +58,7 @@ re-derived exactly before comparing.
 import json
 import os
 import time
+from pathlib import Path
 
 
 def main():
@@ -1107,6 +1111,174 @@ def main():
             "trace_events": trace_events,
         }
 
+    # ---- online checker daemon (jepsen_tpu.online, doc/online.md):
+    # tenants' WALs written live by background writer threads while the
+    # daemon polls — time-to-first-verdict percentiles and verdicts/s
+    # WHILE the histories are still being written (the whole point of
+    # the service), then a forced overload burst with shrunken ladder
+    # thresholds proving graceful degradation (shed fraction, deferred
+    # tenants) without losing any verdict. CPU-safe at the default toy
+    # scale; JT_BENCH_ONLINE=0 skips, JT_BENCH_ONLINE_TENANTS /
+    # JT_BENCH_ONLINE_OPS size it.
+    online_section = None
+    if os.environ.get("JT_BENCH_ONLINE", "1") != "0":
+        import tempfile as _on_tf
+        import threading as _on_thr
+
+        from jepsen_tpu.history.codec import dumps_op as _on_dumps
+        from jepsen_tpu.history.ops import invoke_op as _on_inv, \
+            ok_op as _on_ok
+        from jepsen_tpu.history.wal import WAL_MAGIC as _ON_MAGIC, \
+            WAL_FILE as _ON_WAL
+        from jepsen_tpu.online import OnlineConfig, OnlineDaemon
+        from jepsen_tpu.store import Store as _OnStore
+
+        OT = int(os.environ.get("JT_BENCH_ONLINE_TENANTS", "3"))
+        OPAIRS = int(os.environ.get("JT_BENCH_ONLINE_OPS", "60"))
+
+        def _on_ops(n_pairs, start=0):
+            ops, idx = [], start * 4
+            for k in range(start, start + n_pairs):
+                for op in (_on_inv(0, "write", k + 1),
+                           _on_ok(0, "write", k + 1),
+                           _on_inv(0, "read", None),
+                           _on_ok(0, "read", k + 1)):
+                    op.index = idx
+                    idx += 1
+                    ops.append(op)
+            return ops
+
+        def _on_write(path, lines, mode="a"):
+            with open(path, mode) as f:
+                f.write("\n".join(lines) + "\n")
+
+        def _on_head(seed):
+            return [json.dumps({"wal": _ON_MAGIC, "pid": os.getpid(),
+                                "seed": seed,
+                                "test": {"name": f"bench-{seed}"},
+                                "phase": "setup"}),
+                    json.dumps({"phase": "run", "wal_ops": 0})]
+
+        def _writer(path, seed, stages=6, pause=0.05):
+            _on_write(path, _on_head(seed), mode="w")
+            per = max(1, OPAIRS // stages)
+            done = 0
+            while done < OPAIRS:
+                n = min(per, OPAIRS - done)
+                _on_write(path, [_on_dumps(o)
+                                 for o in _on_ops(n, start=done)])
+                done += n
+                time.sleep(pause)
+            _on_write(path, [json.dumps({"phase": "analyzed",
+                                         "wal_ops": OPAIRS * 4})])
+
+        with _on_tf.TemporaryDirectory() as td:
+            base = Path(td) / "store"
+            paths = []
+            for i in range(OT):
+                d = base / f"bench-online-{i}" / "r1"
+                d.mkdir(parents=True)
+                paths.append(d / _ON_WAL)
+            daemon = OnlineDaemon(
+                store=_OnStore(base),
+                config=OnlineConfig(model=model, poll_s=0,
+                                    check_interval_ops=8,
+                                    crash_quiet_s=3600))
+            writers = [_on_thr.Thread(target=_writer, args=(p, i),
+                                      daemon=True)
+                       for i, p in enumerate(paths)]
+            t0 = time.time()
+            for w in writers:
+                w.start()
+            while any(w.is_alive() for w in writers):
+                daemon.tick()
+                time.sleep(0.005)
+            t_writing = time.time() - t0
+            checks_while_writing = daemon.stats["checks"]
+            for _ in range(50):
+                daemon.tick()
+                if daemon.idle():
+                    break
+            ttfvs = sorted(t.t_first_verdict - t.t_admitted
+                           for t in daemon.tenants.values()
+                           if t.t_first_verdict is not None)
+            tenants_valid = all(
+                (t.result or {}).get("valid") is True
+                for t in daemon.tenants.values())
+            daemon.close()
+
+            # Forced overload burst: pre-written backlogs + shrunken
+            # ladder thresholds — the daemon must degrade (widen →
+            # shed → defer), then still land every verdict.
+            bbase = Path(td) / "burst"
+            for i in range(OT):
+                d = bbase / f"burst-{i}" / "r1"
+                d.mkdir(parents=True)
+                _on_write(d / _ON_WAL,
+                          _on_head(100 + i)
+                          + [_on_dumps(o) for o in _on_ops(OPAIRS)],
+                          mode="w")
+            pend = OPAIRS * 4
+            burst = OnlineDaemon(
+                store=_OnStore(bbase),
+                config=OnlineConfig(model=model, poll_s=0,
+                                    check_interval_ops=8,
+                                    crash_quiet_s=3600,
+                                    overload_pending_ops=pend // 2,
+                                    shed_pending_ops=pend,
+                                    defer_pending_ops=2 * pend))
+            for _ in range(60):
+                burst.tick()
+                if all(t.status == "tailing" and t.pending == 0
+                       and len(t.ops) == pend
+                       for t in burst.tenants.values()):
+                    break
+            burst.cfg.crash_quiet_s = 0
+            for t in burst.tenants.values():
+                t.state.header = dict(t.state.header or {}, pid=-1)
+                t.last_growth = 0.0
+            for _ in range(10):
+                burst.tick()
+                if burst.idle():
+                    break
+            bs = burst.stats
+            burst_valid = all((t.result or {}).get("valid") is True
+                              for t in burst.tenants.values())
+            burst.close()
+
+        def _pct(xs, p):
+            # Nearest-rank, matching telemetry's histogram percentiles
+            # (ceil(p*n/100) - 1): the online TTFV figures must be
+            # comparable with the WAL flush percentiles next to them.
+            if not xs:
+                return None
+            i = min(len(xs) - 1,
+                    max(0, int(round(p / 100.0 * len(xs) + 0.5)) - 1))
+            return round(xs[i], 4)
+
+        online_section = {
+            "tenants": OT,
+            "ops_per_tenant": OPAIRS * 4,
+            "ttfv_p50_s": _pct(ttfvs, 50),
+            "ttfv_p99_s": _pct(ttfvs, 99),
+            "verdicts_per_s_while_writing":
+                round(checks_while_writing / max(t_writing, 1e-9), 2),
+            "interim_checks_while_writing": checks_while_writing,
+            "checks": daemon.stats["checks"],
+            "finalized": daemon.stats["finalized"],
+            "valid_ok": tenants_valid,
+            "burst": {
+                "checks": bs["checks"],
+                "shed": bs["shed"],
+                "shed_fraction": round(bs["shed"]
+                                       / max(bs["checks"], 1), 4),
+                "deferred": bs["deferred"],
+                "widened": bs["widened"],
+                "resumed": bs["resumed"],
+                "valid_ok": burst_valid,
+            },
+        }
+
     print(json.dumps({
         "metric": "linearizability_check_throughput_1kop_cas_e2e",
         "value": round(rate, 2),
@@ -1229,6 +1401,7 @@ def main():
         },
         "synth_device": synth_section,
         "telemetry": tel_section,
+        "online": online_section,
     }))
 
 

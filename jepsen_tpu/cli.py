@@ -701,6 +701,70 @@ def fuzz_cmd() -> dict:
     return {"fuzz": {"add_opts": add_opts, "run": run}}
 
 
+def watch_cmd() -> dict:
+    """``watch``: the always-on online checker daemon
+    (jepsen_tpu.online, doc/online.md). Tails every incomplete run's
+    live WAL in the store, incrementally checks completed prefixes on
+    device, flags the first violating op seconds after it lands
+    (durable ``first-violation.json``), and finalizes each run with a
+    verdict field-for-field identical to a post-mortem ``recheck`` —
+    crashed writers salvage, completed writers re-check their stored
+    history. Admission (tenant count, W-class, rate) and the overload
+    ladder (widen → shed-to-host → defer) keep it alive under any
+    backlog; SIGTERM/SIGINT shut it down signal-clean (journals close,
+    the tenant registry persists, decided prefixes never re-dispatch
+    on restart). Exit 0 when every watched run is valid so far, 1
+    otherwise."""
+    LINEAR_FAMILIES = ("cas", "cas-absent", "mutex", "fifo-queue")
+
+    def add_opts(p):
+        p.add_argument("--model", default="cas-absent",
+                       choices=list(LINEAR_FAMILIES),
+                       help="Checker family for the watched runs "
+                            "(linearizable families only)")
+        p.add_argument("--poll", type=float, default=0.5,
+                       help="Tail poll interval, seconds (jittered)")
+        p.add_argument("--ticks", type=int, default=0,
+                       help="Stop after N poll passes (0 = run until "
+                            "signaled)")
+        p.add_argument("--until-idle", action="store_true",
+                       default=False,
+                       help="Exit once every watched run is finalized")
+        p.add_argument("--interval", type=int, default=64,
+                       help="Interim check cadence, ops")
+        p.add_argument("--max-w", type=int, default=14,
+                       help="W-class admission bound: wider prefixes "
+                            "ride the host oracle")
+        p.add_argument("--max-tenants", type=int, default=64)
+
+    def run(opts):
+        import json as _json
+
+        from .online import watch_store
+        from .recheck import registry
+        from .runtime import GracefulShutdown
+
+        spec = registry()[opts.model]
+        with GracefulShutdown() as gs:
+            st = watch_store(model=spec["model"](), stop=gs.stop,
+                             ticks=opts.ticks or None,
+                             until_idle=opts.until_idle,
+                             poll_s=opts.poll,
+                             check_interval_ops=opts.interval,
+                             max_w=opts.max_w,
+                             max_tenants=opts.max_tenants)
+        line = {"valid": st["valid"], "stats": st["stats"],
+                "tenants": {k: {"status": v["status"],
+                                "valid_so_far": v["valid_so_far"],
+                                "first_violation": v["first_violation"],
+                                "checks": v["checks"]}
+                            for k, v in st["tenants"].items()}}
+        print(_json.dumps(line, default=str))
+        return 0 if st["valid"] else 1
+
+    return {"watch": {"add_opts": add_opts, "run": run}}
+
+
 def trace_cmd() -> dict:
     """``trace --file trace.jsonl``: summarize / export a recorded
     span trace (the JSONL sink ``JT_TRACE=<path>`` streams — see
@@ -750,7 +814,8 @@ def trace_cmd() -> dict:
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
     run_cli({**suite_cmd(), **serve_cmd(), **recheck_cmd(),
-             **salvage_cmd(), **fuzz_cmd(), **trace_cmd()}, argv)
+             **salvage_cmd(), **fuzz_cmd(), **trace_cmd(),
+             **watch_cmd()}, argv)
 
 
 if __name__ == "__main__":

@@ -39,6 +39,7 @@ import logging
 import os
 import threading
 import time
+from dataclasses import dataclass, field
 from pathlib import Path
 from typing import List, Optional, Tuple
 
@@ -177,6 +178,116 @@ class HistoryWAL:
 
 # ------------------------------------------------------------ reading
 
+@dataclass
+class TailState:
+    """Persistent cursor for ``tail_wal``: which segment identity
+    (inode) and byte offset the tailer has consumed through, plus the
+    running parse state (header / op count / latest phase). The online
+    checker keeps one per tenant; it is cheap, picklable state — a
+    daemon restart rebuilds it by re-tailing from 0 (decided-prefix
+    journals, not the cursor, are what make restarts cheap)."""
+
+    ino: int = -1          # inode the cursor is on; -1 = nothing seen
+    pos: int = 0           # byte offset past the last whole parsed line
+    header: Optional[dict] = None
+    n_ops: int = 0
+    phase: Optional[str] = None
+    phases: List[Tuple[str, int]] = field(default_factory=list)
+
+
+def tail_wal(path, st: Optional[TailState] = None, *,
+             max_bytes: int = 8 << 20,
+             materialize: bool = True) -> Tuple[TailState, dict]:
+    """Incremental segment tail — the online checker's read primitive.
+
+    Reads only the bytes appended since ``st`` (a fresh TailState
+    starts at 0) and parses WHOLE lines: a torn final line (the
+    writer's in-flight group commit, or a kill mid-write) is left for
+    a later call to complete — the "torn mid-record tail then
+    completion" case loses nothing and duplicates nothing. Rotation
+    and truncation are detected by inode change / size shrink: the
+    cursor resets and the NEW segment is consumed from offset 0 in the
+    same call, with ``rotated`` set so the caller can invalidate
+    anything derived from the old content. ``max_bytes`` bounds one
+    call's read (a first tail of a huge segment catches up over
+    successive calls instead of stalling the poll loop).
+
+    Returns ``(state, out)`` where out is ``{"ops": [Op...], "phases":
+    [(name, wal_ops)...], "rotated", "torn", "missing", "bad_magic",
+    "grew"}``. ``bad_magic`` marks a file that is not a history WAL
+    (the tailer's answer, not an exception — a daemon sweeping a
+    store must skip, not die). Ops carry their writer-assigned indexes
+    untouched. ``materialize=False`` counts ops (``st.n_ops``) without
+    building a single Op — the wal_progress mode, one parser for both
+    consumers."""
+    st = st or TailState()
+    out = {"ops": [], "phases": [], "rotated": False, "torn": False,
+           "missing": False, "bad_magic": False, "grew": False}
+    p = Path(path)
+    try:
+        s = os.stat(p)
+    except OSError:
+        out["missing"] = True
+        return st, out
+    if st.ino >= 0 and (s.st_ino != st.ino or s.st_size < st.pos):
+        # The path names different content now (logrotate-style swap,
+        # truncate-and-rewrite): everything parsed so far described
+        # the OLD segment.
+        st = TailState()
+        out["rotated"] = True
+    st.ino = s.st_ino
+    out["size"] = s.st_size
+    if s.st_size <= st.pos:
+        return st, out
+    try:
+        with open(p, "rb") as f:
+            f.seek(st.pos)
+            data = f.read(min(s.st_size - st.pos, max_bytes))
+    except OSError:
+        out["missing"] = True
+        return st, out
+    pos = consumed = 0
+    while pos < len(data):
+        nl = data.find(b"\n", pos)
+        if nl < 0:
+            out["torn"] = True      # next call completes the line
+            break
+        line = data[pos:nl].strip()
+        try:
+            if st.header is None:
+                if line:
+                    d = json.loads(line)
+                    if d.get("wal") != WAL_MAGIC:
+                        out["bad_magic"] = True
+                        return st, out
+                    st.header = d
+                    st.phase = d.get("phase", st.phase)
+            elif b'"type"' in line:
+                if materialize:
+                    out["ops"].append(loads_op(line.decode()))
+                st.n_ops += 1
+            elif line:
+                d = json.loads(line)
+                st.phase = d.get("phase", st.phase)
+                stamp = (st.phase, int(d.get("wal_ops", -1)))
+                st.phases.append(stamp)
+                out["phases"].append(stamp)
+        except Exception:
+            # A corrupt whole line can only be the in-flight group
+            # commit at the moment of writer death — stop here; the
+            # good prefix stands and writer-death finalization (which
+            # re-reads through read_wal's identical tolerance) owns
+            # the rest.
+            out["torn"] = True
+            break
+        pos = nl + 1
+        consumed = pos              # only whole parsed lines advance
+    st.pos += consumed
+    out["grew"] = bool(out["ops"] or out["phases"]
+                       or (consumed and st.header is not None))
+    return st, out
+
+
 # Bounded per-path cursor cache for wal_progress: an always-on /live
 # poller must not grow one entry per run forever (finished runs stop
 # being polled but their entries would otherwise persist). LRU via
@@ -192,67 +303,31 @@ def wal_progress(path) -> Optional[dict]:
     materializing a single Op — what the web UI's ``/live`` view polls
     per in-flight run (read_wal builds the full Op list; on a
     million-op campaign that is the difference between a page load and
-    a stall). Incremental: the per-path cursor scans only bytes
-    appended since the last call, so a 2-second poll loop costs the
-    tail, not a full re-read of a multi-GB segment every tick (a
-    shrunken/replaced file resets the cursor). A torn final line (the
-    in-flight group commit) is left for the next poll to complete.
-    None when there is no durable header yet."""
-    p = Path(path)
-    try:
-        size = p.stat().st_size
-    except OSError:
-        return None
-    key = str(p)
+    a stall). ONE parser with the online tailer: this is
+    ``tail_wal(materialize=False)`` behind a bounded per-path cursor
+    cache, so the two consumers cannot drift — incremental scans, a
+    torn final line left for the next poll to complete,
+    rotation/truncation reset by inode change or shrink, and a bounded
+    per-call read (the first poll of a multi-GB segment catches up
+    over successive ticks instead of stalling a page load). None when
+    there is no durable header yet."""
+    key = str(Path(path))
     with _PROGRESS_LOCK:
         st = _PROGRESS_CACHE.pop(key, None)       # re-insert = LRU touch
-        if st is None or size < st["pos"]:
-            st = {"pos": 0, "ops": 0, "phase": None, "header": None}
+        st, out = tail_wal(path, st, materialize=False,
+                           max_bytes=_PROGRESS_READ_BUDGET)
+        if out["missing"] or out["bad_magic"]:
+            return None                   # evicted: nothing to resume
         _PROGRESS_CACHE[key] = st
         while len(_PROGRESS_CACHE) > _PROGRESS_CACHE_MAX:
             _PROGRESS_CACHE.pop(next(iter(_PROGRESS_CACHE)))
-        if size > st["pos"]:
-            # Bounded per-call read: the first poll of a multi-GB
-            # segment must not materialize the whole file in RAM under
-            # the global lock — the cursor catches up over successive
-            # polls instead (32 MB/tick ≫ any live append rate).
-            budget = min(size - st["pos"], _PROGRESS_READ_BUDGET)
-            try:
-                with open(p, "rb") as f:
-                    f.seek(st["pos"])
-                    data = f.read(budget)
-            except OSError:
-                return None
-            pos = consumed = 0
-            while pos < len(data):
-                nl = data.find(b"\n", pos)
-                if nl < 0:
-                    break          # torn tail: next poll completes it
-                line = data[pos:nl].strip()
-                try:
-                    if st["header"] is None:
-                        if line:
-                            d = json.loads(line)
-                            if d.get("wal") != WAL_MAGIC:
-                                del _PROGRESS_CACHE[key]
-                                return None
-                            st["header"] = d
-                    elif b'"type"' in line:
-                        st["ops"] += 1
-                    elif line:
-                        st["phase"] = json.loads(line).get(
-                            "phase", st["phase"])
-                except Exception:
-                    break          # corrupt line: the prefix stands
-                pos = nl + 1
-                consumed = pos     # only whole parsed lines advance
-            st["pos"] += consumed
-        header = st["header"]
+        header = st.header
         if header is None:
             return None
-        return {"header": header, "ops": st["ops"],
-                "phase": st["phase"] or header.get("phase", "setup"),
-                "seed": header.get("seed"), "bytes": size}
+        return {"header": header, "ops": st.n_ops,
+                "phase": st.phase or header.get("phase", "setup"),
+                "seed": header.get("seed"),
+                "bytes": out.get("size", st.pos)}
 
 
 def wal_header(path) -> Optional[dict]:

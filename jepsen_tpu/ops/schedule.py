@@ -136,6 +136,27 @@ def default_fuse_width() -> int:
         return 1
     return DEFAULT_FUSE_WIDTH
 
+
+def sched_max_queue() -> int:
+    """$JT_SCHED_MAX_QUEUE: bound on encoded-but-undispatched chunks
+    buffered at the encode→dispatch hand-off. 0 (the default) keeps
+    the historical behavior (the fuse buffer fills to fuse_width, then
+    the pipeline's depth bound applies); a positive bound makes a
+    stalled device WEDGE the pipeline behind a counted
+    ``backpressure_events`` stat — bounded host memory with a visible
+    signal — instead of letting a pathological fuse/depth configuration
+    grow the hand-off without limit."""
+    env = os.environ.get("JT_SCHED_MAX_QUEUE")
+    if env is None:
+        return 0
+    try:
+        return max(0, int(env))
+    except ValueError:
+        log.warning("ignoring malformed JT_SCHED_MAX_QUEUE=%r "
+                    "(want an integer >= 0)", env)
+        return 0
+
+
 # In-flight chunk budget: 2 = classic double buffering (host pads k+1,
 # device runs k, host decodes k-1).
 PIPELINE_DEPTH = 2
@@ -619,6 +640,39 @@ def prewarm_kernels(specs: Iterable[Tuple]) -> List[threading.Thread]:
 
 
 
+class ResidentState:
+    """Cross-batch scheduler memory — the resident-buffer streaming
+    entry for long-lived callers (the online checker's rolling prefix
+    checks dispatch one BucketScheduler per check, many checks per
+    second, for hours). Passed via ``scheduler_opts={"resident": rs}``,
+    it threads the pieces worth keeping warm across per-batch
+    scheduler instances:
+
+      * ``safe_bp`` — OOM-bisected rows-per-dispatch caps, so check
+        k+1 plans under the wall check k already discovered instead of
+        re-OOMing into the ladder once per batch;
+      * ``awaited`` — kernel shapes already awaited once, so the
+        watchdog's one-time compile grace is paid once per daemon, not
+        once per rolling check.
+
+    The process-wide kernel registry / AOT shipping already persists
+    the compiled executables themselves; this carries the *learned*
+    state that otherwise dies with each scheduler. Shared by reference
+    (both schedulers mutate the same dict/set), which is exactly the
+    point."""
+
+    def __init__(self):
+        self.safe_bp: Dict = {}
+        self.awaited: set = set()
+        self.batches = 0
+
+    def adopt(self, sch) -> None:
+        """Wire a freshly built scheduler to this resident state."""
+        sch._safe_bp = self.safe_bp
+        sch._awaited_shapes = self.awaited
+        self.batches += 1
+
+
 def _stat_inc(sch, family: str, key: str, n) -> None:
     """Shared locked stats+registry increment for both schedulers:
     bump the instance stats dict under its lock and mirror into the
@@ -700,7 +754,9 @@ class BucketScheduler:
                  max_retries: Optional[int] = None,
                  backoff_s: Optional[float] = None,
                  fuse_width: Optional[int] = None,
-                 shard_min_rows: Optional[int] = None):
+                 shard_min_rows: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 resident: Optional[ResidentState] = None):
         self.return_frontier = return_frontier
         self.max_classes = (DEFAULT_MAX_CLASSES if max_classes is None
                             else max_classes)
@@ -755,6 +811,13 @@ class BucketScheduler:
         self.row_provenance: Dict[int, str] = {}
         self._safe_bp: Dict[Tuple[int, int], int] = {}
         self._awaited_shapes: set = set()
+        # Encode→dispatch hand-off bound (JT_SCHED_MAX_QUEUE): chunks
+        # buffered past it force a blocking flush behind a counted
+        # backpressure event.
+        self.max_queue = sched_max_queue() if max_queue is None \
+            else max(0, int(max_queue))
+        if resident is not None:
+            resident.adopt(self)
         # ``stats`` is read by callers as a plain dict, but increments
         # go through _inc: chunks of concurrent fused groups retire on
         # executor/retire threads, and an unlocked read-modify-write
@@ -775,7 +838,7 @@ class BucketScheduler:
             "retries": 0, "bisections": 0, "watchdog_fired": 0,
             "oom_events": 0, "corrupt_chunks": 0, "quarantined_rows": 0,
             "prewarm_wedged": 0, "abandoned_buckets": 0,
-            "faults_injected": 0,
+            "faults_injected": 0, "backpressure_events": 0,
         }
         self._t0 = None
         self._first_dispatch_t = None
@@ -1550,8 +1613,22 @@ class BucketScheduler:
                 # each exactly when dispatch is the bottleneck.
                 # fuse_width=1 degenerates to the per-chunk flow.
                 self._fuse_buf.append((st, lo, hi, Bp))
+                # JT_SCHED_MAX_QUEUE: the hand-off is full while the
+                # pipeline is saturated — a stalled device now WEDGES
+                # here (flush → retire_ready blocks on the stalled
+                # group; the watchdog owns a true wedge) behind a
+                # counted event, instead of buffering encoded chunks
+                # without bound.
+                full = (self.max_queue
+                        and len(self._fuse_buf) >= self.max_queue
+                        and len(inflight) >= self.depth)
+                if full:
+                    self._inc("backpressure_events")
+                    telemetry.event("scheduler.backpressure",
+                                    queued=len(self._fuse_buf))
                 if (len(inflight) < self.depth
-                        or len(self._fuse_buf) >= self.fuse_width):
+                        or len(self._fuse_buf) >= self.fuse_width
+                        or full):
                     yield from flush()
 
         it = iter(groups)
@@ -1707,7 +1784,8 @@ class GraphScheduler:
                  max_retries: Optional[int] = None,
                  backoff_s: Optional[float] = None,
                  on_chunk=None,
-                 compilation_cache: bool = True):
+                 compilation_cache: bool = True,
+                 resident: Optional[ResidentState] = None):
         self.chunk_rows = (GRAPH_CHUNK_ROWS if chunk_rows is None
                            else max(1, int(chunk_rows)))
         if compilation_cache:
@@ -1726,6 +1804,11 @@ class GraphScheduler:
         self.row_provenance: Dict[int, str] = {}
         self._safe_bp: Dict[int, int] = {}
         self._awaited_shapes: set = set()
+        if resident is not None:
+            # Graph buckets key safe_bp by bare V (the WGL side keys
+            # by (V, W) tuples), so one ResidentState serves both
+            # families without collisions.
+            resident.adopt(self)
         self._stats_lock = threading.Lock()
         self._mirrors: dict = {}       # key -> registry counter handle
         self.stats: dict = {

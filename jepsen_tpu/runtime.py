@@ -79,6 +79,55 @@ def run_fault_injector():
     return _RUN_FAULT
 
 
+class GracefulShutdown:
+    """Signal-clean daemon lifecycle: SIGTERM/SIGINT set a ``stop``
+    event the serving loop polls — the in-flight dispatch finishes,
+    journals close, the tenant registry persists — instead of dying
+    mid-write. A SECOND signal restores the previous handlers and
+    raises KeyboardInterrupt: a wedged drain must still be killable.
+    Install from the main thread (CPython restricts signal.signal to
+    it); ``stop`` is also settable programmatically, which is how
+    in-process tests drive it. The online checker daemon
+    (``jepsen-tpu watch``) is the first consumer; any long-running
+    loop (campaigns, the web server) can ride it."""
+
+    def __init__(self, signums=None):
+        import signal
+        self.signums = tuple(signums) if signums is not None \
+            else (signal.SIGTERM, signal.SIGINT)
+        self.stop = threading.Event()
+        self._prev: Dict[int, Any] = {}
+
+    def _handle(self, signum, frame) -> None:
+        if self.stop.is_set():
+            self.restore()
+            raise KeyboardInterrupt(f"second signal {signum}")
+        log.info("signal %s: finishing the in-flight work, then "
+                 "shutting down (signal again to abort)", signum)
+        self.stop.set()
+
+    def install(self) -> "GracefulShutdown":
+        import signal
+        for s in self.signums:
+            self._prev[s] = signal.signal(s, self._handle)
+        return self
+
+    def restore(self) -> None:
+        import signal
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev = {}
+
+    def __enter__(self) -> "GracefulShutdown":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.restore()
+
+
 class DeadlineBarrier:
     """``threading.Barrier`` with a deadline (``JT_BARRIER_TIMEOUT_S``,
     default 300 s — generous next to any healthy setup phase).

@@ -53,6 +53,21 @@ NONSERIALIZABLE_KEYS = {
 # Campaign-checkpoint header magic (CampaignCheckpoint).
 CAMPAIGN_MAGIC = "JTCAMP1"
 
+# Online-checker namespace (jepsen_tpu.online): per-run artifacts the
+# always-on daemon leaves beside the WAL. The journal gates interim
+# prefix re-dispatch across daemon restarts; the verdict file is the
+# durable final result (recheck-parity unit); the first-violation
+# record is the "flag the first violating op seconds after it happens"
+# artifact; the deferred mark makes an overload-paused tenant durable.
+ONLINE_JOURNAL = "online.journal.jsonl"
+ONLINE_VERDICT = "online-verdict.json"
+ONLINE_DEFERRED = "online-deferred.json"
+FIRST_VIOLATION = "first-violation.json"
+
+# Store-level tenant registry the daemon persists each tick (web /live
+# reads it cross-process).
+ONLINE_REGISTRY = "online-registry.json"
+
 
 class CampaignMismatch(ValueError):
     """An explicit campaign resume named a checkpoint belonging to a
@@ -372,6 +387,43 @@ class Store:
 
     def run_dir(self, test_name: str, ts: str = "latest") -> Path:
         return self.base / test_name / ts
+
+    # ----------------------------------------------------------- online
+    def online_registry_path(self) -> Path:
+        return self.base / ONLINE_REGISTRY
+
+    def load_online_registry(self) -> dict:
+        """The online daemon's persisted tenant registry (status,
+        verdict-so-far, SLO counters per tenant) — {} when no daemon
+        ever watched this store or the file is unreadable (the
+        registry is display/resume state, never a correctness gate)."""
+        try:
+            return json.loads(self.online_registry_path().read_text())
+        except Exception:
+            return {}
+
+    def save_online_registry(self, reg: dict) -> None:
+        self.base.mkdir(parents=True, exist_ok=True)
+        atomic_write_json(self.online_registry_path(), reg)
+
+    def _run_json(self, test_name: str, ts: str, name: str
+                  ) -> Optional[dict]:
+        try:
+            f = self.run_dir(test_name, ts) / name
+            return json.loads(f.read_text()) if f.exists() else None
+        except Exception:
+            return None
+
+    def online_verdict(self, test_name: str, ts: str) -> Optional[dict]:
+        """The daemon's durable final verdict for a run (the
+        recheck-parity unit), or None while the run is still being
+        tailed / was never watched."""
+        return self._run_json(test_name, ts, ONLINE_VERDICT)
+
+    def first_violation(self, test_name: str, ts: str) -> Optional[dict]:
+        """The online first-violation record: which op first made the
+        run invalid and at what prefix the daemon caught it."""
+        return self._run_json(test_name, ts, FIRST_VIOLATION)
 
     def load(self, test_name: str, ts: str = "latest") -> dict:
         """Rehydrate a stored run: test map slice + history + results

@@ -607,6 +607,22 @@ def snapshot() -> dict:
     return REGISTRY.snapshot()
 
 
+def metrics_prefixed(prefix: str) -> dict:
+    """Flat {metric: value} slice of the registry under a name prefix
+    — counters/gauges verbatim, histograms as their summary dicts
+    (count/sum/min/max/p50/p99). The online daemon's status line and
+    the web /live view read their ``online.*`` SLO histograms and
+    queue gauges through this instead of re-walking the full
+    snapshot."""
+    snap = snapshot()
+    out: dict = {}
+    for kind in ("counters", "gauges", "histograms"):
+        for k, v in (snap.get(kind) or {}).items():
+            if k.startswith(prefix):
+                out[k] = v
+    return out
+
+
 def counters_delta(base: Optional[dict], now: dict) -> dict:
     """``now`` with its counters re-expressed as deltas over ``base``
     (zero deltas dropped). The registry is process-cumulative; a

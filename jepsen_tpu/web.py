@@ -14,7 +14,9 @@ from __future__ import annotations
 import html
 import io
 import json
+import os
 import threading
+import time
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
@@ -38,6 +40,9 @@ td, th { padding: .3em .8em; border: 1px solid #ccc; text-align: left; }
 .badge { padding: .1em .5em; border-radius: .6em; font-size: .85em; }
 .badge-live { background: #2d7dd2; color: #fff; }
 .badge-crashed { background: #666; color: #fff; }
+.badge-stalled { background: #d9972f; color: #fff; }
+.badge-violation { background: #b03030; color: #fff; }
+.badge-clean { background: #3a8f3a; color: #fff; }
 a { text-decoration: none; }
 pre { background: #f7f7f7; padding: 1em; overflow-x: auto; }
 """
@@ -49,6 +54,17 @@ def _validity(run_dir: Path):
             return json.load(f).get("valid")
     except Exception:
         return None
+
+
+def live_stale_s() -> float:
+    """$JT_LIVE_STALE_S: a live writer whose WAL hasn't grown for this
+    many seconds badges ``stalled`` — alive-but-wedged is a distinct
+    triage state from ``crashed`` (pid gone). Default 30 s, several
+    group-commit windows past any healthy cadence."""
+    try:
+        return float(os.environ.get("JT_LIVE_STALE_S", "30"))
+    except ValueError:
+        return 30.0
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -110,16 +126,73 @@ class Handler(BaseHTTPRequestHandler):
             return True
         return writer_alive(header)
 
-    def _incomplete_badge(self, name: str, ts: str) -> str:
-        """Distinct badge for a crashed/in-flight (pre-salvage) run:
-        ``live`` when the WAL's writer pid is still alive on this
-        host, ``crashed`` otherwise — the index answers "did my
-        campaign die?" without shell access."""
+    def _run_state(self, name: str, ts: str) -> str:
+        """An incomplete run's triage state: ``live`` (writer alive,
+        WAL growing), ``stalled`` (writer alive but the WAL hasn't
+        grown for $JT_LIVE_STALE_S — wedged, not dead), or ``crashed``
+        (writer pid gone)."""
         from .history.wal import WAL_FILE, wal_header
         wal = self.store.run_dir(name, ts) / WAL_FILE
-        if self._writer_live(wal_header(wal)):
-            return ' <span class="badge badge-live">live</span>'
-        return ' <span class="badge badge-crashed">crashed</span>'
+        if not self._writer_live(wal_header(wal)):
+            return "crashed"
+        try:
+            if time.time() - wal.stat().st_mtime >= live_stale_s():
+                return "stalled"
+        except OSError:
+            pass
+        return "live"
+
+    def _incomplete_badge(self, name: str, ts: str) -> str:
+        """Distinct badge for a crashed/in-flight (pre-salvage) run —
+        the index answers "did my campaign die (or wedge)?" without
+        shell access."""
+        state = self._run_state(name, ts)
+        return f' <span class="badge badge-{state}">{state}</span>'
+
+    def _online_cell(self, name: str, ts: str, reg: dict) -> str:
+        """The online checker's view of an in-flight run: the daemon's
+        verdict-so-far plus a first-violation badge (store.py online
+        namespace — written by ``jepsen-tpu watch``, readable
+        cross-process). ``reg`` is the store registry, loaded ONCE per
+        page render by the caller. Inode-stamped records are checked
+        against the CURRENT WAL the same way the daemon's rehydration
+        does: a segment rotated after finalization must not wear the
+        old segment's badge."""
+        from .history.wal import WAL_FILE
+
+        def fresh(rec):
+            ino = (rec or {}).get("ino")
+            if ino is None:
+                return rec is not None
+            try:
+                wal = self.store.run_dir(name, ts) / WAL_FILE
+                return os.stat(wal).st_ino == ino
+            except OSError:
+                return True       # nothing newer on disk to contradict
+        v = self.store.online_verdict(name, ts)
+        fv = self.store.first_violation(name, ts)
+        if not fresh(v):
+            v = None
+        if not fresh(fv):
+            fv = None
+        if fv is not None:
+            where = fv.get("op_index")
+            return (f'<span class="badge badge-violation">INVALID @ op '
+                    f"{html.escape(str(where))}</span>")
+        if v is not None:
+            ok = v.get("valid") is True
+            cls = "badge-clean" if ok else "badge-violation"
+            txt = "valid" if ok else f"invalid: {v.get('valid')}"
+            return f'<span class="badge {cls}">{html.escape(txt)}</span>'
+        t = (reg.get("tenants") or {}).get(f"{name}/{ts}")
+        if t is None:
+            return "—"
+        if t.get("valid_so_far") is True:
+            return (f'<span class="badge badge-clean">✓ so far '
+                    f"({t.get('checked_ops', 0)} ops)</span>")
+        if t.get("valid_so_far") is False:
+            return '<span class="badge badge-violation">invalid</span>'
+        return html.escape(str(t.get("status", "watched")))
 
     def index(self):
         incomplete = set(self.store.incomplete(include_salvaged=False))
@@ -155,15 +228,14 @@ class Handler(BaseHTTPRequestHandler):
         in-flight run's WAL, plus this process's telemetry registry
         snapshot (meaningful when the server rides inside a campaign
         process). Auto-refreshes."""
-        from .history.wal import WAL_FILE, wal_header, wal_progress
+        from .history.wal import WAL_FILE, wal_progress
         rows = []
+        online_reg = self.store.load_online_registry()
         for name, ts in self.store.incomplete(include_salvaged=True):
             wal = self.store.run_dir(name, ts) / WAL_FILE
             p = wal_progress(wal)
-            alive = self._writer_live(wal_header(wal))
-            badge = ('<span class="badge badge-live">live</span>'
-                     if alive else
-                     '<span class="badge badge-crashed">crashed</span>')
+            state = self._run_state(name, ts)
+            badge = f'<span class="badge badge-{state}">{state}</span>'
             rel = f"{name}/{ts}"
             rows.append(
                 "<tr>"
@@ -174,11 +246,13 @@ class Handler(BaseHTTPRequestHandler):
                 f"<td>{html.escape(str((p or {}).get('phase', '?')))}"
                 f"</td>"
                 f"<td>{(p or {}).get('ops', '?')}</td>"
+                f"<td>{self._online_cell(name, ts, online_reg)}</td>"
                 f"<td>{html.escape(str((p or {}).get('seed', '')))}"
                 f"</td></tr>")
         runs_tbl = ("<h2>in-flight runs</h2>"
                     "<table><tr><th>test</th><th>run</th><th>state</th>"
-                    "<th>phase</th><th>ops</th><th>seed</th></tr>"
+                    "<th>phase</th><th>ops</th>"
+                    "<th>verdict so far</th><th>seed</th></tr>"
                     + "".join(rows) + "</table>"
                     if rows else "<p>no in-flight runs</p>")
         snap = telemetry.snapshot()

@@ -64,6 +64,12 @@ def pytest_configure(config):
                    "budget, and fuzz kill-and-resume (deterministic; "
                    "runs in tier-1)")
     config.addinivalue_line(
+        "markers", "online: always-on online checker daemon — live "
+                   "WAL tailing (torn tails, rotation, writer death), "
+                   "admission + overload ladder, journal-gated "
+                   "restart, and online-vs-post-mortem verdict parity "
+                   "(deterministic; runs in tier-1)")
+    config.addinivalue_line(
         "markers", "telemetry: span tracer + metrics registry — "
                    "nesting/attributes, ring wraparound, Chrome-trace "
                    "export, snapshot determinism, no-op-when-off, and "

@@ -29,6 +29,7 @@ def test_bench_emits_contract_json():
                JT_BENCH_LONG_B="32", JT_BENCH_LONG_OPS="500",
                JT_BENCH_XLONG_B="6", JT_BENCH_XLONG_OPS="2000",
                JT_BENCH_SYNTH_B="64", JT_BENCH_TRACE_B="64",
+               JT_BENCH_ONLINE_TENANTS="2", JT_BENCH_ONLINE_OPS="24",
                # Tracing stays ambient-off: the section flips the
                # flight recorder on for its own traced passes only.
                JT_TRACE="0")
@@ -118,6 +119,19 @@ def test_bench_emits_contract_json():
     assert fz["iters_per_s"] > 0 and fz["neighborhoods"] >= 0
     # Per-section synth breakdown on the probes.
     assert d["long_history"]["long"]["synth_s"] >= 0
+    # Online checker-daemon section (ISSUE 9 acceptance): live-tailed
+    # verdicts while the histories are still being written, plus the
+    # forced overload burst degrading through the ladder without
+    # dropping any tenant's eventual verdict.
+    on = d["online"]
+    assert on["tenants"] == 2 and on["ops_per_tenant"] == 96
+    assert on["ttfv_p50_s"] is not None and on["ttfv_p99_s"] is not None
+    assert on["verdicts_per_s_while_writing"] > 0
+    assert on["finalized"] == 2 and on["valid_ok"] is True
+    b = on["burst"]
+    assert b["checks"] > 0 and b["valid_ok"] is True
+    assert b["shed"] + b["deferred"] + b["widened"] > 0
+    assert 0 <= b["shed_fraction"] <= 1
     assert d["xlong_history"]["synth_s"] >= 0
     # Telemetry section (ISSUE 8 acceptance): the traced-overhead
     # measurement, span coverage of the checked path, and the

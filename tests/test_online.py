@@ -1,0 +1,805 @@
+"""Always-on online checker daemon (jepsen_tpu.online, doc/online.md).
+
+The framework's premise applied to its own serving layer: the daemon
+that checks histories while they are being written must itself survive
+writer crashes, torn tails, log rotation, slow consumers, overload,
+and its own faults — with verdicts field-for-field identical to the
+post-mortem path. Covers the tailer edge cases the issue names (torn
+mid-record tail then completion, writer SIGKILL mid-group-commit,
+rotation under an active cursor, two tenants with interleaved flush
+cadences), the admission/overload ladder, journal-gated restart with
+zero decided prefixes re-dispatched, the scheduler's JT_SCHED_MAX_QUEUE
+backpressure bound, and the online-vs-post-mortem parity gate —
+fault-free AND under every single-fault daemon schedule.
+"""
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.history.codec import dumps_op, write_jsonl
+from jepsen_tpu.history.core import index
+from jepsen_tpu.history.ops import INVOKE, invoke_op, ok_op
+from jepsen_tpu.history.wal import (HistoryWAL, TailState, WAL_FILE,
+                                    WAL_MAGIC, tail_wal, wal_progress)
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.online import (DaemonFaultInjector, OnlineConfig,
+                               OnlineDaemon, checkable_prefix,
+                               daemon_fault_schedules)
+from jepsen_tpu.ops.linearize import check_batch_columnar
+from jepsen_tpu.store import (FIRST_VIOLATION, ONLINE_DEFERRED,
+                              ONLINE_JOURNAL, ONLINE_VERDICT, Store)
+
+pytestmark = pytest.mark.online
+
+REPO = Path(__file__).resolve().parent.parent
+HELPER = Path(__file__).resolve().parent / "_durability_helpers.py"
+
+# A pid that does not exist on any sane test box: os.kill probes fail,
+# so WALs written with it read as a DEAD writer (the crashed-run case).
+DEAD_PID = 2 ** 22 + 12345
+
+
+# ------------------------------------------------------------- builders
+
+def reg_ops(n_pairs, corrupt_read=None, start_index=0, start_value=0):
+    """A deterministic single-process register history: write k / read
+    k pairs, indexed. ``corrupt_read=N`` makes the Nth read observe 999
+    (never written) — invalid from that completion on."""
+    ops, v, reads, idx = [], start_value, 0, start_index
+    for _ in range(n_pairs):
+        v += 1
+        group = [invoke_op(0, "write", v), ok_op(0, "write", v)]
+        reads += 1
+        rv = 999 if corrupt_read == reads else v
+        group += [invoke_op(0, "read", None), ok_op(0, "read", rv)]
+        for op in group:
+            op.index = idx
+            idx += 1
+            ops.append(op)
+    return ops
+
+
+def wal_header_line(pid=DEAD_PID, seed=0, name="reg"):
+    return json.dumps({"wal": WAL_MAGIC, "test": {"name": name},
+                       "seed": seed, "pid": pid, "phase": "setup"})
+
+
+def write_wal(path, ops, *, pid=DEAD_PID, seed=0, analyzed=False,
+              append=False, torn=b""):
+    """Write (or grow) a raw WAL segment byte-for-byte — full control
+    over writer pid (dead/alive), phase stamps, and torn tails, which
+    HistoryWAL deliberately doesn't give."""
+    lines = []
+    if not append:
+        lines += [wal_header_line(pid=pid, seed=seed),
+                  json.dumps({"phase": "run", "wal_ops": 0})]
+    lines += [dumps_op(o) for o in ops]
+    if analyzed:
+        lines.append(json.dumps({"phase": "analyzed",
+                                 "wal_ops": len(ops)}))
+    with open(path, "ab" if append else "wb") as f:
+        if lines:
+            f.write(("\n".join(lines) + "\n").encode())
+        f.write(torn)
+    return Path(path)
+
+
+def mkrun(base, name, ts, ops, **kw):
+    d = Path(base) / name / ts
+    d.mkdir(parents=True, exist_ok=True)
+    write_wal(d / WAL_FILE, ops, **kw)
+    return d
+
+
+def cfg(**kw):
+    kw.setdefault("model", cas_register())
+    kw.setdefault("poll_s", 0)
+    kw.setdefault("check_interval_ops", 4)
+    kw.setdefault("crash_quiet_s", 0)
+    return OnlineConfig(**kw)
+
+
+def online_counter(key):
+    return telemetry.REGISTRY.get(f"online.{key}") or 0
+
+
+# ------------------------------------------------------ tailer edge cases
+
+def test_tail_torn_mid_record_then_completed(tmp_path):
+    """A torn mid-record tail (the writer's in-flight group commit)
+    is left for a later poll to COMPLETE — nothing lost, nothing
+    duplicated."""
+    p = tmp_path / "w.jsonl"
+    ops = reg_ops(3)
+    full = dumps_op(ops[-1])
+    write_wal(p, ops[:-1], torn=full[:9].encode())
+    st, out = tail_wal(p)
+    assert out["torn"] is True
+    assert [o.index for o in out["ops"]] == list(range(len(ops) - 1))
+    assert st.header["seed"] == 0
+    # The writer completes the record and appends one more op.
+    extra = invoke_op(0, "read", None)
+    extra.index = len(ops)
+    with open(p, "ab") as f:
+        f.write(full[9:].encode() + b"\n")
+        f.write((dumps_op(extra) + "\n").encode())
+    st, out = tail_wal(p, st)
+    assert out["torn"] is False
+    assert [o.index for o in out["ops"]] == [len(ops) - 1, len(ops)]
+    assert st.n_ops == len(ops) + 1
+
+
+def test_tail_rotation_under_active_cursor(tmp_path):
+    """The path swapped for different content (inode change) resets
+    the cursor and consumes the NEW segment from 0 in the same call."""
+    p = tmp_path / "w.jsonl"
+    write_wal(p, reg_ops(4), seed=1)
+    st, out = tail_wal(p)
+    assert st.n_ops == 16 and not out["rotated"]
+    fresh = tmp_path / "w.new"
+    write_wal(fresh, reg_ops(2), seed=2)
+    os.replace(fresh, p)
+    st, out = tail_wal(p, st)
+    assert out["rotated"] is True
+    assert st.header["seed"] == 2
+    assert len(out["ops"]) == 8 and st.n_ops == 8
+
+
+def test_tail_missing_and_bad_magic(tmp_path):
+    st, out = tail_wal(tmp_path / "absent.jsonl")
+    assert out["missing"] is True and st.header is None
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"not": "a wal"}\n')
+    st, out = tail_wal(bad)
+    assert out["bad_magic"] is True
+
+
+def test_wal_progress_rotation_by_inode(tmp_path):
+    """wal_progress must reset its persistent cursor on inode change —
+    a LARGER replacement segment would otherwise be misparsed from the
+    stale offset."""
+    p = tmp_path / WAL_FILE
+    write_wal(p, reg_ops(2), seed=7)
+    assert wal_progress(p)["ops"] == 8
+    fresh = tmp_path / "w.new"
+    write_wal(fresh, reg_ops(5), seed=8)   # larger than the original
+    os.replace(fresh, p)
+    prog = wal_progress(p)
+    assert prog["ops"] == 20
+    assert prog["header"]["seed"] == 8
+
+
+def test_checkable_prefix_holds_back_dangling():
+    """Dangling invocations stay OPEN in the checked prefix (never
+    durably :info'd) and the verdict agrees with the salvage form —
+    the prefix-checkability contract."""
+    from jepsen_tpu.history.wal import salvage_history
+    h = reg_ops(3)
+    tail = invoke_op(0, "write", 42)
+    tail.index = len(h)
+    h = h + [tail]
+    cp = checkable_prefix(h)
+    assert cp[-1].type == INVOKE            # held back, not :info'd
+    salvaged, dangling = salvage_history(h)
+    assert dangling == 1
+    model = cas_register()
+    r_open = check_batch_columnar(model, [cp], details="invalid")[0]
+    r_salv = check_batch_columnar(model, [salvaged],
+                                  details="invalid")[0]
+    assert r_open["valid"] is r_salv["valid"] is True
+
+
+# ------------------------------------------------------- daemon lifecycle
+
+def test_interim_checks_then_complete_finalize(tmp_path):
+    """A live (alive-writer) WAL grows across polls: rolling prefix
+    checks land journaled verdicts; the ``analyzed`` stamp finalizes
+    through the stored history with the journal retired."""
+    base = tmp_path / "store"
+    ops = reg_ops(6)
+    d = mkrun(base, "reg", "r1", ops[:8], pid=os.getpid())
+    daemon = OnlineDaemon(store=Store(base),
+                          config=cfg(crash_quiet_s=60))
+    daemon.tick()
+    t = daemon.tenants[("reg", "r1")]
+    assert t.stats["checks"] == 1 and t.checked_ops == 8
+    assert t.valid_so_far is True
+    assert (d / ONLINE_JOURNAL).exists()
+    write_wal(d / WAL_FILE, ops[8:16], append=True)
+    daemon.tick()
+    assert t.stats["checks"] == 2 and t.checked_ops == 16
+    # Completion: history lands, the writer stamps analyzed.
+    write_jsonl(d / "history.jsonl", index([o.with_() for o in ops]))
+    write_wal(d / WAL_FILE, ops[16:], append=True, analyzed=True)
+    daemon.tick()
+    assert t.status == "done" and t.salvaged is False
+    assert t.result["valid"] is True
+    v = json.loads((d / ONLINE_VERDICT).read_text())
+    assert v["valid"] is True and v["salvaged"] is False
+    assert not (d / ONLINE_JOURNAL).exists()
+    slo = telemetry.metrics_prefixed("online.")
+    assert slo["online.ttfv_s"]["count"] >= 1
+    daemon.close()
+
+
+def test_first_violation_flagged_and_persisted(tmp_path):
+    """The production story: the first violating op is flagged from an
+    interim PREFIX check — seconds after it lands, long before the run
+    ends — and the record is durable."""
+    base = tmp_path / "store"
+    ops = reg_ops(8, corrupt_read=2)       # invalid at op index 7
+    d = mkrun(base, "reg", "r1", ops[:12], pid=os.getpid())
+    daemon = OnlineDaemon(store=Store(base),
+                          config=cfg(crash_quiet_s=60))
+    daemon.tick()
+    t = daemon.tenants[("reg", "r1")]
+    assert t.valid_so_far is False
+    fv = json.loads((d / FIRST_VIOLATION).read_text())
+    assert fv["op_index"] == 7 and fv["prefix_ops"] == 12
+    assert daemon.stats["first_violations"] == 1
+    # Later growth never un-flags it (monotone verdicts).
+    write_wal(d / WAL_FILE, ops[12:], append=True)
+    daemon.tick()
+    assert t.valid_so_far is False
+    assert t.first_violation["op_index"] == 7
+    daemon.close()
+
+
+# ------------------------------------------------ parity with post-mortem
+
+def run_and_kill(base, seed, corrupt, fault="op:12"):
+    """A REAL register run in a subprocess, SIGKILLed by the run-level
+    nemesis mid-group-commit (the op-K fsync-then-SIGKILL fault)."""
+    env = {**os.environ, "JT_RUN_FAULT": fault, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": str(REPO)}
+    r = subprocess.run(
+        [sys.executable, str(HELPER), "run", "register", str(base),
+         str(seed), str(corrupt)],
+        capture_output=True, text=True, env=env, timeout=180)
+    assert r.returncode == -signal.SIGKILL, \
+        (r.returncode, r.stdout[-500:], r.stderr[-2000:])
+    store = Store(base)
+    (name, ts), = store.incomplete()
+    return store, name, ts
+
+
+def postmortem(store, name, ts, model):
+    """The reference verdict: salvage the crashed WAL, then the stored
+    replay path — exactly what the daemon must match field-for-field."""
+    store.salvage(name, ts, model=model)
+    rc = store.recheck(name, model, timestamps=[ts])
+    return rc["runs"][ts]["results"]["history"]
+
+
+def test_writer_sigkill_parity_clean_and_invalid(tmp_path):
+    """Acceptance: writer SIGKILLed mid-group-commit (real subprocess,
+    $JT_RUN_FAULT) — the daemon's final verdict, witness, and bad-op
+    index are field-for-field identical to Store.recheck on the
+    salvaged run, for a clean AND an invalid history."""
+    model = cas_register()
+    for sub, corrupt in (("clean", 0), ("bad", 3)):
+        base = tmp_path / sub
+        store, name, ts = run_and_kill(base, seed=5, corrupt=corrupt)
+        daemon = OnlineDaemon(store=store, config=cfg())
+        daemon.tick()
+        t = daemon.tenants[(name, ts)]
+        assert t.status == "done" and t.salvaged is True
+        assert t.result == postmortem(store, name, ts, model), sub
+        if corrupt:
+            assert t.result["valid"] is False
+            assert (store.run_dir(name, ts) / FIRST_VIOLATION).exists()
+        daemon.close()
+
+
+def test_parity_under_every_daemon_fault_schedule(tmp_path):
+    """No single daemon fault (tail/encode/dispatch fail or stall)
+    changes the final verdict: each schedule engages, costs at most
+    retried ticks, and converges to the same field-for-field result."""
+    model = cas_register()
+    base = tmp_path / "seed"
+    store, name, ts = run_and_kill(base, seed=9, corrupt=3)
+    baseline_daemon = OnlineDaemon(store=store, config=cfg())
+    baseline_daemon.tick()
+    baseline = baseline_daemon.tenants[(name, ts)].result
+    baseline_daemon.close()
+    assert baseline == postmortem(store, name, ts, model)
+    src = store.run_dir(name, ts)
+    for label, plan in daemon_fault_schedules():
+        fresh = tmp_path / label.replace("@", "_") / name / ts
+        shutil.copytree(src, fresh)
+        for junk in (ONLINE_VERDICT, ONLINE_JOURNAL, FIRST_VIOLATION,
+                     "salvage.json", "history.jsonl", "history.txt",
+                     "history.cols.bin", "results.json"):
+            f = fresh / junk
+            if f.exists():
+                f.unlink()
+        inj = DaemonFaultInjector(plan)
+        daemon = OnlineDaemon(store=Store(fresh.parent.parent),
+                              config=cfg(), faults=inj)
+        for _ in range(4):
+            daemon.tick()
+            if daemon.idle() and daemon.tenants:
+                break
+        assert inj.log, f"{label}: schedule never engaged"
+        t = daemon.tenants[(name, ts)]
+        assert t.status == "done", label
+        assert t.result == baseline, label
+        daemon.close()
+
+
+# ----------------------------------------------------- restart durability
+
+def test_kill_and_restart_redispatches_zero_decided_prefixes(tmp_path):
+    """Acceptance: a daemon restart resumes from the per-tenant
+    journal — prefixes decided by the previous incarnation are never
+    re-dispatched (ChunkJournal refuses double-decides structurally,
+    so a violation would raise, not just fail an assert)."""
+    base = tmp_path / "store"
+    ops = reg_ops(8)
+    d = mkrun(base, "reg", "r1", ops[:8], pid=os.getpid())
+    d1 = OnlineDaemon(store=Store(base), config=cfg(crash_quiet_s=60))
+    d1.tick()
+    write_wal(d / WAL_FILE, ops[8:16], append=True)
+    d1.tick()
+    assert d1.tenants[("reg", "r1")].stats["checks"] == 2
+    d1.close()                                # kill (journal survives)
+
+    d2 = OnlineDaemon(store=Store(base), config=cfg(crash_quiet_s=60))
+    d2.tick()                                 # same WAL content
+    t = d2.tenants[("reg", "r1")]
+    assert t.stats["resumed_prefixes"] == 2
+    assert t.stats["checks"] == 0             # zero re-dispatched
+    # ...and none swallowed: a re-dispatch would raise in
+    # ChunkJournal.record and land here as a check_error.
+    assert d2.stats["check_errors"] == 0
+    assert t.valid_so_far is True             # rehydrated verdict
+    write_wal(d / WAL_FILE, ops[16:], append=True)
+    d2.tick()                                 # only the NEW prefix
+    assert t.stats["checks"] == 1 and t.checked_ops == 32
+    write_jsonl(d / "history.jsonl", index([o.with_() for o in ops]))
+    write_wal(d / WAL_FILE, [], append=True, analyzed=True)
+    d2.tick()
+    assert t.status == "done"
+    d2.close()
+
+    d3 = OnlineDaemon(store=Store(base), config=cfg())
+    d3.tick()                                 # after final verdict:
+    t3 = d3.tenants[("reg", "r1")]            # zero work at all
+    assert t3.status == "done" and t3.stats["checks"] == 0
+    assert t3.result["valid"] is True
+    d3.close()
+
+
+def test_finalize_drains_ingest_gated_tail(tmp_path):
+    """The ingest bound can leave WAL bytes unread behind a backlogged
+    checker; the FINAL verdict must still cover the whole segment —
+    including a violation hiding in the unread tail."""
+    base = tmp_path / "store"
+    ops = reg_ops(10, corrupt_read=9)        # violation near the END
+    d = mkrun(base, "reg", "r1", ops[:12], pid=DEAD_PID)
+    daemon = OnlineDaemon(
+        store=Store(base),
+        # Checks permanently rate-deferred: the backlog never drains,
+        # so once pending >= the ingest bound the tail stops reading.
+        config=cfg(max_buffered_ops=8, rate_checks_per_s=1e-9,
+                   crash_quiet_s=3600))
+    daemon.tick()
+    t = daemon.tenants[("reg", "r1")]
+    write_wal(d / WAL_FILE, ops[12:], append=True)
+    daemon.tick()
+    assert daemon.stats["backpressure"] >= 1  # the bound really bit
+    assert len(t.ops) == 12                   # 28 ops still unread
+    daemon.cfg.crash_quiet_s = 0
+    t.last_growth = 0.0
+    daemon.tick()
+    assert t.status == "done"
+    v = json.loads((d / ONLINE_VERDICT).read_text())
+    assert v["ops"] == len(ops)               # ...but the drain won
+    assert t.result["valid"] is False
+    assert t.result["op"]["index"] == 35
+    daemon.close()
+
+
+def test_unknown_verdict_neither_latches_nor_persists(tmp_path):
+    """A host-engine "unknown" (config budget exhausted) carries no
+    information: no first-violation record, no latched invalid, not
+    journaled as decided — and the real final check still lands."""
+    base = tmp_path / "store"
+    d = mkrun(base, "reg", "r1", reg_ops(4), pid=os.getpid())
+    daemon = OnlineDaemon(
+        store=Store(base),
+        config=cfg(crash_quiet_s=60, max_w=0,    # every check sheds
+                   host_engine=lambda m, h: {"valid": "unknown"}))
+    daemon.tick()
+    t = daemon.tenants[("reg", "r1")]
+    assert t.stats["checks"] == 1
+    assert daemon.stats["unknown_verdicts"] == 1
+    assert t.valid_so_far is None
+    assert t.first_violation is None
+    assert not (d / FIRST_VIOLATION).exists()
+    assert t._decided == {}                   # undecided: retried on
+    assert t.checked_ops == 16                # restart, not hot-looped
+    # Finalization runs the real engine regardless of the shed path.
+    t.state.header = dict(t.state.header, pid=DEAD_PID)
+    daemon.cfg.crash_quiet_s = 0
+    t.last_growth = 0.0
+    daemon.tick()
+    assert t.status == "done" and t.result["valid"] is True
+    daemon.close()
+
+
+def test_rotation_then_daemon_restart_keeps_journal(tmp_path):
+    """The journal key binds to the segment (inode + header), not to
+    in-memory rotation counters: rotate, decide prefixes, restart the
+    daemon — the post-rotation journal must still resume."""
+    base = tmp_path / "store"
+    d = mkrun(base, "reg", "r1", reg_ops(2), pid=os.getpid(), seed=1)
+    d1 = OnlineDaemon(store=Store(base), config=cfg(crash_quiet_s=60))
+    d1.tick()
+    fresh = tmp_path / "w.new"
+    write_wal(fresh, reg_ops(3), pid=os.getpid(), seed=2)
+    os.replace(fresh, d / WAL_FILE)
+    d1.tick()                                 # rotation + new decide
+    assert d1.tenants[("reg", "r1")].checked_ops == 12
+    d1.close()
+    d2 = OnlineDaemon(store=Store(base), config=cfg(crash_quiet_s=60))
+    d2.tick()
+    t = d2.tenants[("reg", "r1")]
+    assert t.stats["resumed_prefixes"] == 1   # post-rotation row kept
+    assert t.stats["checks"] == 0
+    assert d2.stats["check_errors"] == 0
+    d2.close()
+
+
+def test_rotation_drops_stale_journal_and_violation(tmp_path):
+    """A WAL rotated under an ACTIVE daemon: the cursor resets, decided
+    prefixes keyed to the old content are discarded, and the OLD
+    segment's first-violation record (in-memory and durable) is voided
+    — the clean new segment must not badge invalid, and a real
+    violation in it must still be able to persist."""
+    base = tmp_path / "store"
+    d = mkrun(base, "reg", "r1", reg_ops(3, corrupt_read=1),
+              pid=os.getpid(), seed=1)
+    daemon = OnlineDaemon(store=Store(base),
+                          config=cfg(crash_quiet_s=60))
+    daemon.tick()
+    t = daemon.tenants[("reg", "r1")]
+    assert t.stats["checks"] == 1 and t.checked_ops == 12
+    assert t.valid_so_far is False
+    assert (d / FIRST_VIOLATION).exists()
+    fresh = tmp_path / "w.new"
+    write_wal(fresh, reg_ops(2), pid=os.getpid(), seed=2)
+    os.replace(fresh, d / WAL_FILE)
+    daemon.tick()
+    assert t.rotations == 1
+    assert t.checked_ops == 8 and t.valid_so_far is True
+    assert t.first_violation is None
+    assert not (d / FIRST_VIOLATION).exists()
+    assert daemon.stats["rotations"] == 1
+    daemon.close()
+
+
+def test_stale_final_verdict_rechecked_after_rotation(tmp_path):
+    """online-verdict.json is bound to its segment (inode): a WAL
+    swapped AFTER finalization re-checks on the next daemon instead of
+    serving a verdict about content that no longer exists."""
+    base = tmp_path / "store"
+    d = mkrun(base, "reg", "r1", reg_ops(2), pid=DEAD_PID, seed=1)
+    d1 = OnlineDaemon(store=Store(base), config=cfg())
+    d1.tick()
+    assert d1.tenants[("reg", "r1")].result["valid"] is True
+    d1.close()
+    fresh = tmp_path / "w.new"
+    write_wal(fresh, reg_ops(3, corrupt_read=2), pid=DEAD_PID, seed=2)
+    os.replace(fresh, d / WAL_FILE)
+    d2 = OnlineDaemon(store=Store(base), config=cfg())
+    d2.tick()
+    t = d2.tenants[("reg", "r1")]
+    assert t.status == "done"
+    assert t.result["valid"] is False     # the NEW segment's verdict
+    v = json.loads((d / ONLINE_VERDICT).read_text())
+    assert v["valid"] is False and v["ops"] == 12
+    d2.close()
+
+
+def test_headerless_dead_wal_retires_as_unknown(tmp_path):
+    """A writer killed inside the header fsync leaves an empty WAL:
+    nothing is salvageable, but the tenant must RETIRE with a durable
+    unknown verdict — never hang ``--until-idle`` or claim a pass —
+    and the unknown must survive restarts without latching invalid."""
+    base = tmp_path / "store"
+    d = base / "reg" / "r1"
+    d.mkdir(parents=True)
+    (d / WAL_FILE).touch()
+    daemon = OnlineDaemon(store=Store(base), config=cfg())
+    daemon.run(until_idle=True, ticks=10)
+    t = daemon.tenants[("reg", "r1")]
+    assert t.status == "done"
+    assert t.result["valid"] == "unknown"
+    assert t.valid_so_far is None
+    v = json.loads((d / ONLINE_VERDICT).read_text())
+    assert v["valid"] == "unknown" and v["unrecoverable"]
+    daemon.close()
+    d2 = OnlineDaemon(store=Store(base), config=cfg())
+    d2.tick()
+    t2 = d2.tenants[("reg", "r1")]
+    assert t2.status == "done" and t2.valid_so_far is None
+    assert d2.status()["valid"] is True    # unknown != invalid
+    d2.close()
+
+
+def test_bad_magic_rotation_voids_old_violation(tmp_path):
+    """A violating WAL replaced by a non-WAL file: the tenant drops,
+    but the old segment's first-violation record goes with it — the
+    path must not badge invalid forever over vanished content."""
+    base = tmp_path / "store"
+    d = mkrun(base, "reg", "r1", reg_ops(2, corrupt_read=1),
+              pid=os.getpid())
+    daemon = OnlineDaemon(store=Store(base),
+                          config=cfg(crash_quiet_s=60))
+    daemon.tick()
+    t = daemon.tenants[("reg", "r1")]
+    assert (d / FIRST_VIOLATION).exists()
+    fresh = tmp_path / "not-a-wal"
+    fresh.write_text('{"some": "other file"}\n')
+    os.replace(fresh, d / WAL_FILE)
+    daemon.tick()
+    assert t.status == "done"
+    assert t.first_violation is None
+    assert not (d / FIRST_VIOLATION).exists()
+    daemon.close()
+
+
+# ------------------------------------------------- multi-tenant behavior
+
+def test_two_tenants_interleaved_flush_cadences(tmp_path):
+    """Two writers with different group-commit cadences: an eager
+    flusher and a buffered HistoryWAL. The daemon sees exactly what
+    each has made durable, keeps per-tenant journals, and both reach
+    correct final verdicts."""
+    base = tmp_path / "store"
+    a_ops = reg_ops(5)
+    da = mkrun(base, "rega", "r1", a_ops[:8], pid=os.getpid())
+    db = base / "regb" / "r1"
+    db.mkdir(parents=True)
+    b_ops = index([o.with_() for o in reg_ops(4, corrupt_read=2)])
+    wal_b = HistoryWAL(db / WAL_FILE, header={"test": {"name": "regb"},
+                                              "seed": 3},
+                       flush_ms=1e9)        # buffered: fsync-on-demand
+    wal_b.stamp_phase("run")
+    for op in b_ops[:10]:
+        wal_b.append_op(op)                 # buffered — NOT durable
+    daemon = OnlineDaemon(store=Store(base),
+                          config=cfg(crash_quiet_s=60))
+    daemon.tick()
+    ta = daemon.tenants[("rega", "r1")]
+    tb = daemon.tenants[("regb", "r1")]
+    assert ta.checked_ops == 8              # eager writer: visible
+    assert len(tb.ops) == 0                 # buffered writer: not yet
+    wal_b.sync()                            # B's group commit lands
+    write_wal(da / WAL_FILE, a_ops[8:], append=True)
+    daemon.tick()
+    assert tb.checked_ops == 10 and tb.valid_so_far is False
+    assert ta.checked_ops == 20 and ta.valid_so_far is True
+    assert (da / ONLINE_JOURNAL).exists() and (db / ONLINE_JOURNAL).exists()
+    for op in b_ops[10:]:
+        wal_b.append_op(op)
+    wal_b.stamp_phase("analyzed")           # stamps force a sync
+    wal_b.close()
+    write_jsonl(da / "history.jsonl", index([o.with_() for o in a_ops]))
+    write_wal(da / WAL_FILE, [], append=True, analyzed=True)
+    daemon.tick()
+    assert ta.status == tb.status == "done"
+    assert ta.result["valid"] is True
+    assert tb.result["valid"] is False
+    daemon.close()
+
+
+def test_wclass_admission_sheds_to_host_oracle(tmp_path):
+    """Admission by W-class: a prefix whose peak pending window
+    exceeds max_w rides the exact host engine (shed counted), and the
+    verdict is still right."""
+    base = tmp_path / "store"
+    ops, idx = [], 0
+    for v in (1, 2, 3):                      # three CONCURRENT writers
+        op = invoke_op(v - 1, "write", v)
+        op.index = idx; idx += 1; ops.append(op)
+    for v in (1, 2, 3):
+        op = ok_op(v - 1, "write", v)
+        op.index = idx; idx += 1; ops.append(op)
+    tail = [invoke_op(0, "read", None), ok_op(0, "read", 3)]
+    for op in tail:
+        op.index = idx; idx += 1; ops.append(op)
+    mkrun(base, "wide", "r1", ops, pid=os.getpid())
+    daemon = OnlineDaemon(store=Store(base),
+                          config=cfg(max_w=2, crash_quiet_s=60,
+                                     check_interval_ops=2))
+    daemon.tick()
+    t = daemon.tenants[("wide", "r1")]
+    assert t.peak_w == 3
+    assert daemon.stats["shed_wclass"] >= 1
+    assert t.stats["host_checks"] >= 1
+    assert t.valid_so_far is True
+    daemon.close()
+
+
+def test_overload_ladder_degrades_and_recovers(tmp_path):
+    """A forced overload burst walks the ladder — widen, shed to host,
+    defer with a durable mark — and NO tenant's eventual verdict is
+    dropped."""
+    base = tmp_path / "store"
+    dirs = {}
+    for i, name in enumerate(("t0", "t1", "t2")):
+        dirs[name] = mkrun(base, name, "r1",
+                           reg_ops(3, corrupt_read=1 if i == 2 else None),
+                           pid=os.getpid(), seed=i)
+    daemon = OnlineDaemon(
+        store=Store(base),
+        config=cfg(check_interval_ops=2, crash_quiet_s=3600,
+                   overload_pending_ops=6, shed_pending_ops=12,
+                   defer_pending_ops=24, widen_factor=4))
+    lvl = daemon.tick()                      # 36 pending -> L3
+    assert lvl == 3
+    assert daemon.stats["deferred"] >= 1
+    marks = [d / ONLINE_DEFERRED for d in dirs.values()]
+    assert any(m.exists() for m in marks)    # the pause is durable
+    for _ in range(12):
+        daemon.tick()
+        if all(t.status == "tailing" and t.pending == 0
+               and len(t.ops) == 12
+               for t in daemon.tenants.values()):
+            break
+    assert daemon.stats["shed"] >= 1         # L2 engaged on the way
+    assert daemon.stats["resumed"] >= 1      # ...and recovered
+    assert not any(m.exists() for m in marks)
+    # Every tenant still converges to its correct verdict.
+    daemon.cfg.crash_quiet_s = 0
+    for t in daemon.tenants.values():
+        t.state.header = dict(t.state.header, pid=DEAD_PID)
+        t.last_growth = 0.0
+    for _ in range(4):
+        daemon.tick()
+        if daemon.idle():
+            break
+    vs = {k[0]: t.result["valid"]
+          for k, t in daemon.tenants.items()}
+    assert vs == {"t0": True, "t1": True, "t2": False}
+    daemon.close()
+
+
+def test_widen_rung_counts_and_defers_checks(tmp_path):
+    """L1 in isolation: a check due at the base cadence is deferred by
+    the widened interval (counted), then runs once the widened cadence
+    is met."""
+    base = tmp_path / "store"
+    d = mkrun(base, "reg", "r1", reg_ops(1) + reg_ops(1, start_index=4,
+                                                      start_value=1)[:3],
+              pid=os.getpid())               # 7 ops pending
+    daemon = OnlineDaemon(
+        store=Store(base),
+        config=cfg(check_interval_ops=2, crash_quiet_s=3600,
+                   overload_pending_ops=6, shed_pending_ops=100,
+                   defer_pending_ops=200, widen_factor=4))
+    assert daemon.tick() == 1
+    t = daemon.tenants[("reg", "r1")]
+    assert t.stats["checks"] == 0
+    assert daemon.stats["widened"] >= 1
+    op = ok_op(0, "write", 2)
+    op.index = 7
+    write_wal(d / WAL_FILE, [op], append=True)   # 8 >= widened interval
+    daemon.tick()
+    assert t.stats["checks"] == 1
+    daemon.close()
+
+
+# ------------------------------------------------- scheduler integration
+
+def test_sched_max_queue_backpressure_counted():
+    """JT_SCHED_MAX_QUEUE bounds the encode→dispatch hand-off: a
+    saturated pipeline flushes behind a counted backpressure event,
+    and verdicts are unchanged."""
+    model = cas_register()
+    hists = [index([o.with_() for o in reg_ops(4, corrupt_read=None,
+                                               start_value=i)])
+             for i in range(12)]
+    want = [r["valid"] for r in
+            check_batch_columnar(model, hists, details="invalid")]
+    k = "scheduler.backpressure_events{family=wgl}"
+    before = telemetry.REGISTRY.get(k) or 0
+    rs = check_batch_columnar(
+        model, hists, details="invalid", min_device_batch=1,
+        scheduler_opts={"max_queue": 1, "chunk_rows": 4, "depth": 1,
+                        "fuse_width": 4})
+    assert [r["valid"] for r in rs] == want
+    assert (telemetry.REGISTRY.get(k) or 0) > before
+
+
+def test_resident_state_shared_across_schedulers():
+    """ResidentState is the streaming entry's cross-batch memory: the
+    learned safe chunk sizes and awaited shapes of scheduler k are
+    scheduler k+1's starting point, for both families."""
+    from jepsen_tpu.ops.schedule import (BucketScheduler, GraphScheduler,
+                                         ResidentState)
+    rs = ResidentState()
+    s1 = BucketScheduler(resident=rs, prewarm=False,
+                         compilation_cache=False)
+    s1._safe_bp[(4, 2)] = 8
+    s1._awaited_shapes.add((4, 2, 2, 64))
+    s2 = BucketScheduler(resident=rs, prewarm=False,
+                         compilation_cache=False)
+    assert s2._safe_bp[(4, 2)] == 8
+    assert (4, 2, 2, 64) in s2._awaited_shapes
+    g = GraphScheduler(resident=rs, compilation_cache=False)
+    assert g._safe_bp is rs.safe_bp
+    assert rs.batches == 3
+
+
+# ------------------------------------------------------- web + lifecycle
+
+def test_live_view_badges_stalled_crashed_and_verdicts(tmp_path,
+                                                       monkeypatch):
+    """/live distinguishes stalled (alive writer, stale WAL —
+    $JT_LIVE_STALE_S) from crashed (pid gone), and surfaces the online
+    daemon's verdict-so-far / first-violation records."""
+    from jepsen_tpu.web import serve
+    monkeypatch.setenv("JT_LIVE_STALE_S", "5")
+    base = tmp_path / "store"
+    d_crash = mkrun(base, "tcrash", "r1", reg_ops(2), pid=DEAD_PID)
+    d_stall = mkrun(base, "tstall", "r1", reg_ops(2), pid=os.getpid())
+    old = time.time() - 600
+    os.utime(d_stall / WAL_FILE, (old, old))
+    mkrun(base, "tlive", "r1", reg_ops(2), pid=os.getpid())
+    (d_crash / FIRST_VIOLATION).write_text(
+        json.dumps({"op_index": 7, "prefix_ops": 8}))
+    store = Store(base)
+    store.save_online_registry(
+        {"tenants": {"tlive/r1": {"status": "tailing",
+                                  "valid_so_far": True,
+                                  "checked_ops": 8}}})
+    srv = serve(host="127.0.0.1", port=0, store=store)
+    try:
+        port = srv.server_address[1]
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/live", timeout=10).read().decode()
+    finally:
+        srv.shutdown()
+    assert 'badge-crashed">crashed' in page
+    assert 'badge-stalled">stalled' in page
+    assert 'badge-live">live' in page
+    assert "INVALID @ op 7" in page
+    assert "✓ so far (8 ops)" in page
+
+
+def test_watch_cli_until_idle(tmp_path, monkeypatch, capsys):
+    """``jepsen-tpu watch --until-idle``: finalizes the store's crashed
+    runs and exits 1 when any watched run is invalid."""
+    from jepsen_tpu import cli
+    monkeypatch.chdir(tmp_path)
+    mkrun(Path("store"), "reg", "r1", reg_ops(4, corrupt_read=2),
+          pid=DEAD_PID)
+    with pytest.raises(SystemExit) as e:
+        cli.main(["watch", "--until-idle", "--model", "cas",
+                  "--poll", "0.01", "--interval", "4"])
+    assert e.value.code == 1
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["valid"] is False
+    assert out["tenants"]["reg/r1"]["status"] == "done"
+    assert out["tenants"]["reg/r1"]["first_violation"] == 7
+
+
+def test_graceful_shutdown_two_signal_contract():
+    from jepsen_tpu.runtime import GracefulShutdown
+    gs = GracefulShutdown(signums=())
+    gs._handle(15, None)
+    assert gs.stop.is_set()
+    with pytest.raises(KeyboardInterrupt):
+        gs._handle(15, None)
