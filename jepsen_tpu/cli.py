@@ -765,6 +765,117 @@ def watch_cmd() -> dict:
     return {"watch": {"add_opts": add_opts, "run": run}}
 
 
+def fleet_cmd() -> dict:
+    """``fleet``: the campaign orchestrator (jepsen_tpu.fleet,
+    doc/fleet.md). Shards a campaign — synth seed sweep, store-wide
+    blind-sweep recheck, or fuzz rounds — across N worker processes
+    coordinated purely through lease files under
+    ``store/<name>/fleet/``: a SIGKILLed worker's leases expire and
+    its seeds redistribute to survivors with ZERO completed seeds
+    re-run, each unit is cost-routed to the cheapest capable backend,
+    and worker artifacts merge into one campaign-level results view
+    the web index renders as a single row. ``--resume`` continues a
+    killed campaign; ``--join DIR --worker-id W`` runs one worker
+    against an existing campaign dir (the multi-host entry: point it
+    at the same shared store). Exit 0 iff the campaign completed
+    valid."""
+    def add_opts(p):
+        p.add_argument("--join", default=None, metavar="DIR",
+                       help="Worker mode: process leases of an "
+                            "existing campaign dir "
+                            "(store/<name>/fleet) and exit when it "
+                            "completes")
+        p.add_argument("--worker-id", default=None,
+                       help="Worker name for --join (unique per "
+                            "worker; lease files carry it)")
+        p.add_argument("--name", default="fleet",
+                       help="Campaign name (store/<name>/fleet/ holds "
+                            "the work spec, leases, and summaries)")
+        p.add_argument("--kind", default="synth",
+                       choices=["synth", "recheck", "fuzz"])
+        p.add_argument("--seeds", type=int, default=None,
+                       help="Number of seed units (synth/fuzz kinds)")
+        p.add_argument("--seed", type=int, default=0,
+                       help="Base seed (units are seed..seed+N-1)")
+        p.add_argument("--workers", type=int, default=2,
+                       help="Local worker processes (0 = run one "
+                            "worker inline, no subprocess)")
+        p.add_argument("--resume", action="store_true", default=False,
+                       help="Continue a killed campaign: completed "
+                            "units rehydrate (zero re-run), in-flight "
+                            "seeds resume their journals")
+        p.add_argument("--model", default="cas",
+                       help="Checker family (linearizable families)")
+        p.add_argument("--test", default=None,
+                       help="recheck: the stored test to sweep")
+        p.add_argument("--synth", default="device",
+                       choices=["device", "numpy"])
+        p.add_argument("--histories", type=int, default=1024,
+                       help="Histories per seed unit (synth/fuzz)")
+        p.add_argument("--n-ops", dest="n_ops", type=int, default=40)
+        p.add_argument("--n-procs", dest="n_procs", type=int, default=5)
+        p.add_argument("--n-values", dest="n_values", type=int,
+                       default=5)
+        p.add_argument("--keys", dest="n_keys", type=int, default=1)
+        p.add_argument("--corrupt", type=float, default=0.0)
+        p.add_argument("--p-info", dest="p_info", type=float,
+                       default=0.0)
+        p.add_argument("--lease-ttl", dest="lease_ttl", type=float,
+                       default=None,
+                       help="Lease heartbeat staleness bound, seconds "
+                            "(default $JT_LEASE_TTL_S, 15)")
+        p.add_argument("--lease-chunk", dest="lease_chunk", type=int,
+                       default=None,
+                       help="Seeds per lease (takeover granularity)")
+
+    def run(opts):
+        import json as _json
+
+        from .fleet import fleet_campaign, fleet_worker
+
+        if opts.join:
+            if not opts.worker_id:
+                print("--join needs --worker-id")
+                return 254
+            summ = fleet_worker(opts.join, opts.worker_id)
+            print(_json.dumps(summ, default=str))
+            return 0
+        if opts.kind == "recheck" and not opts.test:
+            print("--kind recheck needs --test")
+            return 254
+        spec = None
+        seeds = None
+        if opts.kind in ("synth", "fuzz"):
+            if opts.seeds is None and not opts.resume:
+                print("--seeds N required (or --resume an existing "
+                      "campaign)")
+                return 254
+            if opts.seeds is not None:
+                from .ops.synth_device import SynthSpec
+                seeds = [opts.seed + i for i in range(opts.seeds)]
+                spec = SynthSpec(
+                    family="cas", n=opts.histories, seed=opts.seed,
+                    n_procs=opts.n_procs, n_ops=opts.n_ops,
+                    n_values=opts.n_values, n_keys=opts.n_keys,
+                    corrupt=opts.corrupt, p_info=opts.p_info)
+        out = fleet_campaign(
+            name=opts.name, kind=opts.kind, seeds=seeds, spec=spec,
+            model=opts.model, synth=opts.synth, test=opts.test,
+            workers=opts.workers, resume=opts.resume,
+            lease_chunk=opts.lease_chunk, lease_ttl=opts.lease_ttl)
+        line = {"valid": out["valid"], "complete": out["complete"],
+                "units": out["units"], "invalid": out["invalid"],
+                "workers": {w: s["units"]
+                            for w, s in out["workers"].items()},
+                "takeovers": out["leases"]["takeovers"],
+                "router": out["router"]["chosen"],
+                "dir": out.get("dir")}
+        print(_json.dumps(line, default=str))
+        return 0 if (out["valid"] is True and out["complete"]) else 1
+
+    return {"fleet": {"add_opts": add_opts, "run": run}}
+
+
 def trace_cmd() -> dict:
     """``trace --file trace.jsonl``: summarize / export a recorded
     span trace (the JSONL sink ``JT_TRACE=<path>`` streams — see
@@ -814,8 +925,8 @@ def trace_cmd() -> dict:
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
     run_cli({**suite_cmd(), **serve_cmd(), **recheck_cmd(),
-             **salvage_cmd(), **fuzz_cmd(), **trace_cmd(),
-             **watch_cmd()}, argv)
+             **salvage_cmd(), **fuzz_cmd(), **fleet_cmd(),
+             **trace_cmd(), **watch_cmd()}, argv)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,1062 @@
+"""Fleet campaign orchestrator: sharded multi-worker dispatch with
+cost-routed backends.
+
+Five PRs built single-process machinery — the streaming bucket
+scheduler, the resilience ladder, durable checkpoints, on-device
+synthesis, the online daemon. This layer turns them into a fleet:
+
+  * **Sharding.** A campaign (synth seed sweep, blind-sweep recheck of
+    a stored test, fuzz rounds) becomes a WORK SPEC file plus a lease
+    directory under ``store/<name>/fleet/``. Worker processes — local
+    subprocesses spawned by the orchestrator, or processes started by
+    hand on other hosts against the same (shared) store — claim seed
+    ranges by lease, heartbeat while working, and write one durable
+    summary per unit. Nothing but the filesystem coordinates them,
+    which is exactly what makes the same spec multi-host-ready.
+
+  * **Leases (the cluster-wide checkpoint).** The PR-5 durability
+    format is extended, not replaced: per-unit summaries
+    (``seed-<s>.json``, the exact ``run_synth_seeds`` artifact) and
+    per-seed ChunkJournals stay the completed-work record; the lease
+    files add WHO may produce them. A lease is claimed by exclusive
+    create, renewed by heartbeat, and expires when its heartbeat goes
+    stale (``JT_LEASE_TTL_S``) — a SIGKILLed worker's leases lapse and
+    survivors take them over at a bumped generation, skipping every
+    unit whose summary already landed: ZERO completed seeds re-run,
+    and the in-flight seed resumes its journal with zero re-dispatched
+    histories.
+
+  * **Cost-based routing.** Each checkable unit is priced against the
+    measured dispatch-overhead/op-model numbers the scheduler already
+    owns (ops/schedule.py): the fused device WGL scan at
+    ``2^W``/lane-rate, the MXU graph closure at ``mxu_op_model`` MACs,
+    the host oracle at its near-W-flat per-event rate. The router
+    sends each unit to the cheapest CAPABLE backend instead of the
+    fixed per-family path — wide sub-histories stop paying exponential
+    device frontiers when the host is cheaper, graph workloads stop
+    paying DFS when the MXU isn't, and long histories ride the
+    event-chunked kernel by the same arithmetic
+    (``BucketScheduler.event_route_events``).
+
+  * **Aggregation.** Workers write ordinary per-unit stores +
+    journals; ``merge_campaign`` folds them into one campaign-level
+    ``fleet/results.json`` and the orchestrator publishes a standard
+    run dir (``store/<name>/<ts>/results.json``) so the web index
+    renders the whole fleet as a single row with a ``fleet`` badge.
+
+``jepsen-tpu fleet`` (cli.py) is the operator surface; ``--join DIR
+--worker-id W`` runs one worker against an existing campaign dir (the
+multi-host entry). doc/fleet.md documents the model.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import shutil
+import socket
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import telemetry
+from .store import (FLEET_DIR, CampaignMismatch, ChunkJournal, Store,
+                    DEFAULT, atomic_write_json, spec_digest)
+
+log = logging.getLogger("jepsen.fleet")
+
+FLEET_MAGIC = "JTFLEET1"
+SPEC_FILE = "fleet.json"
+LEASES_DIR = "leases"
+RESULTS_FILE = "results.json"
+
+# Spec fields that IDENTIFY a campaign: a --resume against a dir whose
+# spec differs in any of these is a different campaign (refused, the
+# CampaignCheckpoint discipline). Worker count / TTLs may differ.
+IDENTITY_KEYS = ("fleet", "name", "kind", "model", "synth", "units",
+                 "spec", "test", "timestamps")
+
+
+def max_local_workers() -> int:
+    """$JT_FLEET_MAX_LOCAL_WORKERS: cap on worker processes spawned on
+    THIS host (0 = uncapped). Default: the host's core count — local
+    workers are CPU-bound jax processes, and oversubscribing them
+    regresses outright (the 2-core MULTICHIP_r07 probe measured 4
+    local workers at 0.92x of one); width beyond the cores belongs on
+    more hosts (``fleet --join``), not more processes."""
+    env = os.environ.get("JT_FLEET_MAX_LOCAL_WORKERS")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass
+    return os.cpu_count() or 1
+
+
+def lease_ttl_s() -> float:
+    """$JT_LEASE_TTL_S: heartbeat staleness past which a worker's
+    lease is forfeit and its seeds redistribute. Default 15 s — many
+    heartbeat periods (ttl/3), few enough that a killed worker's share
+    of a campaign restarts within seconds."""
+    try:
+        return max(0.5, float(os.environ.get("JT_LEASE_TTL_S", "15")))
+    except ValueError:
+        return 15.0
+
+
+# ------------------------------------------------------ cost-based router
+
+def router_rates() -> Dict[str, float]:
+    """The measured/assumed backend rates the router prices against.
+    ``lane_ops_per_s`` is the scheduler's dispatch-cost rate (the same
+    pessimism class the W-class DP and watchdog use);
+    ``host_s_per_event`` calibrates the host oracle's near-W-flat
+    per-event cost from the measured W15/W16 device/native crossover
+    (ops/linearize.py's wide-tail comment: ~0.4 s per ~1k-event row);
+    ``macs_per_s`` prices the MXU closure; ``graph_host_s_per_edge``
+    the host DFS. All env-overridable — a deployment that measures its
+    own crossover pins it, exactly like $JT_DISPATCH_OVERHEAD_US."""
+    from .ops.schedule import DISPATCH_COST_LANE_OPS_PER_S
+
+    def f(env, dflt):
+        try:
+            return float(os.environ.get(env, dflt))
+        except ValueError:
+            return float(dflt)
+
+    return {
+        "lane_ops_per_s": DISPATCH_COST_LANE_OPS_PER_S,
+        "host_s_per_event": f("JT_HOST_S_PER_EVENT", "4e-4"),
+        "macs_per_s": f("JT_GRAPH_MACS_PER_S", "1e12"),
+        "graph_host_s_per_edge": f("JT_GRAPH_HOST_S_PER_EDGE", "2e-6"),
+    }
+
+
+def pending_window(history) -> int:
+    """A history's peak pending window — the encoder's ``max_live``
+    rule (invokes allocate a slot, only ok-completions free it) as one
+    cheap host scan, no encode."""
+    from .history.ops import INVOKE, OK
+
+    live = peak = 0
+    for op in history:
+        if not op.is_client:
+            continue
+        if op.type == INVOKE:
+            live += 1
+            peak = max(peak, live)
+        elif op.type == OK:
+            live = max(0, live - 1)
+    return peak
+
+
+def estimate_w(history) -> int:
+    """The unit's POST-PARTITION W class: KV-valued histories strain
+    per key before encoding (ops.partition), so what the device will
+    actually pay is the widest per-key window, not the merged one."""
+    from .independent import history_keys, subhistory
+
+    keys = history_keys(history)
+    if not keys:
+        return pending_window(history)
+    return max(pending_window(subhistory(k, history)) for k in keys)
+
+
+def classify_history(history) -> str:
+    """Which checker family decides a unit: ``graph`` for histories
+    whose vocabulary lowers to dependency graphs (list-append /
+    adya-g2 — ops.graph.extract_graph's own sniff rule), ``wgl`` for
+    everything the linearizable frontier scan owns."""
+    fs = {op.f for op in history if op.is_client}
+    return "graph" if ("append" in fs or "insert" in fs) else "wgl"
+
+
+class CostRouter:
+    """Prices each checkable unit per backend and picks the cheapest
+    CAPABLE one. Units are (family, W-or-vertex-bucket, length); the
+    device terms amortize the measured per-dispatch overhead
+    (ops.schedule.measure_dispatch_overhead_us) over the rows that
+    would share the dispatch. Records every choice for the campaign's
+    router summary."""
+
+    #: W past which the packed frontier no longer fits any device
+    #: route (beyond the frontier-sharded mask axis) — the host oracle
+    #: is the only capable backend. $JT_ROUTER_MAX_W overrides.
+    MAX_DEVICE_W = 22
+
+    def __init__(self, rates: Optional[dict] = None,
+                 max_device_w: Optional[int] = None):
+        self.rates = {**router_rates(), **(rates or {})}
+        if max_device_w is not None:
+            self.max_device_w = int(max_device_w)
+        else:
+            try:
+                self.max_device_w = int(
+                    os.environ.get("JT_ROUTER_MAX_W", ""))
+            except ValueError:
+                self.max_device_w = self.MAX_DEVICE_W
+        self.chosen: Dict[str, int] = {}
+        self.est_cost_s: Dict[str, float] = {}
+
+    def _overhead_s(self) -> float:
+        from .ops.schedule import measure_dispatch_overhead_us
+        return measure_dispatch_overhead_us() * 1e-6
+
+    # ---------------------------------------------------------- pricing
+    def price_wgl(self, w: int, n_events: int,
+                  rows: int = 1) -> Dict[str, float]:
+        """Per-unit cost of a linearizable unit at post-partition
+        window ``w`` and ``n_events`` history lines: the device scan
+        pays 2^w frontier lanes per event plus its amortized dispatch
+        overhead; the host oracle's per-event cost is near W-flat."""
+        dev = (n_events * float(1 << min(int(w), 30))
+               / self.rates["lane_ops_per_s"]
+               + self._overhead_s() / max(int(rows), 1))
+        host = n_events * self.rates["host_s_per_event"]
+        return {"wgl-device": dev, "host-oracle": host}
+
+    def price_graph(self, n_vertices: int, n_edges: int,
+                    rows: int = 1) -> Dict[str, float]:
+        """Per-unit cost of a dependency-graph unit: the MXU closure
+        pays mxu_op_model MACs at the padded vertex bucket; the host
+        DFS is linear in vertices + edges."""
+        from .ops.graph import bucket_v, mxu_op_model
+        m = mxu_op_model(bucket_v(max(int(n_vertices), 1)))
+        dev = (m["macs"] / self.rates["macs_per_s"]
+               + self._overhead_s() / max(int(rows), 1))
+        host = ((n_vertices + n_edges)
+                * self.rates["graph_host_s_per_edge"])
+        return {"graph-device": dev, "graph-host": host}
+
+    def _record(self, backend: str, costs: Dict[str, float]) -> None:
+        self.chosen[backend] = self.chosen.get(backend, 0) + 1
+        self.est_cost_s[backend] = (self.est_cost_s.get(backend, 0.0)
+                                    + costs[backend])
+
+    def choose_wgl(self, w: int, n_events: int,
+                   rows: int = 1) -> Tuple[str, Dict[str, float]]:
+        costs = self.price_wgl(w, n_events, rows)
+        backend = ("host-oracle" if w > self.max_device_w
+                   else min(costs, key=costs.get))
+        self._record(backend, costs)
+        return backend, costs
+
+    def choose_graph(self, n_vertices: int, n_edges: int,
+                     rows: int = 1) -> Tuple[str, Dict[str, float]]:
+        costs = self.price_graph(n_vertices, n_edges, rows)
+        backend = min(costs, key=costs.get)
+        self._record(backend, costs)
+        return backend, costs
+
+    def wgl_check_kwargs(self, spec) -> dict:
+        """Scheduler knobs for a synth seed batch, cost-derived: the
+        wide-tail host crossover (``min_device_batch`` — rows below
+        which a W>=16 bucket's amortized dispatch overhead makes the
+        native host engine cheaper) comes out of the same arithmetic
+        instead of a caller-fixed constant. Post-partition, a cas
+        spec's per-key window is bounded by its process count and its
+        per-key event count by 2*n_ops/n_keys."""
+        from .ops.linearize import DATA_MAX_SLOTS
+        ev = max(1, 2 * spec.n_ops // max(spec.n_keys, 1))
+        w = min(spec.n_procs, spec.n_ops, self.max_device_w)
+        host_row = ev * self.rates["host_s_per_event"]
+        dev_row = (ev * float(1 << max(int(w), DATA_MAX_SLOTS))
+                   / self.rates["lane_ops_per_s"])
+        if dev_row >= host_row:
+            mdb = 4096                   # host beats the scan outright
+        else:
+            mdb = min(4096, max(1, int(self._overhead_s()
+                                       / max(host_row - dev_row, 1e-12))
+                                + 1))
+        return {"min_device_batch": mdb}
+
+    def table(self, ws=(4, 8, 12, 16, 18, 20),
+              events: int = 1000) -> List[dict]:
+        """The router cost table (doc/fleet.md, bench): per W, both
+        backends' prices and the winner — the crossover made visible."""
+        out = []
+        for w in ws:
+            costs = self.price_wgl(w, events)
+            backend = ("host-oracle" if w > self.max_device_w
+                       else min(costs, key=costs.get))
+            out.append({"W": w, "events": events, "backend": backend,
+                        **{k: round(v, 6) for k, v in costs.items()}})
+        return out
+
+    def summary(self) -> dict:
+        return {"chosen": dict(self.chosen),
+                "est_cost_s": {k: round(v, 6)
+                               for k, v in self.est_cost_s.items()},
+                "max_device_w": self.max_device_w,
+                "rates": self.rates}
+
+
+def route_check(model, histories: Sequence, *, router: Optional[
+        CostRouter] = None, details: str = "invalid") -> Tuple[
+            List[dict], dict]:
+    """Check a mixed corpus with every unit cost-routed: classify each
+    history (wgl vs graph family), price it, and dispatch each backend
+    group as one batch — fused device WGL
+    (ops.linearize.check_batch_columnar), MXU graph closure
+    (checkers.cycle.check_graphs_batch), or the host oracles. Returns
+    (per-history result dicts in input order, each tagged with its
+    ``backend``, and the routing summary). This is the fleet recheck
+    path's engine and the router-parity test seam."""
+    router = router if router is not None else CostRouter()
+    n = len(histories)
+    plan: List[Tuple[int, str]] = []
+    graphs: Dict[int, object] = {}
+    for i, h in enumerate(histories):
+        if classify_history(h) == "graph":
+            from .ops.graph import extract_graph
+            g = extract_graph(h)
+            graphs[i] = g
+            edges = sum(int(e.shape[0]) for e in g.edges.values())
+            backend, _ = router.choose_graph(g.n, edges)
+        else:
+            backend, _ = router.choose_wgl(estimate_w(h), len(h))
+        plan.append((i, backend))
+    groups: Dict[str, List[int]] = {}
+    for i, backend in plan:
+        groups.setdefault(backend, []).append(i)
+    results: List[Optional[dict]] = [None] * n
+
+    if groups.get("wgl-device"):
+        from .ops.linearize import check_batch_columnar
+        idx = groups["wgl-device"]
+        rs = check_batch_columnar(model, [histories[i] for i in idx],
+                                  details=details)
+        for i, r in zip(idx, rs):
+            results[i] = r
+    if groups.get("host-oracle"):
+        idx = groups["host-oracle"]
+        hs = [histories[i] for i in idx]
+        rs = None
+        try:
+            from .native import check_batch_native
+            rs = check_batch_native(model, hs)
+        except Exception:
+            rs = None
+        if rs is None:
+            from .checkers.linearizable import wgl_check
+            rs = [wgl_check(model, h) for h in hs]
+        for i, r in zip(idx, rs):
+            r.setdefault("provenance", "host-oracle")
+            results[i] = r
+    if groups.get("graph-device"):
+        from .checkers.cycle import check_graphs_batch
+        idx = groups["graph-device"]
+        rs = check_graphs_batch([graphs[i] for i in idx])
+        for i, r in zip(idx, rs):
+            results[i] = r
+    if groups.get("graph-host"):
+        from .ops.graph import check_graph_host
+        for i in groups["graph-host"]:
+            results[i] = check_graph_host(graphs[i],
+                                          provenance="host-oracle")
+    for (i, backend) in plan:
+        results[i]["backend"] = backend
+    routing = {"units": n,
+               "backends": {b: len(ix) for b, ix in groups.items()},
+               **router.summary()}
+    return results, routing  # type: ignore[return-value]
+
+
+# ------------------------------------------------------------- leases
+
+def _read_json(path) -> Optional[dict]:
+    try:
+        return json.loads(Path(path).read_text())
+    except Exception:
+        return None
+
+
+def _lease_path(cdir: Path, chunk_id: int) -> Path:
+    return cdir / LEASES_DIR / f"chunk-{chunk_id}.json"
+
+
+def _lease_payload(chunk_id: int, units, worker: str, gen: int,
+                   done: bool = False) -> dict:
+    return {"chunk": int(chunk_id), "units": [int(u) for u in units],
+            "worker": worker, "pid": os.getpid(),
+            "host": socket.gethostname(), "hb": time.time(),
+            "gen": int(gen), "done": bool(done)}
+
+
+def claim_chunk(cdir: Path, chunk_id: int, units, worker: str,
+                ttl: float) -> Optional[int]:
+    """Try to claim one seed-range lease. Returns the claimed
+    generation (0 = first owner, >0 = takeover of an expired lease) or
+    None when the chunk is done or someone else holds a live lease.
+    First claim is an atomic hard-link of a fully-written payload
+    (two fresh workers cannot both win, and no reader ever sees an
+    empty or partial lease file); takeover is atomic-replace at
+    generation+1 with a read-back — the loser of a takeover race sees
+    the other worker's record and walks away, and ownership is
+    re-verified before every unit (the heartbeat's ``lost`` flag), so
+    a stolen lease is abandoned at the next unit boundary."""
+    path = _lease_path(cdir, chunk_id)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    payload = _lease_payload(chunk_id, units, worker, 0)
+    # Worker id in the temp name: pids alone can collide across hosts
+    # on a shared store.
+    tmp = path.with_name(f"{path.name}.claim.{worker}.{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        os.link(tmp, path)
+        return 0
+    except FileExistsError:
+        pass
+    finally:
+        try:
+            tmp.unlink()
+        except FileNotFoundError:
+            pass
+    cur = _read_json(path)
+    if cur is None:
+        # Unreadable lease: only a crashed writer of a bygone era can
+        # leave one (claims land atomically above, renew/takeover use
+        # atomic_write_json) — but stay conservative and treat a
+        # RECENT file as live rather than stealing it.
+        try:
+            if time.time() - path.stat().st_mtime < ttl:
+                return None
+        except OSError:
+            return None
+        cur = {"gen": -1, "hb": 0.0}
+    if cur.get("done"):
+        return None
+    if cur.get("worker") == worker:
+        return int(cur.get("gen", 0))        # already ours (re-entry)
+    if time.time() - float(cur.get("hb", 0.0)) < ttl:
+        return None                          # live somewhere else
+    gen = int(cur.get("gen", 0)) + 1
+    atomic_write_json(path, _lease_payload(chunk_id, units, worker, gen))
+    back = _read_json(path)
+    if back and back.get("worker") == worker and \
+            int(back.get("gen", -1)) == gen:
+        telemetry.event("fleet.takeover", chunk=int(chunk_id),
+                        gen=gen)
+        return gen
+    return None
+
+
+def mark_done(cdir: Path, chunk_id: int, units, worker: str,
+              gen: int) -> None:
+    """Retire a completed chunk's lease — done leases never expire, so
+    no survivor wastes a takeover on finished work."""
+    path = _lease_path(cdir, chunk_id)
+    cur = _read_json(path)
+    if cur and cur.get("worker") == worker and \
+            int(cur.get("gen", -1)) == int(gen):
+        atomic_write_json(path, _lease_payload(chunk_id, units, worker,
+                                               gen, done=True))
+
+
+class LeaseHeartbeat:
+    """Renews a held lease every ttl/3 on a daemon thread; flips
+    ``lost`` (and stops renewing) the moment the on-disk record names
+    someone else — the worker's signal to abandon the chunk at the
+    next unit boundary instead of double-writing."""
+
+    def __init__(self, cdir: Path, chunk_id: int, units, worker: str,
+                 gen: int, ttl: float):
+        self.path = _lease_path(cdir, chunk_id)
+        self.chunk_id, self.units = chunk_id, units
+        self.worker, self.gen, self.ttl = worker, int(gen), float(ttl)
+        self.lost = threading.Event()
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True,
+                                   name=f"fleet-hb-{chunk_id}")
+
+    def start(self) -> "LeaseHeartbeat":
+        self._t.start()
+        return self
+
+    def _run(self) -> None:
+        period = max(0.1, self.ttl / 3.0)
+        while not self._stop.wait(period):
+            cur = _read_json(self.path)
+            if cur is None or cur.get("worker") != self.worker or \
+                    int(cur.get("gen", -1)) != self.gen:
+                self.lost.set()
+                return
+            atomic_write_json(self.path, _lease_payload(
+                self.chunk_id, self.units, self.worker, self.gen))
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# ----------------------------------------------------------- work spec
+
+def _chunk_map(ws: dict) -> Dict[int, List[int]]:
+    units = [int(u) for u in ws["units"]]
+    size = max(1, int(ws.get("lease_chunk", 1)))
+    return {k: units[i:i + size]
+            for k, i in enumerate(range(0, len(units), size))}
+
+
+def _summary_path(cdir: Path, unit: int) -> Path:
+    return cdir / f"seed-{int(unit)}.json"
+
+
+def _load_spec(cdir: Path) -> dict:
+    ws = _read_json(Path(cdir) / SPEC_FILE)
+    if ws is None:
+        raise FileNotFoundError(
+            f"{Path(cdir) / SPEC_FILE}: no fleet work spec — not a "
+            f"campaign dir (orchestrate one with `jepsen-tpu fleet`)")
+    if ws.get("fleet") != FLEET_MAGIC:
+        raise CampaignMismatch(
+            f"{Path(cdir) / SPEC_FILE}: not a {FLEET_MAGIC} work spec")
+    return ws
+
+
+def _resolve_model(name: str):
+    from .recheck import registry
+    spec = registry()[name]
+    assert spec["kind"] == "linear", \
+        f"fleet campaigns check linearizable families, not {name!r}"
+    return spec["model"]()
+
+
+def campaign_complete(cdir: Path, ws: Optional[dict] = None,
+                      seen: Optional[set] = None) -> bool:
+    """Every unit durably summarized. ``seen`` memoizes units already
+    observed complete — a summary never disappears once written, so
+    pollers (the worker claim loop, the orchestrator's babysit loop)
+    pass a per-process set and only re-stat the shrinking remainder
+    instead of O(units) metadata round trips per poll (a real cost on
+    the multi-host shared-store path)."""
+    ws = ws if ws is not None else _load_spec(cdir)
+    cdir = Path(cdir)
+    for u in ws["units"]:
+        u = int(u)
+        if seen is not None and u in seen:
+            continue
+        if _summary_path(cdir, u).exists():
+            if seen is not None:
+                seen.add(u)
+            continue
+        return False
+    return True
+
+
+# -------------------------------------------------------------- worker
+
+def fleet_worker(campaign_dir, worker_id: str, *,
+                 stop: Optional[threading.Event] = None) -> dict:
+    """One worker's whole life against a campaign dir: claim leases,
+    process their units (skipping any unit whose summary already
+    landed — the zero-re-run invariant at the finest grain), heartbeat
+    while working, take over expired leases, and exit once the
+    campaign is complete. Writes ``worker-<id>.json`` (unit counts,
+    router summary, telemetry counter deltas) on the way out."""
+    cdir = Path(campaign_dir)
+    ws = _load_spec(cdir)
+    ttl = float(ws.get("lease_ttl_s") or lease_ttl_s())
+    chunks = _chunk_map(ws)
+    router = CostRouter()
+    tel_base = telemetry.snapshot()
+    seen: set = set()           # units observed complete (memoized)
+    stats = {"worker": worker_id, "chunks": 0, "units": 0,
+             "rehydrated": 0, "takeovers": 0, "abandoned": 0,
+             "errors": 0}
+    test_sleep = 0.0
+    try:
+        test_sleep = float(os.environ.get("JT_FLEET_TEST_SLEEP_S", "0"))
+    except ValueError:
+        pass
+
+    def chunk_done(units) -> bool:
+        for u in units:
+            u = int(u)
+            if u in seen:
+                continue
+            if _summary_path(cdir, u).exists():
+                seen.add(u)
+                continue
+            return False
+        return True
+
+    with telemetry.span("fleet.worker", worker=worker_id):
+        while not (stop is not None and stop.is_set()):
+            claimed_any = False
+            for k, units in chunks.items():
+                if stop is not None and stop.is_set():
+                    break
+                if chunk_done(units):
+                    continue
+                gen = claim_chunk(cdir, k, units, worker_id, ttl)
+                if gen is None:
+                    continue
+                claimed_any = True
+                stats["chunks"] += 1
+                if gen > 0:
+                    stats["takeovers"] += 1
+                    log.info("worker %s took over chunk %d at "
+                             "generation %d (previous lease expired)",
+                             worker_id, k, gen)
+                hb = LeaseHeartbeat(cdir, k, units, worker_id, gen,
+                                    ttl).start()
+                try:
+                    finished = _process_chunk(
+                        cdir, ws, units, worker_id, hb, router, stats,
+                        stop, test_sleep)
+                finally:
+                    hb.stop()
+                if finished and not hb.lost.is_set():
+                    mark_done(cdir, k, units, worker_id, gen)
+                elif hb.lost.is_set():
+                    stats["abandoned"] += 1
+                    log.warning("worker %s lost chunk %d's lease "
+                                "mid-flight; abandoning it cleanly",
+                                worker_id, k)
+            if campaign_complete(cdir, ws, seen=seen):
+                break
+            if not claimed_any:
+                # Everything left is leased to live workers: wait for
+                # them to finish — or for their heartbeats to lapse.
+                if stop is not None and stop.wait(
+                        min(1.0, ttl / 3.0)):
+                    break
+                if stop is None:
+                    time.sleep(min(1.0, ttl / 3.0))
+    summary = {**stats, "router": router.summary(),
+               "telemetry": telemetry.counters_delta(
+                   tel_base, telemetry.snapshot())}
+    atomic_write_json(cdir / f"worker-{worker_id}.json", summary)
+    return summary
+
+
+def _process_chunk(cdir: Path, ws: dict, units, worker_id: str,
+                   hb: LeaseHeartbeat, router: CostRouter, stats: dict,
+                   stop, test_sleep: float) -> bool:
+    """Run one leased chunk's units. Returns True iff every unit ended
+    summarized; ownership is re-checked before each unit so a stolen
+    lease abandons at the next boundary."""
+    for u in units:
+        if (stop is not None and stop.is_set()) or hb.lost.is_set():
+            return False
+        spath = _summary_path(cdir, u)
+        if spath.exists():
+            stats["rehydrated"] += 1
+            continue
+        finish = None
+        try:
+            summ, finish = _run_unit(cdir, ws, int(u), router)
+        except Exception as e:          # noqa: BLE001 — unit-scoped
+            # One failing unit must not wedge the whole fleet in a
+            # claim/crash loop: the error IS the unit's durable result
+            # (valid: unknown), visible in the merge.
+            log.warning("fleet unit %s failed: %s", u, e,
+                        exc_info=True)
+            stats["errors"] += 1
+            summ = {"error": f"{type(e).__name__}: {e}"}
+        summ["worker"] = worker_id
+        atomic_write_json(spath, summ)
+        if finish is not None:
+            # Journal cleanup strictly AFTER the summary lands (the
+            # run_synth_seeds order): a kill between finish and the
+            # summary write would leave neither, and the takeover
+            # would re-dispatch the whole seed.
+            finish()
+        stats["units"] += 1
+        telemetry.REGISTRY.counter("fleet.units").inc()
+        if test_sleep:
+            time.sleep(test_sleep)
+    return True
+
+
+def _run_unit(cdir: Path, ws: dict, unit: int,
+              router: CostRouter) -> tuple:
+    """Execute one work unit by campaign kind; returns (summary,
+    cleanup-or-None) — the cleanup (journal finish) runs only AFTER
+    the caller lands the summary durably, preserving the
+    zero-re-dispatch window. ``synth`` re-uses the exact per-seed
+    engine run_synth_seeds runs (runtime.synth_seed_summary under a
+    spec-keyed ChunkJournal) — fleet and single-process campaigns
+    produce field-for-field identical summaries by construction.
+    ``recheck`` cost-routes the stored run's history; ``fuzz`` runs
+    one witness-guided round."""
+    kind = ws["kind"]
+    with telemetry.span("fleet.unit", kind=kind, unit=unit):
+        if kind == "synth":
+            from .ops.synth_device import SynthSpec
+            from .runtime import synth_seed_summary
+            spec = SynthSpec(**ws["spec"])
+            sspec = dataclasses.replace(spec, seed=int(unit))
+            model = _resolve_model(ws["model"])
+            # Record the batch-level routing decision (post-partition
+            # W bound, per-key event count, the whole batch amortizing
+            # one dispatch) alongside the knobs it derives.
+            router.choose_wgl(min(spec.n_procs, spec.n_ops),
+                              max(1, 2 * spec.n_ops
+                                  // max(spec.n_keys, 1)),
+                              rows=spec.n)
+            journal = ChunkJournal(
+                cdir / f"seed-{unit}.journal.jsonl",
+                {"spec": spec_digest(sspec, synth=ws["synth"])},
+                resume=True)
+            check_kwargs = router.wgl_check_kwargs(sspec)
+            try:
+                summ = synth_seed_summary(
+                    model, sspec, synth=ws["synth"], journal=journal,
+                    check_kwargs=check_kwargs)
+            finally:
+                journal.close()
+            summ["router"] = check_kwargs
+            return summ, journal.finish
+        if kind == "fuzz":
+            from .fuzz import fuzz_campaign
+            from .ops.synth_device import SynthSpec
+            spec = SynthSpec(**ws["spec"])
+            # Units ARE absolute seeds (the synth-kind contract);
+            # fold the unit in as THE round seed, not an offset on
+            # top of spec.seed.
+            rspec = dataclasses.replace(spec, seed=int(unit))
+            out = fuzz_campaign(
+                rspec, rounds=1, synth=ws["synth"],
+                neighborhood=int(ws.get("neighborhood", 4)),
+                max_witnesses=int(ws.get("max_witnesses", 8)),
+                name=None)
+            return {k: out[k] for k in
+                    ("checked", "invalid", "neighborhoods",
+                     "neighborhood_invalid", "disagreements")}, None
+        if kind == "recheck":
+            ts = ws["timestamps"][int(unit)]
+            root = Store(Path(ws["store_base"]))
+            loaded = root.load(ws["test"], ts)
+            h = loaded.get("history")
+            if h is None:
+                return {"ts": ts, "valid": "unknown",
+                        "error": "no stored history"}, None
+            model = _resolve_model(ws["model"])
+            rs, routing = route_check(model, [h], router=router)
+            return {"ts": ts, "valid": rs[0].get("valid"),
+                    "backend": rs[0].get("backend"),
+                    "backends": routing["backends"]}, None
+        raise ValueError(f"unknown fleet kind {kind!r}")
+
+
+# --------------------------------------------------------- aggregation
+
+def _unit_valid(kind: str, summ: dict):
+    if "error" in summ:
+        return "unknown"
+    if kind == "synth":
+        return summ.get("invalid", 0) == 0
+    if kind == "fuzz":
+        # Finding invalid histories is the fuzz working; a checker
+        # DISAGREEMENT is the alarm (the fuzz_cmd exit contract).
+        return summ.get("disagreements", 0) == 0
+    return summ.get("valid")
+
+
+def merge_campaign(campaign_dir) -> dict:
+    """Fold every worker's durable artifacts into the one campaign
+    verdict: per-unit summaries, worker summaries (router choices +
+    telemetry counter deltas, summed), and the lease ledger (chunks,
+    takeover generations). Persisted as ``fleet/results.json``; the
+    orchestrator additionally publishes it as a standard run dir so
+    the web index renders the fleet as a single view."""
+    from .checkers.core import merge_valid
+
+    cdir = Path(campaign_dir)
+    ws = _load_spec(cdir)
+    kind = ws["kind"]
+    units, missing, invalid = {}, [], 0
+    for u in ws["units"]:
+        summ = _read_json(_summary_path(cdir, u))
+        if summ is None:
+            missing.append(int(u))
+            continue
+        summ["valid"] = _unit_valid(kind, summ)
+        units[str(u)] = summ
+        if "invalid" in summ:
+            # synth/fuzz: invalid HISTORIES found (workload signal).
+            invalid += int(summ["invalid"] or 0)
+        elif summ["valid"] is False:
+            # recheck: one invalid stored run per failing unit — the
+            # counter must agree with the merged verdict.
+            invalid += 1
+    workers, chosen, est = {}, {}, {}
+    wsums = []
+    for wf in sorted(cdir.glob("worker-*.json")):
+        wsum = _read_json(wf) or {}
+        wsums.append(wsum)
+        wid = wsum.get("worker", wf.stem)
+        workers[wid] = {k: wsum.get(k, 0) for k in
+                        ("chunks", "units", "rehydrated", "takeovers",
+                         "abandoned", "errors")}
+        r = wsum.get("router") or {}
+        for k, v in (r.get("chosen") or {}).items():
+            chosen[k] = chosen.get(k, 0) + v
+        for k, v in (r.get("est_cost_s") or {}).items():
+            est[k] = round(est.get(k, 0.0) + v, 6)
+    leases = {"chunks": 0, "done": 0, "takeovers": 0}
+    for lf in sorted((cdir / LEASES_DIR).glob("chunk-*.json")) \
+            if (cdir / LEASES_DIR).exists() else []:
+        le = _read_json(lf) or {}
+        leases["chunks"] += 1
+        leases["done"] += bool(le.get("done"))
+        leases["takeovers"] += max(0, int(le.get("gen", 0)))
+    complete = not missing
+    valid = merge_valid(u["valid"] for u in units.values()) \
+        if units else True
+    if not complete:
+        valid = "unknown" if valid is True else valid
+    out = {"name": ws["name"], "kind": kind, "valid": valid,
+           "created": ws.get("created"),
+           "complete": complete, "units": len(ws["units"]),
+           "missing": missing, "invalid": invalid, "seeds": units,
+           "router": {"chosen": chosen, "est_cost_s": est,
+                      "table": CostRouter().table()},
+           "workers": workers, "leases": leases,
+           "telemetry": {"source": "fleet",
+                         "counters": telemetry.merge_counter_snapshots(
+                             w.get("telemetry") for w in wsums)}}
+    atomic_write_json(cdir / RESULTS_FILE, out)
+    return out
+
+
+def publish_campaign(root: Store, name: str, merged: dict) -> Path:
+    """One campaign-level run dir (``store/<name>/<ts>/``) carrying
+    the merged verdict: the web index renders the whole fleet as a
+    single row (with a ``fleet`` badge) exactly like any other run.
+    Idempotent per campaign: a re-merge (e.g. ``--resume`` on a
+    completed campaign) refreshes the run dir already published for
+    this campaign's ``created`` stamp instead of adding a duplicate
+    row."""
+    from .store import StoreHandle
+
+    h = None
+    for ts in root.tests().get(name, []):
+        prior = root._run_json(name, ts, "results.json") or {}
+        if (prior.get("fleet") or {}).get("created") is not None and \
+                prior["fleet"]["created"] == merged.get("created"):
+            h = StoreHandle(root.run_dir(name, ts), store=root,
+                            test_name=name)
+            break
+    if h is None:
+        h = root.create(name)
+    h.write_json("test.json", {
+        "name": name, "fleet": True, "kind": merged["kind"],
+        "units": merged["units"]})
+    h.save_results({"valid": merged["valid"], "fleet": merged})
+    return h.dir
+
+
+# --------------------------------------------------------- orchestrator
+
+def _spawn_worker(campaign_dir: Path, worker_id: str):
+    """One local worker subprocess against the campaign dir — the
+    same entry a remote host would run by hand (``jepsen-tpu fleet
+    --join DIR --worker-id W``). Workers get their own (small) virtual
+    device env: $JT_FLEET_WORKER_DEVICES, default 1 — fleet
+    parallelism is across processes, not within them."""
+    import subprocess
+    import sys
+
+    from .provision import virtual_cpu_env
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (str(repo) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    try:
+        devs = int(os.environ.get("JT_FLEET_WORKER_DEVICES", "1"))
+    except ValueError:
+        devs = 1
+    if devs > 0:
+        virtual_cpu_env(devs, env=env)
+    logf = open(Path(campaign_dir) / f"worker-{worker_id}.log", "ab")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.cli", "fleet",
+         "--join", str(campaign_dir), "--worker-id", worker_id],
+        env=env, stdout=logf, stderr=subprocess.STDOUT)
+    p._jt_log = logf        # closed on reap
+    return p
+
+
+def fleet_campaign(*, name: str = "fleet", kind: str = "synth",
+                   seeds: Optional[Sequence[int]] = None, spec=None,
+                   model: str = "cas", synth: str = "device",
+                   test: Optional[str] = None,
+                   timestamps: Optional[Sequence[str]] = None,
+                   workers: int = 2, store_root: Optional[Store] = None,
+                   resume: bool = False,
+                   lease_chunk: Optional[int] = None,
+                   lease_ttl: Optional[float] = None,
+                   neighborhood: int = 4, max_witnesses: int = 8,
+                   poll_s: float = 0.25,
+                   max_respawns: Optional[int] = None,
+                   stop: Optional[threading.Event] = None) -> dict:
+    """Orchestrate one fleet campaign end to end: write the work spec,
+    spawn ``workers`` local worker processes (0 = run one worker
+    inline, no subprocess), monitor them — a worker that dies while
+    units remain is respawned (bounded), and a killed worker's leases
+    expire under the survivors anyway — then merge every durable
+    artifact into the campaign verdict and publish it as a standard
+    run dir. ``resume=True`` continues a killed campaign: completed
+    units rehydrate from their summaries (zero re-run), in-flight
+    seeds resume their journals (zero re-dispatched histories).
+
+    ``kind``: ``synth`` shards a run_synth_seeds-shaped seed campaign;
+    ``recheck`` shards a store-wide blind-sweep recheck of ``test``'s
+    stored runs (units are timestamp indices, each cost-routed);
+    ``fuzz`` shards witness-guided fuzz rounds."""
+    root = store_root if store_root is not None else DEFAULT
+    base = Path(root.base).resolve()
+    cdir = base / name / FLEET_DIR
+
+    if kind == "recheck":
+        assert test, "recheck campaigns name a stored --test"
+        if timestamps is None:
+            timestamps = root.tests().get(test, [])
+        units = list(range(len(timestamps)))
+    else:
+        assert spec is not None or resume, \
+            f"{kind} campaigns need a SynthSpec"
+        units = [int(s) for s in seeds] if seeds is not None else None
+
+    existing = _read_json(cdir / SPEC_FILE)
+    if resume:
+        if existing is None or existing.get("fleet") != FLEET_MAGIC:
+            raise FileNotFoundError(
+                f"--resume: no fleet campaign at {cdir}")
+        if units is not None or spec is not None:
+            fresh = _work_spec(name, kind, units, spec, model, synth,
+                               test, timestamps, base, lease_chunk,
+                               lease_ttl, neighborhood, max_witnesses,
+                               workers)
+            bad = [k for k in IDENTITY_KEYS
+                   if k in fresh and fresh.get(k) != existing.get(k)]
+            if bad:
+                raise CampaignMismatch(
+                    f"fleet campaign {cdir} differs on {bad}; start a "
+                    f"fresh campaign (without --resume) to replace it")
+        ws = existing
+    else:
+        if cdir.exists():
+            shutil.rmtree(cdir)
+        cdir.mkdir(parents=True, exist_ok=True)
+        (cdir / LEASES_DIR).mkdir(exist_ok=True)
+        ws = _work_spec(name, kind, units, spec, model, synth, test,
+                        timestamps, base, lease_chunk, lease_ttl,
+                        neighborhood, max_witnesses, workers)
+        atomic_write_json(cdir / SPEC_FILE, ws)
+
+    # Local pool width: capped at the host's cores by default
+    # (JT_FLEET_MAX_LOCAL_WORKERS) — oversubscribed local jax workers
+    # REGRESS; width beyond the cores belongs on more hosts (--join).
+    cap = max_local_workers()
+    spawn_n = min(workers, cap) if (workers > 0 and cap) else workers
+    if 0 < spawn_n < workers:
+        log.info("capping local fleet pool at %d worker(s) "
+                 "(%d requested, %s cores; join more hosts for more "
+                 "width, or set JT_FLEET_MAX_LOCAL_WORKERS=0)",
+                 spawn_n, workers, os.cpu_count())
+    sp = telemetry.begin("fleet.campaign", name=name, kind=kind,
+                         units=len(ws["units"]), workers=spawn_n)
+    try:
+        if not campaign_complete(cdir, ws):
+            if spawn_n <= 0:
+                fleet_worker(cdir, "w0", stop=stop)
+            else:
+                _drive_workers(cdir, ws, spawn_n, poll_s,
+                               max_respawns, stop)
+    finally:
+        sp.end()
+    merged = merge_campaign(cdir)
+    merged["requested_workers"] = workers
+    merged["spawned_workers"] = spawn_n if spawn_n > 0 else 1
+    merged["dir"] = str(publish_campaign(root, name, merged))
+    return merged
+
+
+def _work_spec(name, kind, units, spec, model, synth, test, timestamps,
+               base, lease_chunk, lease_ttl, neighborhood,
+               max_witnesses, workers) -> dict:
+    if lease_chunk is None:
+        # Several chunks per worker: takeover granularity (what a dead
+        # worker forfeits) vs lease traffic.
+        lease_chunk = max(1, len(units or ())
+                          // max(4 * max(workers, 1), 1))
+    return {
+        "fleet": FLEET_MAGIC, "name": name, "kind": kind,
+        "model": model, "synth": synth,
+        "units": [int(u) for u in (units or ())],
+        "spec": (dataclasses.asdict(spec) if spec is not None
+                 else None),
+        "test": test,
+        "timestamps": list(timestamps) if timestamps else None,
+        "store_base": str(base),
+        "lease_chunk": int(lease_chunk),
+        "lease_ttl_s": float(lease_ttl if lease_ttl is not None
+                             else lease_ttl_s()),
+        "neighborhood": int(neighborhood),
+        "max_witnesses": int(max_witnesses),
+        "created": time.time(),
+    }
+
+
+def _drive_workers(cdir: Path, ws: dict, workers: int, poll_s: float,
+                   max_respawns: Optional[int], stop) -> None:
+    """Spawn + babysit the local worker pool until the campaign
+    completes. Lease expiry already redistributes a dead worker's
+    units to survivors; respawning (bounded) just restores pool
+    width — and is the only recovery when EVERY worker died."""
+    procs = {}
+    spawned = 0
+    seen: set = set()            # memoized completed units (per poll)
+    for i in range(workers):
+        wid = f"w{i}"
+        procs[wid] = _spawn_worker(cdir, wid)
+        spawned += 1
+    budget = workers if max_respawns is None else int(max_respawns)
+    try:
+        while True:
+            if campaign_complete(cdir, ws, seen=seen):
+                break
+            if stop is not None and stop.is_set():
+                break
+            dead = [wid for wid, p in procs.items()
+                    if p.poll() is not None]
+            for wid in dead:
+                p = procs.pop(wid)
+                getattr(p, "_jt_log", None) and p._jt_log.close()
+                if p.returncode != 0:
+                    log.warning("fleet worker %s exited rc=%s", wid,
+                                p.returncode)
+                if not campaign_complete(cdir, ws, seen=seen) \
+                        and budget > 0:
+                    budget -= 1
+                    nid = f"w{spawned}"
+                    spawned += 1
+                    log.info("respawning fleet worker (%s -> %s)",
+                             wid, nid)
+                    procs[nid] = _spawn_worker(cdir, nid)
+            if not procs:
+                if campaign_complete(cdir, ws, seen=seen):
+                    break
+                raise RuntimeError(
+                    "every fleet worker exited with units remaining "
+                    "and the respawn budget exhausted; see "
+                    f"{cdir}/worker-*.log")
+            time.sleep(poll_s)
+    finally:
+        for p in procs.values():
+            try:
+                p.wait(timeout=max(5.0, 3 * float(
+                    ws.get("lease_ttl_s", 15.0))))
+            except Exception:
+                p.kill()
+                p.wait()
+            getattr(p, "_jt_log", None) and p._jt_log.close()

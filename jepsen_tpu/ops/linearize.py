@@ -559,11 +559,14 @@ def run_encoded_batch(batch: EncodedBatch, return_frontier: bool = False):
                 f"window W={batch.W} needs {D} frontier devices")
     else:
         mesh = production_mesh(1)
-        if mesh is not None and \
-                batch.batch >= mesh.shape["data"] * MIN_ROWS_PER_DEVICE:
+        from ..parallel.mesh import should_shard
+        if should_shard(batch.batch, mesh):
             pending = _dispatch_sharded("dataN", batch, mesh,
                                         return_frontier)
         else:
+            # Sub-minimum sharding (rows/device below the
+            # $JT_SHARD_MIN_ROWS floor) regresses — MULTICHIP_r06's
+            # 4/8-device points — so thin batches stay on one device.
             pending = _data1_dispatch(batch, return_frontier)
 
     valids, bads, fronts = [], [], []

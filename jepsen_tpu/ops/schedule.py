@@ -88,7 +88,7 @@ from .faults import (CorruptOutput, FaultInjector, WatchdogExpired,
                      classify_failure, corrupt_arrays, validate_decoded)
 from .linearize import (DATA_MAX_SLOTS, DISPATCH_LOG, INT32_MAX,
                         KERNEL_SHAPE_LOG, MAX_FRONTIER_ELEMENTS,
-                        MIN_ROWS_PER_DEVICE, WindowOverflow,
+                        WindowOverflow,
                         get_fused_kernel, get_kernel, log_kernel_shapes,
                         n_state_words, production_mesh, run_encoded_batch,
                         run_event_chunked, vpu_op_model)
@@ -155,6 +155,29 @@ def sched_max_queue() -> int:
         log.warning("ignoring malformed JT_SCHED_MAX_QUEUE=%r "
                     "(want an integer >= 0)", env)
         return 0
+
+
+def event_route_min_events() -> int:
+    """$JT_EVENT_ROUTE_EVENTS: event-axis length at which a narrow
+    bucket routes through the event-chunked resume kernel BY COST
+    instead of reaching it only as the post-OOM-bisection fallback.
+    The crossover is measured, not derived: the r05 10k-op probe
+    (651/s monolithic) showed the one-shot scan's per-event cost
+    climbing with history length — a 100k-step scan is one giant XLA
+    program whose compile and working set grow with N, while carried
+    ``EVENT_CHUNK``-step dispatches keep one small compiled shape and
+    double-buffer uploads under the scan for free. Default 8192
+    (~4 event chunks — below that the extra per-chunk dispatch
+    overhead outweighs the win); 0 disables the route."""
+    env = os.environ.get("JT_EVENT_ROUTE_EVENTS")
+    if env is None:
+        return 8192
+    try:
+        return max(0, int(env))
+    except ValueError:
+        log.warning("ignoring malformed JT_EVENT_ROUTE_EVENTS=%r "
+                    "(want an integer >= 0)", env)
+        return 8192
 
 
 # In-flight chunk budget: 2 = classic double buffering (host pads k+1,
@@ -756,6 +779,7 @@ class BucketScheduler:
                  fuse_width: Optional[int] = None,
                  shard_min_rows: Optional[int] = None,
                  max_queue: Optional[int] = None,
+                 event_route_events: Optional[int] = None,
                  resident: Optional[ResidentState] = None):
         self.return_frontier = return_frontier
         self.max_classes = (DEFAULT_MAX_CLASSES if max_classes is None
@@ -786,6 +810,13 @@ class BucketScheduler:
         # (data devices * MIN_ROWS_PER_DEVICE); dispatch-latency-bound
         # callers (and the hermetic partition tests) raise it.
         self.shard_min_rows = shard_min_rows
+        # Long-history cost route: narrow buckets whose event axis
+        # meets this length dispatch through the event-chunked resume
+        # kernel (run_event_chunked) by COST MODEL — the measured
+        # long-scan crossover — rather than only as the OOM fallback.
+        self.event_route_events = (
+            event_route_min_events() if event_route_events is None
+            else max(0, int(event_route_events)))
         self.on_chunk = on_chunk
         if compilation_cache:
             enable_compilation_cache()
@@ -839,6 +870,7 @@ class BucketScheduler:
             "oom_events": 0, "corrupt_chunks": 0, "quarantined_rows": 0,
             "prewarm_wedged": 0, "abandoned_buckets": 0,
             "faults_injected": 0, "backpressure_events": 0,
+            "event_routed_rows": 0, "event_routed_dispatches": 0,
         }
         self._t0 = None
         self._first_dispatch_t = None
@@ -1431,6 +1463,32 @@ class BucketScheduler:
                 self.on_chunk(run.batch, lo, hi, v, b, fr)
             run.collect(v, b, fr)
 
+    def _run_event_routed(self, mb: EncodedBatch):
+        """Cost-routed long-history dispatch: the whole bucket runs
+        through the event-chunked resume kernel (carried frontier,
+        EVENT_CHUNK-step dispatches, uploads double-buffered under the
+        scan). One attempt — any classified failure returns None and
+        the bucket falls through to the standard chunked pipeline,
+        whose full degradation ladder is the retry."""
+        n_disp = -(-mb.n_events // EVENT_CHUNK)
+        try:
+            with telemetry.span("dispatch", cat="device",
+                                route="event-chunked", V=mb.V, W=mb.W,
+                                rows=mb.batch, events=mb.n_events):
+                out = self._exec_event_chunked(mb, 0, mb.batch)
+        except Exception as e:
+            if classify_failure(e) is None:
+                raise
+            log.warning("event-chunked route failed for bucket "
+                        "(V=%s, W=%s, %s rows, %s events): %s; "
+                        "falling back to the standard chunk pipeline",
+                        mb.V, mb.W, mb.batch, mb.n_events, e)
+            return None
+        self._inc("dispatches", n_disp)
+        self._inc("event_routed_dispatches", n_disp)
+        self._inc("event_routed_rows", mb.batch)
+        return out
+
     def _run_wide(self, mb: EncodedBatch):
         """Blocking wide/frontier/sharded dispatch with bounded retry.
         Persistent failure returns ChunkAbandoned — a WindowOverflow
@@ -1569,9 +1627,16 @@ class BucketScheduler:
             self._inc("orig_events",
                       int(mb.orig_n_events.sum())
                       if mb.orig_n_events is not None else ev)
-            shard = mesh is not None and mb.batch >= (
-                mesh.shape["data"] * MIN_ROWS_PER_DEVICE
-                if self.shard_min_rows is None else self.shard_min_rows)
+            if self.shard_min_rows is None:
+                # The mesh-level per-device floor ($JT_SHARD_MIN_ROWS,
+                # default MIN_ROWS_PER_DEVICE): sub-minimum sharding
+                # regresses (MULTICHIP_r06's dataN tail), so thin
+                # merged buckets stay on the fused chunked pipeline.
+                from ..parallel.mesh import should_shard
+                shard = should_shard(mb.batch, mesh)
+            else:
+                shard = (mesh is not None
+                         and mb.batch >= self.shard_min_rows)
             if wide or shard:
                 # Wide/frontier/sharded routes keep their own dispatch
                 # logic (run_encoded_batch): drain the pipeline so
@@ -1591,6 +1656,24 @@ class BucketScheduler:
                         self.on_chunk(mb, 0, mb.batch, v, b, fr)
                 yield mb, out
                 return
+            if (self.event_route_events
+                    and mb.n_events >= self.event_route_events):
+                # Long-history cost route (the r05 10k-op probe's
+                # regime): carried event chunks instead of one
+                # N-step monolithic scan. Blocking like the wide
+                # route, so yields stay in dispatch order.
+                yield from drain()
+                out = self._run_event_routed(mb)
+                if out is not None:
+                    self._last_retire_t = time.monotonic()
+                    if self.stats["t_first_verdict_s"] is None:
+                        self.stats["t_first_verdict_s"] = round(
+                            time.monotonic() - self._t0, 4)
+                    if self.on_chunk is not None:
+                        v, b, fr = out
+                        self.on_chunk(mb, 0, mb.batch, v, b, fr)
+                    yield mb, out
+                    return
             Bp, chunks = self._chunk_plan(mb)
             if self.prewarm and mb.W <= DATA_MAX_SLOTS:
                 spec = (mb.V, mb.W, mb.eff_w_live, mb.shared_target,

@@ -11,6 +11,7 @@ devices.
 """
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -18,7 +19,38 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.linearize import make_kernel
+from ..ops.linearize import MIN_ROWS_PER_DEVICE, make_kernel
+
+
+def shard_min_rows() -> int:
+    """$JT_SHARD_MIN_ROWS: per-device row floor for the batch-sharded
+    (dataN) route. A sharded dispatch whose per-device slice drops
+    below it pays more in collective setup and per-device launch than
+    the split saves — the MULTICHIP_r06 curve's 4/8-device regression
+    (dispatch_s 0.21 → 1.01 at n=4) was exactly this sub-minimum
+    sharding, 256 fixed rows thinning to 64/32 per device. Default
+    MIN_ROWS_PER_DEVICE (the historical floor); deployments that
+    measure their own crossover raise it and the dataN path falls back
+    to the single-device kernel below it (should_shard)."""
+    env = os.environ.get("JT_SHARD_MIN_ROWS")
+    if env is not None:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return MIN_ROWS_PER_DEVICE
+
+
+def should_shard(rows: int, mesh: Optional[Mesh]) -> bool:
+    """Whether a ``rows``-row batch should take the batch-sharded
+    (dataN) route on ``mesh`` — False when the per-device slice would
+    drop below ``shard_min_rows()``, in which case callers run the
+    single-device kernel instead (ops.linearize.run_encoded_batch's
+    routing; the BucketScheduler derives its default hand-off bound
+    from the same floor)."""
+    if mesh is None:
+        return False
+    return rows >= mesh.shape["data"] * shard_min_rows()
 
 
 def checker_mesh(n_data: Optional[int] = None, n_frontier: int = 1,

@@ -852,6 +852,27 @@ def run_seeds(builder: Callable[[int], dict], seeds,
     return tests
 
 
+def synth_seed_summary(model, sspec, *, synth: str = "device",
+                       journal=None,
+                       check_kwargs: Optional[dict] = None) -> dict:
+    """One synth seed's generate-and-check, summarized — the per-seed
+    engine ``run_synth_seeds`` AND the fleet workers (jepsen_tpu.fleet)
+    share, so a sharded campaign's per-seed verdicts are
+    field-for-field identical to a single-process one's by
+    construction. Returns {"checked", "invalid", "bad_sample"}."""
+    import numpy as np
+
+    from .ops.linearize import check_synth
+
+    valid, bad = check_synth(model, sspec, synth=synth,
+                             journal=journal, **(check_kwargs or {}))
+    inv = np.flatnonzero(~np.asarray(valid))
+    return {"checked": int(len(valid)),
+            "invalid": int(inv.size),
+            "bad_sample": [[int(r), int(np.asarray(bad)[r])]
+                           for r in inv[:10].tolist()]}
+
+
 def run_synth_seeds(spec, seeds, *, synth: str = "device", model=None,
                     name: str = "synth-campaign", store_root=None,
                     checkpoint: bool = True, resume: bool = False,
@@ -876,11 +897,8 @@ def run_synth_seeds(spec, seeds, *, synth: str = "device", model=None,
     import dataclasses
     import json as _json
 
-    import numpy as np
-
     from .store import atomic_write_json
     from .models.core import cas_register
-    from .ops.linearize import check_synth
     from .store import ChunkJournal, CampaignCheckpoint, DEFAULT, \
         spec_digest
 
@@ -924,17 +942,12 @@ def run_synth_seeds(spec, seeds, *, synth: str = "device", model=None,
             try:
                 with telemetry.span("campaign.seed", seed=s,
                                     synth=True):
-                    valid, bad = check_synth(model, sspec, synth=synth,
-                                             journal=journal,
-                                             **(check_kwargs or {}))
+                    summ = synth_seed_summary(
+                        model, sspec, synth=synth, journal=journal,
+                        check_kwargs=check_kwargs)
             finally:
                 if journal is not None:
                     journal.close()
-            inv = np.flatnonzero(~np.asarray(valid))
-            summ = {"checked": int(len(valid)),
-                    "invalid": int(inv.size),
-                    "bad_sample": [[int(r), int(np.asarray(bad)[r])]
-                                   for r in inv[:10].tolist()]}
             if checkpoint:
                 atomic_write_json(summary_path, summ)
                 journal.finish()

@@ -68,6 +68,12 @@ FIRST_VIOLATION = "first-violation.json"
 # reads it cross-process).
 ONLINE_REGISTRY = "online-registry.json"
 
+# Fleet-campaign namespace (jepsen_tpu.fleet): the orchestrator's work
+# spec, lease files, and per-unit summaries live under
+# store/<name>/fleet/ — coordination state, never a run (tests()
+# excludes it the way it excludes the latest symlinks).
+FLEET_DIR = "fleet"
+
 
 class CampaignMismatch(ValueError):
     """An explicit campaign resume named a checkpoint belonging to a
@@ -304,7 +310,7 @@ class Store:
                 continue
             runs = [d.name for d in sorted(name_dir.iterdir())
                     if d.is_dir() and not d.is_symlink()
-                    and d.name != "latest"]
+                    and d.name not in ("latest", FLEET_DIR)]
             if runs:
                 out[name_dir.name] = runs
         return out

@@ -642,3 +642,16 @@ def counters_delta(base: Optional[dict], now: dict) -> dict:
         else:
             del out["counters"]
     return out
+
+
+def merge_counter_snapshots(snaps) -> dict:
+    """Sum the ``counters`` blocks of several processes' snapshots (or
+    counters_delta outputs) into one — the fleet orchestrator's
+    cross-worker aggregation: each worker persists its own per-process
+    counter deltas, and the campaign-level telemetry block must report
+    the FLEET's total traffic, which no single registry ever saw."""
+    out: dict = {}
+    for s in snaps:
+        for k, v in ((s or {}).get("counters") or {}).items():
+            out[k] = out.get(k, 0) + v
+    return out

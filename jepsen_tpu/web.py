@@ -43,17 +43,22 @@ td, th { padding: .3em .8em; border: 1px solid #ccc; text-align: left; }
 .badge-stalled { background: #d9972f; color: #fff; }
 .badge-violation { background: #b03030; color: #fff; }
 .badge-clean { background: #3a8f3a; color: #fff; }
+.badge-fleet { background: #5b4fa2; color: #fff; }
 a { text-decoration: none; }
 pre { background: #f7f7f7; padding: 1em; overflow-x: auto; }
 """
 
 
-def _validity(run_dir: Path):
+def _results(run_dir: Path) -> dict:
     try:
         with open(run_dir / "results.json") as f:
-            return json.load(f).get("valid")
+            return json.load(f)
     except Exception:
-        return None
+        return {}
+
+
+def _validity(run_dir: Path):
+    return _results(run_dir).get("valid")
 
 
 def live_stale_s() -> float:
@@ -200,7 +205,8 @@ class Handler(BaseHTTPRequestHandler):
         for name, runs in sorted(self.store.tests().items()):
             for ts in sorted(runs, reverse=True):
                 d = self.store.run_dir(name, ts)
-                v = _validity(d)
+                res = _results(d)
+                v = res.get("valid")
                 badge = ""
                 if (name, ts) in incomplete:
                     cls = "valid-incomplete"
@@ -208,6 +214,14 @@ class Handler(BaseHTTPRequestHandler):
                 else:
                     cls = {True: "valid-true",
                            False: "valid-false"}.get(v, "valid-unknown")
+                    fl = res.get("fleet")
+                    if isinstance(fl, dict):
+                        # A fleet campaign's merged verdict renders as
+                        # ONE row: the badge names the aggregation
+                        # (units checked across every worker).
+                        badge = (f' <span class="badge badge-fleet">'
+                                 f'fleet · {fl.get("units", "?")} '
+                                 f'units</span>')
                 vtxt = {True: "valid", False: "INVALID"}.get(
                     v, "unknown" if v is not None else "—")
                 rel = f"{name}/{ts}"

@@ -70,6 +70,13 @@ def pytest_configure(config):
                    "restart, and online-vs-post-mortem verdict parity "
                    "(deterministic; runs in tier-1)")
     config.addinivalue_line(
+        "markers", "fleet: sharded multi-worker campaign orchestrator "
+                   "— lease claim/expiry/takeover, worker-SIGKILL "
+                   "redistribution with zero re-run seeds, "
+                   "cost-routed backend parity, and fleet-vs-"
+                   "single-process pooled-verdict parity "
+                   "(deterministic; runs in tier-1)")
+    config.addinivalue_line(
         "markers", "telemetry: span tracer + metrics registry — "
                    "nesting/attributes, ring wraparound, Chrome-trace "
                    "export, snapshot determinism, no-op-when-off, and "

@@ -30,6 +30,13 @@ def test_bench_emits_contract_json():
                JT_BENCH_XLONG_B="6", JT_BENCH_XLONG_OPS="2000",
                JT_BENCH_SYNTH_B="64", JT_BENCH_TRACE_B="64",
                JT_BENCH_ONLINE_TENANTS="2", JT_BENCH_ONLINE_OPS="24",
+               # Fleet sweep at toy scale: 1 vs 2 real worker
+               # processes over 2 seed units (the tier-1 guard is the
+               # section's shape + JT_BENCH_FLEET=0 skippability, not
+               # the speedup — 2 toy units can't amortize worker
+               # startup).
+               JT_BENCH_FLEET_WORKERS="1,2", JT_BENCH_FLEET_SEEDS="2",
+               JT_BENCH_FLEET_B="32",
                # Tracing stays ambient-off: the section flips the
                # flight recorder on for its own traced passes only.
                JT_TRACE="0")
@@ -119,6 +126,30 @@ def test_bench_emits_contract_json():
     assert fz["iters_per_s"] > 0 and fz["neighborhoods"] >= 0
     # Per-section synth breakdown on the probes.
     assert d["long_history"]["long"]["synth_s"] >= 0
+    # Long-history cost route (ISSUE 10 satellite): the event-chunked
+    # kernel engaged as a ROUTE, its rate reported.
+    lr = d["long_history"]["routed"]
+    assert lr["threshold_default"] > 0
+    assert lr["event_routed_rows"] > 0
+    assert lr["event_routed_dispatches"] > 0
+    assert lr["events_per_s"] > 0 and lr["rate"] > 0
+    # Fleet section (ISSUE 10 acceptance): a MULTICHIP_r07-shape curve
+    # — per-point e2e, speedup, parallel efficiency — over real worker
+    # processes, plus the router cost table.
+    fl = d["fleet"]
+    assert fl["seeds"] == 2 and fl["histories"] == 32
+    assert [p["workers"] for p in fl["points"]] == [1, 2]
+    for p in fl["points"]:
+        assert p["e2e_s"] > 0 and p["hist_per_s"] > 0
+        assert p["speedup"] > 0 and p["parallel_efficiency"] > 0
+        assert 1 <= p["spawned"] <= p["workers"]
+    assert fl["points"][0]["speedup"] == 1.0
+    assert fl["host_cores"] >= 1
+    assert isinstance(fl["monotone"], bool)
+    # Same spec + seeds at every point: identical verdicts.
+    assert len({p["invalid"] for p in fl["points"]}) == 1
+    tblw = {row["W"]: row["backend"] for row in fl["router_table"]}
+    assert tblw[4] == "wgl-device" and tblw[20] == "host-oracle"
     # Online checker-daemon section (ISSUE 9 acceptance): live-tailed
     # verdicts while the histories are still being written, plus the
     # forced overload burst degrading through the ladder without

@@ -1,0 +1,411 @@
+"""Fleet campaign orchestrator (jepsen_tpu/fleet.py, doc/fleet.md).
+
+Tier-1 gates:
+  * cost-router arithmetic: the W crossover between the device scan
+    and the host oracle, the graph MXU/DFS crossover, capability caps;
+  * router-CHOICE parity: a mixed corpus (cas register, wide-window,
+    list-append) routed across every backend agrees with the host
+    oracles field-for-field, whichever backend the prices pick;
+  * long-history cost route: the event-chunked kernel engaged by
+    threshold is verdict-identical to the monolithic scan;
+  * dataN sub-minimum-sharding fallback ($JT_SHARD_MIN_ROWS);
+  * fleet-vs-single-process pooled-verdict parity (field-for-field
+    per-seed summaries against runtime.run_synth_seeds);
+  * worker-SIGKILL lease-expiry redistribution with ZERO re-run of
+    completed seeds, proven against a real killed subprocess;
+  * `jepsen-tpu fleet --workers 2 --resume` exits 0 on a
+    pre-populated campaign (the CI guard).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.fleet import (CostRouter, FLEET_DIR, LEASES_DIR,
+                              SPEC_FILE, _work_spec, campaign_complete,
+                              claim_chunk, estimate_w, fleet_campaign,
+                              merge_campaign, pending_window,
+                              route_check)
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.ops.synth_device import SynthSpec
+from jepsen_tpu.store import Store, atomic_write_json
+from jepsen_tpu.workloads.synth import (synth_cas_batch,
+                                        synth_la_history,
+                                        synth_wide_window_history)
+
+pytestmark = pytest.mark.fleet
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _worker_env(**extra):
+    """Child env for a real worker subprocess: repo importable, one
+    virtual device (fleet parallelism is across processes), hermetic
+    compile cache."""
+    from jepsen_tpu.provision import virtual_cpu_env
+    env = dict(os.environ, PYTHONPATH=str(REPO), JT_COMPILE_CACHE="0")
+    virtual_cpu_env(1, env=env)
+    env.update(extra)
+    return env
+
+
+# ------------------------------------------------------------- router
+
+def test_cost_router_w_crossover():
+    # Pin the rates so the assertion is about the ARITHMETIC, not the
+    # machine: device cost doubles per W, host is W-flat, so a
+    # crossover must exist — with these rates at 2^w/1e8 == 4e-4,
+    # i.e. between W=15 and W=16 (the measured r05 crossover).
+    r = CostRouter(rates={"lane_ops_per_s": 1e8,
+                          "host_s_per_event": 4e-4})
+    b_lo, _ = r.choose_wgl(8, 1000)
+    b_hi, costs = r.choose_wgl(16, 1000)
+    assert b_lo == "wgl-device"
+    assert b_hi == "host-oracle"
+    assert costs["wgl-device"] > costs["host-oracle"]
+    # Capability cap: past MAX_DEVICE_W only the host is capable,
+    # whatever the prices say.
+    r2 = CostRouter(rates={"lane_ops_per_s": 1e30,
+                           "host_s_per_event": 4e-4})
+    assert r2.choose_wgl(r2.max_device_w + 1, 100)[0] == "host-oracle"
+    # The cost table names the winner per W (the doc/bench artifact).
+    tbl = r.table(ws=(4, 16))
+    assert tbl[0]["backend"] == "wgl-device"
+    assert tbl[1]["backend"] == "host-oracle"
+
+
+def test_cost_router_graph_crossover():
+    dev = CostRouter(rates={"macs_per_s": 1e15,
+                            "graph_host_s_per_edge": 2e-6})
+    host = CostRouter(rates={"macs_per_s": 1.0,
+                             "graph_host_s_per_edge": 2e-6})
+    assert dev.choose_graph(64, 200)[0] == "graph-device"
+    assert host.choose_graph(64, 200)[0] == "graph-host"
+    # Amortizing the dispatch overhead over more rows can only help
+    # the device side.
+    many = dev.price_graph(64, 200, rows=1024)["graph-device"]
+    one = dev.price_graph(64, 200, rows=1)["graph-device"]
+    assert many <= one
+
+
+def test_estimate_w_post_partition():
+    # Two independent keys, each a 2-wide window: the unit's W is the
+    # per-key (post-partition) window, not the merged 4-wide one.
+    from jepsen_tpu.history.ops import Op
+    from jepsen_tpu.independent import KV
+
+    def inv(p, k):
+        return Op(process=p, type="invoke", f="write",
+                  value=KV(k, p), time=p)
+
+    def ok(p, k):
+        return Op(process=p, type="ok", f="write",
+                  value=KV(k, p), time=10 + p)
+
+    h = [inv(0, "a"), inv(1, "a"), inv(2, "b"), inv(3, "b"),
+         ok(0, "a"), ok(1, "a"), ok(2, "b"), ok(3, "b")]
+    assert pending_window(h) == 4
+    assert estimate_w(h) == 2
+
+
+def test_router_choice_parity_mixed_corpus():
+    """Every backend agrees with the host oracle on a mixed corpus —
+    whichever backend the prices pick, the verdict is the same."""
+    from jepsen_tpu.checkers.linearizable import wgl_check
+    from jepsen_tpu.ops.graph import check_graph_host, extract_graph
+
+    model = cas_register()
+    cas = synth_cas_batch(8, seed0=3, n_procs=3, n_ops=18, n_values=3,
+                          corrupt=0.4, p_info=0.1)
+    wide = [synth_wide_window_history(width=17),
+            synth_wide_window_history(width=17, invalid=True)]
+    la = [synth_la_history(i, n_procs=3, n_ops=18,
+                           corrupt=1.0 if i % 2 else 0.0)
+          for i in range(4)]
+    corpus = cas + wide + la
+
+    def oracle(h):
+        if any(op.f in ("append", "insert") for op in h
+               if op.is_client):
+            return check_graph_host(extract_graph(h))["valid"]
+        return wgl_check(model, h)["valid"]
+
+    expected = [oracle(h) for h in corpus]
+
+    # Default rates: cas rides the device scan, W=17 rides the host
+    # oracle, la rides the MXU closure.
+    rs, routing = route_check(model, corpus)
+    assert [r["valid"] for r in rs] == expected
+    assert routing["units"] == len(corpus)
+    assert routing["backends"].get("wgl-device", 0) >= len(cas)
+    assert routing["backends"].get("host-oracle", 0) >= len(wide)
+    assert routing["backends"].get("graph-device", 0) >= len(la)
+    assert all(r.get("backend") for r in rs)
+
+    # Force the OTHER graph backend: verdicts must not move.
+    host_router = CostRouter(rates={"macs_per_s": 1.0})
+    rs2, routing2 = route_check(model, corpus, router=host_router)
+    assert [r["valid"] for r in rs2] == expected
+    assert routing2["backends"].get("graph-host", 0) >= len(la)
+
+    # At least one invalid row per family keeps the gate honest.
+    assert not all(expected[:len(cas)])
+    assert expected[len(cas)] is True
+    assert expected[len(cas) + 1] is False
+    assert not all(expected[len(cas) + 2:])
+
+
+# ------------------------------------------- long-history cost route
+
+def test_event_route_cost_parity():
+    from jepsen_tpu.ops.linearize import check_columnar
+    from jepsen_tpu.ops.schedule import (BucketScheduler,
+                                         event_route_min_events)
+    from jepsen_tpu.workloads.synth import synth_cas_columnar
+
+    assert event_route_min_events() > 0     # on by default
+    model = cas_register()
+    cols = synth_cas_columnar(24, seed=5, n_procs=4, n_ops=40,
+                              n_values=4, corrupt=0.2, p_info=0.0)
+    v0, b0 = check_columnar(model, cols)
+    v1, b1 = check_columnar(model, cols,
+                            scheduler_opts={"event_route_events": 16})
+    assert np.array_equal(np.asarray(v0), np.asarray(v1))
+    assert np.array_equal(np.asarray(b0), np.asarray(b1))
+    assert int((~np.asarray(v0)).sum()) >= 1
+
+    # The route is visible in the scheduler stats (the bench
+    # long_history "routed" figures read the same counters).
+    from jepsen_tpu.checkers.linearizable import prepare_history
+    from jepsen_tpu.ops.encode import bucket_encode
+    hists = synth_cas_batch(6, seed0=3, n_procs=4, n_ops=30,
+                            n_values=3, corrupt=0.2)
+    buckets = bucket_encode(model,
+                            [prepare_history(h) for h in hists])
+    sch = BucketScheduler(event_route_events=8, shard_min_rows=10**9)
+    outs = list(sch.run(buckets))
+    assert sch.stats["event_routed_rows"] > 0
+    assert sch.stats["event_routed_dispatches"] > 0
+    ref = BucketScheduler(event_route_events=0,
+                          shard_min_rows=10**9)
+    refs = list(ref.run(buckets))
+    assert ref.stats["event_routed_rows"] == 0
+    for (_, a), (_, b) in zip(outs, refs):
+        assert np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        assert np.array_equal(np.asarray(a[1]), np.asarray(b[1]))
+
+
+def test_shard_min_rows_fallback(monkeypatch):
+    """dataN falls back to the single-device kernel when rows/device
+    drops below the $JT_SHARD_MIN_ROWS floor (the MULTICHIP_r06
+    4/8-device regression was sub-minimum sharding)."""
+    from jepsen_tpu.checkers.linearizable import prepare_history
+    from jepsen_tpu.ops import linearize as lin
+    from jepsen_tpu.ops.encode import bucket_encode
+    from jepsen_tpu.parallel.mesh import shard_min_rows, should_shard
+
+    hists = synth_cas_batch(96, seed0=3, n_procs=4, n_ops=24,
+                            n_values=3, corrupt=0.2)
+    buckets = bucket_encode(cas_register(),
+                            [prepare_history(h) for h in hists])
+    b = max(buckets, key=lambda x: x.batch)
+    assert b.batch >= 64          # 8 virtual devices x the default floor
+
+    lin.DISPATCH_LOG.clear()
+    v0, bad0, _ = lin.run_encoded_batch(b)
+    assert "dataN" in {p for p, *_ in lin.DISPATCH_LOG}
+
+    monkeypatch.setenv("JT_SHARD_MIN_ROWS", str(10**6))
+    assert shard_min_rows() == 10**6
+    assert not should_shard(b.batch, lin.production_mesh(1))
+    lin.DISPATCH_LOG.clear()
+    v1, bad1, _ = lin.run_encoded_batch(b)
+    paths = {p for p, *_ in lin.DISPATCH_LOG}
+    assert "dataN" not in paths and "data1" in paths
+    assert np.array_equal(np.asarray(v0), np.asarray(v1))
+    assert np.array_equal(np.asarray(bad0), np.asarray(bad1))
+
+
+# ----------------------------------------------------- leases + fleet
+
+def test_max_local_workers_cap(monkeypatch):
+    from jepsen_tpu.fleet import max_local_workers
+    assert max_local_workers() == (os.cpu_count() or 1)
+    monkeypatch.setenv("JT_FLEET_MAX_LOCAL_WORKERS", "0")
+    assert max_local_workers() == 0          # uncapped
+    monkeypatch.setenv("JT_FLEET_MAX_LOCAL_WORKERS", "3")
+    assert max_local_workers() == 3
+
+
+def test_lease_claim_expiry_takeover(tmp_path):
+    cdir = tmp_path / FLEET_DIR
+    (cdir / LEASES_DIR).mkdir(parents=True)
+    assert claim_chunk(cdir, 0, [1, 2], "wA", ttl=60) == 0
+    # Live lease: nobody else gets it.
+    assert claim_chunk(cdir, 0, [1, 2], "wB", ttl=60) is None
+    # Same worker re-enters its own lease.
+    assert claim_chunk(cdir, 0, [1, 2], "wA", ttl=60) == 0
+    # Expire it (backdate the heartbeat): takeover bumps the
+    # generation.
+    lease = cdir / LEASES_DIR / "chunk-0.json"
+    rec = json.loads(lease.read_text())
+    rec["hb"] = time.time() - 999
+    atomic_write_json(lease, rec)
+    assert claim_chunk(cdir, 0, [1, 2], "wB", ttl=60) == 1
+
+
+def test_fleet_inline_matches_single_process(tmp_path):
+    """Field-for-field pooled-verdict parity: a sharded fleet
+    campaign's per-seed summaries equal a single-process
+    run_synth_seeds campaign's — by construction (the shared
+    runtime.synth_seed_summary engine), asserted anyway."""
+    from jepsen_tpu.runtime import run_synth_seeds
+
+    spec = SynthSpec(family="cas", n=20, seed=0, n_procs=3, n_ops=14,
+                     n_values=3, n_keys=2, corrupt=0.25)
+    root = Store(tmp_path / "store")
+    out = fleet_campaign(name="camp", kind="synth", seeds=range(4),
+                         spec=spec, workers=0, store_root=root)
+    single = run_synth_seeds(spec, range(4), name="single",
+                             store_root=root)
+    assert out["complete"] is True
+    assert out["invalid"] == single["invalid"] > 0
+    assert out["valid"] is single["valid"] is False
+    for s in ("0", "1", "2", "3"):
+        got = {k: out["seeds"][s][k]
+               for k in ("checked", "invalid", "bad_sample")}
+        want = {k: single["seeds"][s][k]
+                for k in ("checked", "invalid", "bad_sample")}
+        assert got == want, s
+    # The router recorded its batch-level choices.
+    assert sum(out["router"]["chosen"].values()) >= 4
+    assert out["router"]["table"]
+
+    # The campaign published as ONE standard run the web index
+    # renders: results.json carries the merged fleet block.
+    runs = root.tests().get("camp", [])
+    assert len(runs) == 1
+    res = json.loads(
+        (root.run_dir("camp", runs[0]) / "results.json").read_text())
+    assert res["valid"] is False
+    assert res["fleet"]["units"] == 4
+    assert res["fleet"]["workers"]["w0"]["units"] == 4
+
+    # Resume on the completed campaign: zero work, same verdicts, and
+    # the published run REFRESHES in place — one campaign stays one
+    # web-index row, never a duplicate per resume.
+    out2 = fleet_campaign(name="camp", resume=True, workers=2,
+                          store_root=root)
+    assert out2["complete"] is True
+    assert {s: v["invalid"] for s, v in out2["seeds"].items()} == \
+        {s: v["invalid"] for s, v in out["seeds"].items()}
+    assert root.tests().get("camp", []) == runs
+    assert out2["dir"] == out["dir"]
+
+
+def test_worker_sigkill_lease_redistribution(tmp_path):
+    """SIGKILL a real worker subprocess mid-chunk: its lease expires,
+    the survivor takes it over at a bumped generation, every seed gets
+    decided, and the dead worker's COMPLETED summaries are untouched
+    byte-for-byte (zero re-run)."""
+    spec = SynthSpec(family="cas", n=12, seed=0, n_procs=3, n_ops=12,
+                     n_values=3, corrupt=0.2)
+    base = (tmp_path / "store").resolve()
+    cdir = base / "kill" / FLEET_DIR
+    (cdir / LEASES_DIR).mkdir(parents=True)
+    ws = _work_spec("kill", "synth", list(range(6)), spec, "cas",
+                    "device", None, None, base, 2, 3.0, 4, 8, 2)
+    atomic_write_json(cdir / SPEC_FILE, ws)
+
+    # Worker A dawdles 2 s after every summary (the test seam), so the
+    # kill deterministically lands mid-chunk: seed 0 summarized, seed
+    # 1 leased-but-undecided.
+    pA = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.cli", "fleet", "--join",
+         str(cdir), "--worker-id", "wA"],
+        env=_worker_env(JT_FLEET_TEST_SLEEP_S="2.0"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if list(cdir.glob("seed-*.json")):
+            break
+        time.sleep(0.05)
+    pA.kill()
+    pA.wait()
+    done_before = {p.name: p.read_text()
+                   for p in cdir.glob("seed-*.json")}
+    assert done_before, "worker A never summarized a seed"
+
+    pB = subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu.cli", "fleet", "--join",
+         str(cdir), "--worker-id", "wB"],
+        env=_worker_env(), capture_output=True, text=True,
+        timeout=300)
+    assert pB.returncode == 0, pB.stdout[-2000:]
+    assert campaign_complete(cdir)
+
+    # Zero re-run: A's completed summaries are byte-identical (a
+    # re-run would at least rewrite the worker field).
+    for name, text in done_before.items():
+        assert (cdir / name).read_text() == text, name
+    merged = merge_campaign(cdir)
+    assert merged["complete"] is True
+    assert merged["leases"]["takeovers"] >= 1
+    wB = json.loads((cdir / "worker-wB.json").read_text())
+    assert wB["takeovers"] >= 1
+    assert wB["rehydrated"] >= len(done_before)
+    assert wB["units"] + len(done_before) == 6
+
+    # Pooled-verdict parity vs a single-process campaign over the
+    # same spec/seeds — the redistribution changed who computed each
+    # seed, never what.
+    from jepsen_tpu.runtime import run_synth_seeds
+    single = run_synth_seeds(spec, range(6), name="kill-single",
+                             store_root=Store(base))
+    for s, summ in single["seeds"].items():
+        got = {k: merged["seeds"][s][k]
+               for k in ("checked", "invalid", "bad_sample")}
+        assert got == {k: summ[k]
+                       for k in ("checked", "invalid", "bad_sample")}
+
+
+def test_fleet_cli_resume_exit0(tmp_path):
+    """CI guard: `jepsen-tpu fleet --workers 2 --resume` exits 0 on a
+    pre-populated campaign checkpoint. The population runs in-process
+    (the session's jax is already warm); the resume runs the REAL CLI
+    — a completed campaign's resume is merge-and-publish only, so the
+    subprocess stays jax-free and fast."""
+    spec = SynthSpec(family="cas", n=16, seed=0, n_procs=3, n_ops=12,
+                     n_values=3)
+    out = fleet_campaign(name="ci", kind="synth", seeds=range(3),
+                         spec=spec, workers=0,
+                         store_root=Store(tmp_path / "store"))
+    assert out["valid"] is True and out["complete"] is True
+
+    args = ["--name", "ci", "--seeds", "3", "--histories", "16",
+            "--n-ops", "12", "--n-procs", "3", "--n-values", "3"]
+    resumed = subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu.cli", "fleet", "--workers",
+         "2", "--resume"] + args,
+        env=_worker_env(), cwd=tmp_path, capture_output=True,
+        text=True, timeout=300)
+    assert resumed.returncode == 0, (resumed.stdout[-2000:],
+                                     resumed.stderr[-2000:])
+    line = json.loads(resumed.stdout.strip().splitlines()[-1])
+    assert line["valid"] is True and line["complete"] is True
+    assert line["units"] == 3
+
+    # A mismatched --resume refuses rather than clobbering.
+    bad = subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu.cli", "fleet", "--workers",
+         "0", "--resume", "--name", "ci", "--seeds", "4",
+         "--histories", "16", "--n-ops", "12", "--n-procs", "3",
+         "--n-values", "3"],
+        env=_worker_env(), cwd=tmp_path, capture_output=True,
+        text=True, timeout=120)
+    assert bad.returncode == 255
