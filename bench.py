@@ -46,7 +46,14 @@ JT_BENCH_FLEET_CURVE=<path> writes the standalone MULTICHIP_r07-shape
 curve file), JT_BENCH_ONLINE=0 (skip
 the online-checker-daemon figure: time-to-first-verdict percentiles,
 verdicts/s while writing, and the forced-overload-burst shed fraction;
-JT_BENCH_ONLINE_TENANTS / JT_BENCH_ONLINE_OPS size it), JT_BENCH_TRACE=0 (skip
+JT_BENCH_ONLINE_TENANTS / JT_BENCH_ONLINE_OPS size it),
+JT_BENCH_SERVICE=0 (skip the federated checking-service figure:
+tenants-per-SLO vs real worker processes plus the kill-a-worker
+takeover-latency probe; JT_BENCH_SERVICE_WORKERS /
+JT_BENCH_SERVICE_TENANTS / JT_BENCH_SERVICE_OPS /
+JT_BENCH_SERVICE_SLO_S size it and JT_BENCH_SERVICE_CURVE=<path>
+writes the standalone MULTICHIP_r08-shape curve file —
+doc/service.md), JT_BENCH_TRACE=0 (skip
 the telemetry section) / JT_BENCH_TRACE_B (its workload size; the
 section measures span-tracing overhead against the ≤5% budget and the
 device-busy vs host-gap breakdown — doc/observability.md). JT_TRACE=1
@@ -63,6 +70,18 @@ import json
 import os
 import time
 from pathlib import Path
+
+
+def _pct_nearest(xs, p, digits=4):
+    """Nearest-rank percentile over a SORTED list — the telemetry
+    registry's convention (``int(round(p·n/100 + 0.5)) − 1``, clamped),
+    shared by every section (WAL flush, online TTFV, service TTFV and
+    takeover latency) so their percentile figures stay comparable."""
+    if not xs:
+        return None
+    i = min(len(xs) - 1,
+            max(0, int(round(p / 100.0 * len(xs) + 0.5)) - 1))
+    return round(xs[i], digits)
 
 
 def main():
@@ -779,13 +798,7 @@ def main():
         sync_ms = sorted(ns / 1e6 for ns in sync_ns)
 
         def _pct(xs, p):
-            # Nearest-rank percentile: ceil(p·n/100) − 1, clamped.
-            if not xs:
-                return None
-            import math
-            return round(xs[max(0, min(len(xs) - 1,
-                                       math.ceil(p / 100 * len(xs))
-                                       - 1))], 3)
+            return _pct_nearest(xs, p, digits=3)
 
         # Salvage throughput: reconstruct a checkable history from a
         # crashed run's WAL (torn-tail drop + dangling completion +
@@ -1285,15 +1298,7 @@ def main():
                               for t in burst.tenants.values())
             burst.close()
 
-        def _pct(xs, p):
-            # Nearest-rank, matching telemetry's histogram percentiles
-            # (ceil(p*n/100) - 1): the online TTFV figures must be
-            # comparable with the WAL flush percentiles next to them.
-            if not xs:
-                return None
-            i = min(len(xs) - 1,
-                    max(0, int(round(p / 100.0 * len(xs) + 0.5)) - 1))
-            return round(xs[i], 4)
+        _pct = _pct_nearest
 
         online_section = {
             "tenants": OT,
@@ -1417,6 +1422,239 @@ def main():
                              "— real parallelism bounded by host "
                              "cores, unlike the r06 virtual mesh"),
                     "points": points}, f, indent=2)
+                f.write("\n")
+
+    # ------------------------------------------------------- service
+    # The federated checking service (jepsen_tpu/service.py,
+    # doc/service.md): a store of crashed tenants served to final
+    # verdicts by 1..N real worker PROCESSES coordinating purely
+    # through tenant leases (tenants-per-SLO vs workers), then a
+    # kill-a-worker probe — two workers split LIVE tenants, one is
+    # SIGKILLed, and the per-tenant latency from the kill to the
+    # survivor's gen+1 re-claim lands as p50/p99 (the lease TTL
+    # dominates by construction; the figure proves the BOUND) — the
+    # MULTICHIP_r08 measurement. JT_BENCH_SERVICE=0 skips;
+    # _WORKERS/_TENANTS/_OPS/_SLO_S size it; JT_BENCH_SERVICE_CURVE
+    # writes the standalone curve file.
+    service_section = None
+    if os.environ.get("JT_BENCH_SERVICE", "1") != "0":
+        import shutil as _sv_shutil
+        import tempfile as _sv_tf
+
+        from jepsen_tpu.history.codec import dumps_op as _sv_dumps, \
+            write_jsonl as _sv_wjsonl
+        from jepsen_tpu.history.core import index as _sv_index
+        from jepsen_tpu.history.ops import invoke_op as _sv_inv, \
+            ok_op as _sv_ok
+        from jepsen_tpu.history.wal import WAL_FILE as _SV_WAL, \
+            WAL_MAGIC as _SV_MAGIC
+        from jepsen_tpu.service import (_spawn_service_worker,
+                                        save_budget as _sv_save_budget,
+                                        serve_store, service_summary)
+        from jepsen_tpu.store import Store as _SvStore
+
+        SVW = sorted({int(x) for x in
+                      os.environ.get("JT_BENCH_SERVICE_WORKERS",
+                                     "1,2").split(",") if x.strip()})
+        SVT = int(os.environ.get("JT_BENCH_SERVICE_TENANTS", "4"))
+        SVP = int(os.environ.get("JT_BENCH_SERVICE_OPS", "24"))
+        SV_SLO = float(os.environ.get("JT_BENCH_SERVICE_SLO_S", "30"))
+        SV_TTL = 2.0
+
+        _sv_pct = _pct_nearest
+
+        def _sv_ops(n_pairs):
+            ops, idx = [], 0
+            for k in range(n_pairs):
+                for op in (_sv_inv(0, "write", k + 1),
+                           _sv_ok(0, "write", k + 1),
+                           _sv_inv(0, "read", None),
+                           _sv_ok(0, "read", k + 1)):
+                    op.index = idx
+                    idx += 1
+                    ops.append(op)
+            return ops
+
+        def _sv_mkrun(base, i, pid):
+            d = Path(base) / f"svc-{i}" / "r1"
+            d.mkdir(parents=True, exist_ok=True)
+            lines = [json.dumps({"wal": _SV_MAGIC, "pid": pid,
+                                 "seed": i,
+                                 "test": {"name": f"svc-{i}"},
+                                 "phase": "setup"}),
+                     json.dumps({"phase": "run", "wal_ops": 0})]
+            lines += [_sv_dumps(o) for o in _sv_ops(SVP)]
+            (d / _SV_WAL).write_text("\n".join(lines) + "\n")
+            return d
+
+        _sv_base_args = ["--model", "cas", "--poll", "0.05",
+                         "--interval", "8",
+                         "--lease-ttl", str(SV_TTL),
+                         "--claim-budget", "8"]
+        points = []
+        for w in SVW:
+            td = _sv_tf.mkdtemp(prefix="jt-bench-svc-")
+            try:
+                st = _SvStore(Path(td) / "store")
+                for i in range(SVT):
+                    _sv_mkrun(st.base, i, pid=-1)   # dead writers
+                t0 = time.time()
+                serve_store(store=st, workers=max(w, 1),
+                            until_idle=True, lease_ttl=SV_TTL,
+                            poll_s=0.05,
+                            worker_args=_sv_base_args
+                            + ["--max-tenants", str(SVT)])
+                e2e = time.time() - t0
+                ttfvs, ok = [], 0
+                for i in range(SVT):
+                    v = st.online_verdict(f"svc-{i}", "r1") or {}
+                    ok += v.get("valid") is True
+                    if v.get("ttfv_s") is not None:
+                        ttfvs.append(float(v["ttfv_s"]))
+                ttfvs.sort()
+                points.append({
+                    "workers": w,
+                    "e2e_s": round(e2e, 3),
+                    "tenants_per_s": round(SVT / max(e2e, 1e-9), 3),
+                    "ttfv_p50_s": _sv_pct(ttfvs, 50),
+                    "ttfv_p99_s": _sv_pct(ttfvs, 99),
+                    "tenants_within_slo": sum(1 for x in ttfvs
+                                              if x <= SV_SLO),
+                    "valid_ok": ok == SVT,
+                })
+            finally:
+                _sv_shutil.rmtree(td, ignore_errors=True)
+
+        # Kill-a-worker takeover probe: two workers split LIVE
+        # tenants (writer pid = this process), one dies by SIGKILL,
+        # survivors re-claim at gen+1 — latency measured per orphan.
+        takeover = None
+        td = _sv_tf.mkdtemp(prefix="jt-bench-svc-kill-")
+        try:
+            st = _SvStore(Path(td) / "store")
+            dirs = [_sv_mkrun(st.base, i, pid=os.getpid())
+                    for i in range(SVT)]
+            _sv_save_budget(st)
+            half = max(1, SVT // 2)
+
+            def _owned(wid):
+                n = 0
+                for i in range(SVT):
+                    try:
+                        rec = json.loads(st.service_tenant_lease_path(
+                            f"svc-{i}", "r1").read_text())
+                    except Exception:
+                        continue
+                    n += rec.get("worker") == wid
+                return n
+
+            pA = _spawn_service_worker(
+                st, "kill-a", _sv_base_args
+                + ["--max-tenants", str(half), "--until-idle"])
+            pB = None
+            try:
+                deadline = time.time() + 120
+                while time.time() < deadline and \
+                        _owned("kill-a") < half:
+                    time.sleep(0.05)
+                pB = _spawn_service_worker(
+                    st, "kill-b", _sv_base_args
+                    + ["--max-tenants", str(SVT), "--until-idle"])
+                while time.time() < deadline and \
+                        _owned("kill-b") < SVT - half:
+                    time.sleep(0.05)
+                orphans = []
+                for i in range(SVT):
+                    try:
+                        rec = json.loads(
+                            st.service_tenant_lease_path(
+                                f"svc-{i}", "r1").read_text())
+                    except Exception:
+                        continue        # never claimed: not an orphan
+                    if rec.get("worker") == "kill-a":
+                        orphans.append(i)
+                t_kill = time.time()
+                pA.kill()
+                pA.wait()
+                lat = {}
+                deadline = time.time() + 90
+                while time.time() < deadline and \
+                        len(lat) < len(orphans):
+                    for i in orphans:
+                        if i in lat:
+                            continue
+                        try:
+                            rec = json.loads(
+                                st.service_tenant_lease_path(
+                                    f"svc-{i}", "r1").read_text())
+                        except Exception:
+                            continue
+                        if int(rec.get("gen") or 0) >= 1:
+                            lat[i] = round(time.time() - t_kill, 4)
+                    time.sleep(0.02)
+                # Finalize everything so the survivor drains and
+                # exits (analyzed stamp → stored-history path).
+                for i in range(SVT):
+                    _sv_wjsonl(dirs[i] / "history.jsonl", _sv_index(
+                        [o.with_() for o in _sv_ops(SVP)]))
+                    with open(dirs[i] / _SV_WAL, "a") as f:
+                        f.write(json.dumps(
+                            {"phase": "analyzed",
+                             "wal_ops": SVP * 4}) + "\n")
+                try:
+                    pB.wait(timeout=180)
+                except Exception:
+                    pB.kill()
+                    pB.wait()
+            finally:
+                for p in (pA, pB):
+                    if p is None:
+                        continue
+                    if p.poll() is None:
+                        p.kill()
+                        p.wait()
+                    getattr(p, "_jt_log", None) and p._jt_log.close()
+            lats = sorted(lat.values())
+            ksumm = service_summary(st)
+            takeover = {
+                "tenants": SVT,
+                "killed_owned": len(orphans),
+                "measured": len(lats),
+                "lease_ttl_s": SV_TTL,
+                "latency_p50_s": _sv_pct(lats, 50),
+                "latency_p99_s": _sv_pct(lats, 99),
+                "gen_bumps": ksumm["leases"]["gen_bumps"],
+                "takeovers": ksumm["leases"]["takeovers"],
+                "valid_ok": ksumm["valid"],
+            }
+        finally:
+            _sv_shutil.rmtree(td, ignore_errors=True)
+
+        service_section = {
+            "tenants": SVT,
+            "ops_per_tenant": SVP * 4,
+            "host_cores": os.cpu_count(),
+            "slo_s": SV_SLO,
+            "points": points,
+            "takeover": takeover,
+        }
+        curve_path = os.environ.get("JT_BENCH_SERVICE_CURVE")
+        if curve_path:
+            with open(curve_path, "w") as f:
+                json.dump({
+                    "tenants": SVT, "ops_per_tenant": SVP * 4,
+                    "host_cores": os.cpu_count(),
+                    "slo_s": SV_SLO, "lease_ttl_s": SV_TTL,
+                    "note": ("federated checking service: crashed "
+                             "tenants served to final verdicts by "
+                             "real worker processes coordinating "
+                             "through tenant leases; takeover = "
+                             "SIGKILL one of two workers holding "
+                             "live tenants, latency from the kill "
+                             "to the survivor's gen+1 re-claim "
+                             "(lease TTL dominates by construction)"),
+                    "points": points, "takeover": takeover},
+                    f, indent=2)
                 f.write("\n")
 
     print(json.dumps({
@@ -1543,6 +1781,7 @@ def main():
         "telemetry": tel_section,
         "online": online_section,
         "fleet": fleet_section,
+        "service": service_section,
     }))
 
 

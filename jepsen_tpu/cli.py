@@ -118,16 +118,188 @@ def single_test_cmd(test_fn: Callable,
 
 
 def serve_cmd() -> dict:
-    """The results web server subcommand (cli.clj:278-293)."""
+    """``serve``: the horizontally-scaled checking service
+    (jepsen_tpu.service, doc/service.md) — and, with no --workers, the
+    plain results web server the reference ships (cli.clj:278-293).
+
+    ``serve --workers N`` writes the cluster budget ledger, spawns N
+    local service workers (live runs become leasable tenants; a
+    SIGKILLed worker's tenants are taken over at a bumped generation
+    with zero re-dispatched decided prefixes), babysits the pool, and
+    acts on durable SLO scale advice. ``--join BASE --worker-id W``
+    runs ONE worker against an existing store — the multi-host entry:
+    point every host at the same shared store and the lease files do
+    the rest. ``--until-idle`` exits once every incomplete run in the
+    store carries a durable verdict; exit 1 when any verdict is
+    invalid."""
+    LINEAR_FAMILIES = ("cas", "cas-absent", "mutex", "fifo-queue")
+
     def add_opts(p):
         p.add_argument("-b", "--host", default="0.0.0.0")
-        p.add_argument("-p", "--port", type=int, default=8080)
+        p.add_argument("-p", "--port", type=int, default=None,
+                       help="Web control plane port (default 8080 in "
+                            "web-only mode, off in service mode "
+                            "unless given)")
+        p.add_argument("--workers", type=int, default=None,
+                       help="Service mode: local worker processes "
+                            "(0 = one worker inline). Omitted = "
+                            "web-server-only mode")
+        p.add_argument("--join", default=None, metavar="BASE",
+                       help="Worker mode: serve tenants of an "
+                            "existing store (multi-host entry)")
+        p.add_argument("--worker-id", default=None,
+                       help="Worker name for --join (unique; lease "
+                            "files carry it)")
+        p.add_argument("--model", default="cas-absent",
+                       choices=list(LINEAR_FAMILIES))
+        p.add_argument("--poll", type=float, default=0.5)
+        p.add_argument("--ticks", type=int, default=0,
+                       help="Worker: stop after N poll passes")
+        p.add_argument("--until-idle", action="store_true",
+                       default=False,
+                       help="Exit once the whole CLUSTER's work is "
+                            "done (every incomplete run has a "
+                            "durable verdict)")
+        p.add_argument("--interval", type=int, default=64,
+                       help="Interim check cadence, ops")
+        p.add_argument("--max-w", type=int, default=14,
+                       help="Per-worker W-class bound (wider prefixes "
+                            "ride the host oracle)")
+        p.add_argument("--max-tenants", type=int, default=64,
+                       help="Per-WORKER tenant capacity")
+        p.add_argument("--lease-ttl", dest="lease_ttl", type=float,
+                       default=None,
+                       help="Tenant lease staleness bound, seconds "
+                            "(default $JT_LEASE_TTL_S, 15)")
+        p.add_argument("--claim-budget", dest="claim_budget", type=int,
+                       default=None,
+                       help="Lease claims per worker per tick — the "
+                            "takeover-storm breaker "
+                            "($JT_SERVICE_CLAIM_BUDGET, 2)")
+        p.add_argument("--crash-quiet", dest="crash_quiet", type=float,
+                       default=1.0,
+                       help="Dead-writer quiescence before a crashed "
+                            "tenant finalizes, seconds")
+        # Cluster budget ledger (service/budget.json) — orchestrator
+        # mode only; workers READ the ledger.
+        p.add_argument("--cluster-tenants", type=int, default=None,
+                       help="Budget: total tenants across ALL workers")
+        p.add_argument("--cluster-wide-tenants", type=int, default=None,
+                       help="Budget: total wide (W > --wide-w) tenants")
+        p.add_argument("--wide-w", dest="wide_w", type=int,
+                       default=None,
+                       help="Budget: W class past which a tenant "
+                            "counts wide")
+        p.add_argument("--cluster-ingest-ops", type=float, default=None,
+                       help="Budget: total ingest ops/s across "
+                            "workers (0 = unlimited)")
+        p.add_argument("--slo-ttfv", dest="slo_ttfv", type=float,
+                       default=None,
+                       help="Budget: cluster ttfv p99 SLO, seconds — "
+                            "a breach publishes durable scale advice "
+                            "(0 = off)")
+
+    def _worker_flags(opts):
+        out = ["--model", opts.model, "--poll", str(opts.poll),
+               "--interval", str(opts.interval),
+               "--max-w", str(opts.max_w),
+               "--max-tenants", str(opts.max_tenants),
+               "--crash-quiet", str(opts.crash_quiet)]
+        if opts.lease_ttl is not None:
+            out += ["--lease-ttl", str(opts.lease_ttl)]
+        if opts.claim_budget is not None:
+            out += ["--claim-budget", str(opts.claim_budget)]
+        if opts.ticks:
+            out += ["--ticks", str(opts.ticks)]
+        return out
 
     def run(opts):
-        from .web import serve
-        print(f"Listening on http://{opts.host}:{opts.port}/")
-        serve(host=opts.host, port=opts.port, block=True)
-        return 0
+        import json as _json
+
+        if opts.workers is None and not opts.join:
+            # Web-server-only mode — the reference's serve.
+            from .web import serve
+            port = 8080 if opts.port is None else opts.port
+            print(f"Listening on http://{opts.host}:{port}/")
+            serve(host=opts.host, port=port, block=True)
+            return 0
+
+        from .recheck import registry
+        from .runtime import GracefulShutdown
+
+        spec = registry()[opts.model]
+        if opts.join:
+            if not opts.worker_id:
+                print("--join needs --worker-id")
+                return 254
+            from .online import OnlineConfig
+            from .service import ServiceWorker
+            from .store import Store
+            cfg = OnlineConfig(model=spec["model"](),
+                               poll_s=opts.poll,
+                               check_interval_ops=opts.interval,
+                               max_w=opts.max_w,
+                               max_tenants=opts.max_tenants,
+                               crash_quiet_s=opts.crash_quiet)
+            worker = ServiceWorker(store=Store(opts.join), config=cfg,
+                                   worker_id=opts.worker_id,
+                                   lease_ttl=opts.lease_ttl,
+                                   claim_budget=opts.claim_budget)
+            with GracefulShutdown() as gs:
+                try:
+                    worker.run(stop=gs.stop, ticks=opts.ticks or None,
+                               until_idle=opts.until_idle)
+                finally:
+                    worker.close()
+            summ = worker.summary()
+            print(_json.dumps(summ, default=str))
+            return 0 if all(t.get("valid_so_far") is not False
+                            for t in summ["tenants"].values()) else 1
+
+        from .service import serve_store
+        from .web import serve as web_serve
+        budget = {k: v for k, v in (
+            ("max_tenants", opts.cluster_tenants),
+            ("max_wide_tenants", opts.cluster_wide_tenants),
+            ("wide_w", opts.wide_w),
+            ("max_ingest_ops_s", opts.cluster_ingest_ops),
+            ("slo_ttfv_s", opts.slo_ttfv)) if v is not None}
+        srv = None
+        if opts.port is not None:
+            srv = web_serve(host=opts.host, port=opts.port)
+            print(f"Control plane on "
+                  f"http://{opts.host}:{srv.server_address[1]}"
+                  f"/service")
+        with GracefulShutdown() as gs:
+            if srv is not None:
+                # The serving loop polls gs.stop; the web thread
+                # doesn't — stop it from the signal path directly.
+                gs.on_stop(srv.shutdown)
+            try:
+                out = serve_store(
+                    workers=opts.workers, model=spec["model"](),
+                    budget=budget, until_idle=opts.until_idle,
+                    ticks=opts.ticks or None, stop=gs.stop,
+                    poll_s=opts.poll,
+                    lease_ttl=opts.lease_ttl,
+                    claim_budget=opts.claim_budget,
+                    worker_args=_worker_flags(opts),
+                    max_w=opts.max_w,
+                    check_interval_ops=opts.interval,
+                    max_tenants=opts.max_tenants,
+                    crash_quiet_s=opts.crash_quiet)
+            finally:
+                if srv is not None:
+                    srv.shutdown()
+        line = {"valid": out["valid"], "invalid": out["invalid"],
+                "workers": {w: s["stats"]
+                            for w, s in out["workers"].items()},
+                "tenants": out["leases"]["tenants"],
+                "done": out["leases"]["done"],
+                "takeovers": out["leases"]["takeovers"],
+                "verdicts": out["verdicts"]}
+        print(_json.dumps(line, default=str))
+        return 0 if out["valid"] else 1
 
     return {"serve": {"add_opts": add_opts, "run": run}}
 

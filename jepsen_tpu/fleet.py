@@ -106,6 +106,21 @@ def lease_ttl_s() -> float:
         return 15.0
 
 
+def lease_skew_s() -> float:
+    """$JT_LEASE_SKEW_S: wall-clock skew allowance between lease
+    writers on a shared store. Expiry compares the local clock against
+    the lease file's own stamped wall time, so two hosts whose clocks
+    disagree by up to this much cannot double-own a unit: the live
+    window extends by the allowance, and a lease stamped in the local
+    FUTURE is never stolen at all (refused + counted —
+    ``lease_skew_rejects``). Default 2 s; NFS-grade skew deployments
+    raise it."""
+    try:
+        return max(0.0, float(os.environ.get("JT_LEASE_SKEW_S", "2")))
+    except ValueError:
+        return 2.0
+
+
 # ------------------------------------------------------ cost-based router
 
 def router_rates() -> Dict[str, float]:
@@ -377,29 +392,39 @@ def _lease_path(cdir: Path, chunk_id: int) -> Path:
     return cdir / LEASES_DIR / f"chunk-{chunk_id}.json"
 
 
-def _lease_payload(chunk_id: int, units, worker: str, gen: int,
-                   done: bool = False) -> dict:
-    return {"chunk": int(chunk_id), "units": [int(u) for u in units],
-            "worker": worker, "pid": os.getpid(),
-            "host": socket.gethostname(), "hb": time.time(),
+def lease_payload(extra: dict, worker: str, gen: int,
+                  done: bool = False, hb: Optional[float] = None) -> dict:
+    """The generic lease record: WHO (worker/pid/host), WHEN (the
+    stamped wall-time heartbeat every expiry decision compares
+    against), the takeover generation, and caller fields (``extra``) —
+    fleet chunks carry their unit list, service tenants their run
+    key."""
+    return {**extra, "worker": worker, "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "hb": time.time() if hb is None else float(hb),
             "gen": int(gen), "done": bool(done)}
 
 
-def claim_chunk(cdir: Path, chunk_id: int, units, worker: str,
+def claim_lease(path: Path, extra: dict, worker: str,
                 ttl: float) -> Optional[int]:
-    """Try to claim one seed-range lease. Returns the claimed
-    generation (0 = first owner, >0 = takeover of an expired lease) or
-    None when the chunk is done or someone else holds a live lease.
-    First claim is an atomic hard-link of a fully-written payload
-    (two fresh workers cannot both win, and no reader ever sees an
-    empty or partial lease file); takeover is atomic-replace at
-    generation+1 with a read-back — the loser of a takeover race sees
-    the other worker's record and walks away, and ownership is
-    re-verified before every unit (the heartbeat's ``lost`` flag), so
-    a stolen lease is abandoned at the next unit boundary."""
-    path = _lease_path(cdir, chunk_id)
+    """Try to claim one lease file — the shared ownership primitive of
+    the fleet campaign (seed chunks) and the checking service (live
+    tenants). Returns the claimed generation (0 = first owner, >0 =
+    takeover of an expired lease) or None when the unit is done or
+    someone else holds a live lease.
+
+    First claim is an atomic hard-link of a fully-written payload (two
+    fresh workers cannot both win, and no reader ever sees an empty or
+    partial lease file); takeover is atomic-replace at generation+1
+    with a read-back — the loser of a takeover race sees the other
+    worker's record and walks away. Expiry compares the local wall
+    clock against the lease's OWN stamped time with a
+    ``$JT_LEASE_SKEW_S`` allowance, and a lease stamped in the local
+    future is refused outright (``lease_skew_rejects``): clock-skewed
+    hosts on a shared store cannot double-own a unit."""
+    path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    payload = _lease_payload(chunk_id, units, worker, 0)
+    payload = lease_payload(extra, worker, 0)
     # Worker id in the temp name: pids alone can collide across hosts
     # on a shared store.
     tmp = path.with_name(f"{path.name}.claim.{worker}.{os.getpid()}")
@@ -431,43 +456,139 @@ def claim_chunk(cdir: Path, chunk_id: int, units, worker: str,
         cur = {"gen": -1, "hb": 0.0}
     if cur.get("done"):
         return None
+    if cur.get("worker") == worker and not cur.get("released"):
+        # Already ours (re-entry: same worker id after a restart, or
+        # a crashed campaign resumed under deterministic ids). Refresh
+        # the heartbeat as part of the re-claim — a stale own stamp
+        # would otherwise trip renew_lease's lapsed-owner guard and
+        # churn claim→renew-refused→lost forever.
+        gen = int(cur.get("gen", 0))
+        atomic_write_json(path, lease_payload(extra, worker, gen))
+        return gen
     if cur.get("worker") == worker:
-        return int(cur.get("gen", 0))        # already ours (re-entry)
-    if time.time() - float(cur.get("hb", 0.0)) < ttl:
+        # Our own RELEASED lease (the peer we released to never came):
+        # re-claim like a takeover — generation bumps and the released
+        # flag clears, so renewals work again instead of churning
+        # claim→renew-refused→lost forever.
+        gen = int(cur.get("gen", 0)) + 1
+        atomic_write_json(path, lease_payload(extra, worker, gen))
+        back = _read_json(path)
+        if back and back.get("worker") == worker and \
+                int(back.get("gen", -1)) == gen:
+            return gen
+        return None
+    now = time.time()
+    hb = float(cur.get("hb", 0.0))
+    skew = lease_skew_s()
+    if hb > now + skew:
+        # A heartbeat from our future: the other host's clock (or
+        # ours) is off by more than the allowance. Stealing now could
+        # double-own the unit — refuse, loudly, and let the operator
+        # (or a raised JT_LEASE_SKEW_S) resolve it.
+        telemetry.REGISTRY.counter("fleet.lease_skew_rejects").inc()
+        log.warning(
+            "lease %s is stamped %.1fs in the local future (skew "
+            "allowance %.1fs); refusing takeover — check host clocks "
+            "or raise JT_LEASE_SKEW_S", path, hb - now, skew)
+        return None
+    if now - hb < ttl + skew:
         return None                          # live somewhere else
     gen = int(cur.get("gen", 0)) + 1
-    atomic_write_json(path, _lease_payload(chunk_id, units, worker, gen))
+    atomic_write_json(path, lease_payload(extra, worker, gen))
     back = _read_json(path)
     if back and back.get("worker") == worker and \
             int(back.get("gen", -1)) == gen:
-        telemetry.event("fleet.takeover", chunk=int(chunk_id),
-                        gen=gen)
+        telemetry.event("fleet.takeover", path=str(path), gen=gen)
         return gen
     return None
 
 
-def mark_done(cdir: Path, chunk_id: int, units, worker: str,
-              gen: int) -> None:
-    """Retire a completed chunk's lease — done leases never expire, so
+def renew_lease(path: Path, extra: dict, worker: str,
+                gen: int, ttl: Optional[float] = None) -> bool:
+    """Refresh a held lease's heartbeat. False (nothing written) when
+    the on-disk record names someone else — the owner's signal to
+    abandon the unit instead of double-writing. A lease we already
+    marked done is left untouched (True): a heartbeat racing the
+    done-mark must never resurrect it as live.
+
+    With ``ttl``, an owner whose OWN last stamp has already lapsed
+    past ttl+skew also refuses (False): the unit is legally claimable
+    by a peer at that point, and a renewal racing the peer's takeover
+    write could resurrect the old ownership on top of it — the
+    stalled owner must abandon and re-claim through the front door
+    instead. (Both times compare on this host's clock, so host skew
+    doesn't enter.)"""
+    cur = _read_json(path)
+    if cur is None or cur.get("worker") != worker or \
+            int(cur.get("gen", -1)) != int(gen):
+        return False
+    if cur.get("done"):
+        return True
+    if cur.get("released"):
+        return False                  # we handed it back: stay away
+    if ttl is not None and time.time() - float(cur.get("hb", 0.0)) \
+            >= float(ttl) + lease_skew_s():
+        return False                  # lapsed: a takeover may be live
+    atomic_write_json(path, lease_payload(extra, worker, gen))
+    return True
+
+
+def release_lease(path: Path, extra: dict, worker: str,
+                  gen: int) -> bool:
+    """Voluntarily hand a held lease back (cost-routed rebalancing):
+    the record keeps its generation but its heartbeat is zeroed, so
+    any peer's next claim takes over immediately at generation+1 —
+    with all the unit's durable progress (summaries, journals) intact
+    for the new owner to resume."""
+    cur = _read_json(path)
+    if cur is None or cur.get("worker") != worker or \
+            int(cur.get("gen", -1)) != int(gen):
+        return False
+    atomic_write_json(path, {**lease_payload(extra, worker, gen,
+                                             hb=0.0),
+                             "released": True})
+    return True
+
+
+def mark_lease_done(path: Path, extra: dict, worker: str,
+                    gen: int) -> None:
+    """Retire a completed unit's lease — done leases never expire, so
     no survivor wastes a takeover on finished work."""
-    path = _lease_path(cdir, chunk_id)
     cur = _read_json(path)
     if cur and cur.get("worker") == worker and \
             int(cur.get("gen", -1)) == int(gen):
-        atomic_write_json(path, _lease_payload(chunk_id, units, worker,
-                                               gen, done=True))
+        atomic_write_json(path, lease_payload(extra, worker, gen,
+                                              done=True))
+
+
+def _chunk_extra(chunk_id: int, units) -> dict:
+    return {"chunk": int(chunk_id), "units": [int(u) for u in units]}
+
+
+def claim_chunk(cdir: Path, chunk_id: int, units, worker: str,
+                ttl: float) -> Optional[int]:
+    """The fleet campaign's lease claim: one seed-range chunk
+    (claim_lease over ``leases/chunk-<k>.json``)."""
+    return claim_lease(_lease_path(cdir, chunk_id),
+                       _chunk_extra(chunk_id, units), worker, ttl)
+
+
+def mark_done(cdir: Path, chunk_id: int, units, worker: str,
+              gen: int) -> None:
+    mark_lease_done(_lease_path(cdir, chunk_id),
+                    _chunk_extra(chunk_id, units), worker, gen)
 
 
 class LeaseHeartbeat:
     """Renews a held lease every ttl/3 on a daemon thread; flips
     ``lost`` (and stops renewing) the moment the on-disk record names
-    someone else — the worker's signal to abandon the chunk at the
-    next unit boundary instead of double-writing."""
+    someone else — the worker's signal to abandon the unit at the
+    next boundary instead of double-writing."""
 
     def __init__(self, cdir: Path, chunk_id: int, units, worker: str,
                  gen: int, ttl: float):
         self.path = _lease_path(cdir, chunk_id)
-        self.chunk_id, self.units = chunk_id, units
+        self.extra = _chunk_extra(chunk_id, units)
         self.worker, self.gen, self.ttl = worker, int(gen), float(ttl)
         self.lost = threading.Event()
         self._stop = threading.Event()
@@ -481,13 +602,10 @@ class LeaseHeartbeat:
     def _run(self) -> None:
         period = max(0.1, self.ttl / 3.0)
         while not self._stop.wait(period):
-            cur = _read_json(self.path)
-            if cur is None or cur.get("worker") != self.worker or \
-                    int(cur.get("gen", -1)) != self.gen:
+            if not renew_lease(self.path, self.extra, self.worker,
+                               self.gen, ttl=self.ttl):
                 self.lost.set()
                 return
-            atomic_write_json(self.path, _lease_payload(
-                self.chunk_id, self.units, self.worker, self.gen))
 
     def stop(self) -> None:
         self._stop.set()
@@ -885,6 +1003,140 @@ def _spawn_worker(campaign_dir: Path, worker_id: str):
     return p
 
 
+class LocalPool:
+    """Spawn + babysit a pool of local worker subprocesses — the fleet
+    driver's spawner, reusable by the checking service
+    (jepsen_tpu.service). Owns worker-id allocation, dead-worker reap
+    + bounded respawn, and SLO-advice-driven scale-up
+    (``apply_scale_advice``); it knows nothing about what the workers
+    do — the spawn callback does."""
+
+    def __init__(self, spawn_fn, n: int, *,
+                 max_respawns: Optional[int] = None,
+                 cap: Optional[int] = None):
+        self.spawn_fn = spawn_fn               # worker_id -> Popen
+        self.cap = max_local_workers() if cap is None else int(cap)
+        self.target = min(int(n), self.cap) if self.cap else int(n)
+        self.procs: Dict[str, object] = {}
+        self.spawned = 0
+        self.budget = (self.target if max_respawns is None
+                       else int(max_respawns))
+
+    def start(self) -> "LocalPool":
+        while len(self.procs) < self.target:
+            self._spawn_one()
+        return self
+
+    def _spawn_one(self) -> str:
+        wid = f"w{self.spawned}"
+        self.spawned += 1
+        self.procs[wid] = self.spawn_fn(wid)
+        return wid
+
+    def reap(self, respawn: bool = True) -> List[str]:
+        """Collect exited workers; respawn (bounded) when the caller
+        says the pool still has work. Returns the reaped ids."""
+        dead = [wid for wid, p in self.procs.items()
+                if p.poll() is not None]
+        for wid in dead:
+            p = self.procs.pop(wid)
+            getattr(p, "_jt_log", None) and p._jt_log.close()
+            if p.returncode != 0:
+                log.warning("local worker %s exited rc=%s", wid,
+                            p.returncode)
+            if respawn and self.budget > 0:
+                self.budget -= 1
+                nid = self._spawn_one()
+                log.info("respawning local worker (%s -> %s)", wid, nid)
+        return dead
+
+    def revive(self) -> bool:
+        """Budgeted single respawn for a pool found EMPTY with work
+        remaining (reap only replaces processes it catches dying —
+        a caller that drained to zero between polls uses this).
+        False when the respawn budget is spent."""
+        if self.budget <= 0:
+            return False
+        self.budget -= 1
+        wid = self._spawn_one()
+        log.info("reviving empty worker pool (-> %s)", wid)
+        return True
+
+    def scale_to(self, n: int) -> int:
+        """Widen the pool to ``n`` workers (bounded by the local core
+        cap — width beyond the cores belongs on more hosts). Returns
+        how many were spawned."""
+        n = min(int(n), self.cap) if self.cap else int(n)
+        added = 0
+        while len(self.procs) < n:
+            self._spawn_one()
+            added += 1
+        self.target = max(self.target, min(n, len(self.procs)))
+        return added
+
+    def apply_scale_advice(self, path, max_age_s: float = 300.0) -> int:
+        """Act on a durable ``service/scale-advice.json`` (the SLO
+        breach signal the checking service publishes): widen the pool
+        toward ``want_workers``, then CONSUME the file — advice is a
+        one-shot signal, not standing configuration, and a breach that
+        subsided must not over-provision every future pool. Advice
+        stamped more than ``max_age_s`` ago is discarded unacted (a
+        days-old file found by a fresh serve session describes a
+        days-old breach). Returns workers spawned (0 when the advice
+        is absent, stale, or already satisfied)."""
+        adv = _read_json(path)
+        if not adv:
+            return 0
+        try:
+            want = int(adv.get("want_workers", 0))
+            age = time.time() - float(adv.get("at") or 0.0)
+        except (TypeError, ValueError):
+            return 0
+        if age > max_age_s:
+            try:
+                Path(path).unlink()
+            except OSError:
+                pass
+            return 0
+        if want <= len(self.procs):
+            return 0
+        added = self.scale_to(want)
+        if added:
+            log.info("scale advice %s: spawned %d worker(s) (pool now "
+                     "%d; reason: %s)", path, added, len(self.procs),
+                     adv.get("reason"))
+            telemetry.REGISTRY.counter("service.scaled_workers").inc(
+                added)
+            try:
+                Path(path).unlink()      # consumed
+            except OSError:
+                pass
+        return added
+
+    def shutdown(self, timeout: float = 15.0,
+                 terminate: bool = True) -> None:
+        """Stop the pool: SIGTERM every worker first (their own
+        GracefulShutdown finishes in-flight work and exits clean),
+        wait out ``timeout`` each, SIGKILL stragglers. ``terminate=
+        False`` waits for natural exit first — the --until-idle path,
+        where workers are already draining."""
+        if terminate:
+            for p in self.procs.values():
+                if p.poll() is None:
+                    try:
+                        p.terminate()
+                    except Exception:
+                        pass
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=timeout)
+            except Exception:
+                p.kill()
+                p.wait()
+            getattr(p, "_jt_log", None) and p._jt_log.close()
+        self.procs.clear()
+
+
 def fleet_campaign(*, name: str = "fleet", kind: str = "synth",
                    seeds: Optional[Sequence[int]] = None, spec=None,
                    model: str = "cas", synth: str = "device",
@@ -1010,40 +1262,23 @@ def _work_spec(name, kind, units, spec, model, synth, test, timestamps,
 def _drive_workers(cdir: Path, ws: dict, workers: int, poll_s: float,
                    max_respawns: Optional[int], stop) -> None:
     """Spawn + babysit the local worker pool until the campaign
-    completes. Lease expiry already redistributes a dead worker's
-    units to survivors; respawning (bounded) just restores pool
-    width — and is the only recovery when EVERY worker died."""
-    procs = {}
-    spawned = 0
+    completes (LocalPool). Lease expiry already redistributes a dead
+    worker's units to survivors; respawning (bounded) just restores
+    pool width — and is the only recovery when EVERY worker died."""
     seen: set = set()            # memoized completed units (per poll)
-    for i in range(workers):
-        wid = f"w{i}"
-        procs[wid] = _spawn_worker(cdir, wid)
-        spawned += 1
-    budget = workers if max_respawns is None else int(max_respawns)
+    # cap=0: fleet_campaign already applied the local-core cap when it
+    # sized the pool.
+    pool = LocalPool(lambda wid: _spawn_worker(cdir, wid), workers,
+                     max_respawns=max_respawns, cap=0).start()
     try:
         while True:
             if campaign_complete(cdir, ws, seen=seen):
                 break
             if stop is not None and stop.is_set():
                 break
-            dead = [wid for wid, p in procs.items()
-                    if p.poll() is not None]
-            for wid in dead:
-                p = procs.pop(wid)
-                getattr(p, "_jt_log", None) and p._jt_log.close()
-                if p.returncode != 0:
-                    log.warning("fleet worker %s exited rc=%s", wid,
-                                p.returncode)
-                if not campaign_complete(cdir, ws, seen=seen) \
-                        and budget > 0:
-                    budget -= 1
-                    nid = f"w{spawned}"
-                    spawned += 1
-                    log.info("respawning fleet worker (%s -> %s)",
-                             wid, nid)
-                    procs[nid] = _spawn_worker(cdir, nid)
-            if not procs:
+            pool.reap(respawn=not campaign_complete(cdir, ws,
+                                                    seen=seen))
+            if not pool.procs:
                 if campaign_complete(cdir, ws, seen=seen):
                     break
                 raise RuntimeError(
@@ -1052,11 +1287,9 @@ def _drive_workers(cdir: Path, ws: dict, workers: int, poll_s: float,
                     f"{cdir}/worker-*.log")
             time.sleep(poll_s)
     finally:
-        for p in procs.values():
-            try:
-                p.wait(timeout=max(5.0, 3 * float(
-                    ws.get("lease_ttl_s", 15.0))))
-            except Exception:
-                p.kill()
-                p.wait()
-            getattr(p, "_jt_log", None) and p._jt_log.close()
+        # No SIGTERM here: fleet workers have no graceful-shutdown
+        # handler — a terminate would kill them mid-unit and lose
+        # their worker-<id>.json summaries; they exit on their own
+        # once the campaign completes.
+        pool.shutdown(timeout=max(5.0, 3 * float(
+            ws.get("lease_ttl_s", 15.0))), terminate=False)

@@ -330,6 +330,31 @@ def wal_progress(path) -> Optional[dict]:
                 "bytes": out.get("size", st.pos)}
 
 
+def estimate_peak_w(path, *, max_bytes: int = 1 << 20
+                    ) -> Optional[Tuple[int, int]]:
+    """Cheap tenant-shape probe for the checking service's placement
+    and W-class admission (jepsen_tpu.service): the peak pending
+    window and op count of the WAL's first ``max_bytes`` — one bounded
+    scan, no cursor kept, no tenant state touched. The window rule
+    matches the encoder's (and OnlineTenant._track_w's): invokes open
+    a slot, ok/fail completions close it, ``:info`` pends forever.
+    Returns (peak_w, n_ops) or None when the file has no durable
+    header (or isn't a WAL)."""
+    st, out = tail_wal(path, None, max_bytes=max_bytes)
+    if st.header is None or out["bad_magic"] or out["missing"]:
+        return None
+    open_: set = set()
+    peak = 0
+    for op in out["ops"]:
+        if op.type == INVOKE:
+            open_.add(op.process)
+            if len(open_) > peak:
+                peak = len(open_)
+        elif op.is_completion and op.type != INFO:
+            open_.discard(op.process)
+    return peak, st.n_ops
+
+
 def wal_header(path) -> Optional[dict]:
     """Just the (fsynced-first) header line — the cheap probe for
     sweeps that must not read a potentially huge segment. None when the

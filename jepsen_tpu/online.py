@@ -274,6 +274,13 @@ class OnlineConfig:
     widen_factor: int = 4
     shed_pending_ops: int = 32768        # L2: shed to the host oracle
     defer_pending_ops: int = 131072      # L3: pause stalest tenant
+    # Hard re-admission deadline for deferred tenants ($JT_DEFER_MAX_S,
+    # default 300 s; 0 = disabled, the convention every sibling knob
+    # uses): past it the stalest deferred tenant is force-admitted
+    # ahead of fresh ones even while the fleet stays busy — no live
+    # stream waits unboundedly behind a persistently overloaded
+    # daemon (``deferred_starvation_rescues``).
+    defer_max_s: Optional[float] = None
     # -- finalization
     crash_quiet_s: float = 1.0      # writer dead AND quiet this long
     min_device_batch: int = 64      # Store.recheck's value (parity)
@@ -283,6 +290,12 @@ class OnlineConfig:
         if self.model is None:
             from .models.core import cas_register
             self.model = cas_register()
+        if self.defer_max_s is None:
+            try:
+                self.defer_max_s = max(
+                    0.0, float(os.environ.get("JT_DEFER_MAX_S", "300")))
+            except ValueError:
+                self.defer_max_s = 300.0
 
 
 # --------------------------------------------------------------- engine
@@ -359,6 +372,7 @@ class OnlineTenant:
         self.state = TailState()
         self.ops: List[Op] = []
         self.status = "tailing"         # tailing | deferred | done
+        self.deferred_at: Optional[float] = None  # wall time (durable)
         self.result: Optional[dict] = None
         self.salvaged: Optional[bool] = None
         self.valid_so_far: Optional[bool] = None
@@ -395,6 +409,15 @@ class OnlineTenant:
             self.status = "done"
         elif (self.run_dir / ONLINE_DEFERRED).exists():
             self.status = "deferred"
+            # The overload pause survives the daemon — and so must its
+            # starvation deadline: the mark's own stamp, not this
+            # incarnation's admit time, ages the deferral.
+            try:
+                self.deferred_at = float(json.loads(
+                    (self.run_dir / ONLINE_DEFERRED).read_text()
+                ).get("deferred_at") or time.time())
+            except Exception:
+                self.deferred_at = time.time()
         fv = daemon.store.first_violation(name, ts)
         if fv is not None:
             self.first_violation = fv
@@ -529,6 +552,10 @@ class OnlineTenant:
                 self._track_w(op)
             self.ops.extend(out["ops"])
             self.last_growth = time.monotonic()
+            if out["ops"]:
+                # The daemon's ingest meter — what the service layer's
+                # cluster-wide ingest-rate budget is enforced against.
+                d._count("ingested_ops", len(out["ops"]))
         return bool(out["grew"])
 
     # ----------------------------------------------------------- checks
@@ -735,8 +762,10 @@ class OnlineTenant:
         """Overload L3: pause this tenant durably, release its buffer
         (the WAL itself is the queue; the journal keeps its decided
         prefixes, so resuming re-dispatches none of them)."""
+        self.deferred_at = time.time()
         atomic_write_json(self.run_dir / ONLINE_DEFERRED,
-                          {"run": self.key, "deferred_at": time.time(),
+                          {"run": self.key,
+                           "deferred_at": self.deferred_at,
                            "pending": self.pending})
         if self.journal is not None:
             self.journal.close()
@@ -752,6 +781,7 @@ class OnlineTenant:
         if mark.exists():
             mark.unlink()
         self.status = "tailing"
+        self.deferred_at = None
         self.last_growth = time.monotonic()
 
     def close(self) -> None:
@@ -797,7 +827,9 @@ class OnlineDaemon:
                       "backpressure": 0, "rotations": 0,
                       "stage_faults": 0, "check_errors": 0,
                       "unknown_verdicts": 0, "first_violations": 0,
-                      "finalized": 0, "resumed_prefixes": 0}
+                      "finalized": 0, "resumed_prefixes": 0,
+                      "ingested_ops": 0,
+                      "deferred_starvation_rescues": 0}
         self._t0 = time.monotonic()
 
     # ---------------------------------------------------------- helpers
@@ -942,6 +974,27 @@ class OnlineDaemon:
                 t = min(deferred, key=lambda t: t.t_admitted)
                 t.resume()
                 self._count("resumed")
+        if level >= 2 and self.cfg.defer_max_s > 0:
+            # Deferred-starvation deadline: "resumes as load drops" is
+            # not a liveness guarantee under a PERSISTENTLY busy
+            # daemon. Past defer_max_s the stalest deferred tenant is
+            # force-admitted ahead of fresh prefixes, load or no load.
+            now = time.time()
+            overdue = [t for t in self.tenants.values()
+                       if t.status == "deferred"
+                       and t.deferred_at is not None
+                       and now - t.deferred_at
+                       >= self.cfg.defer_max_s]
+            if overdue:
+                t = min(overdue, key=lambda t: t.deferred_at)
+                log.warning(
+                    "deferred tenant %s blew its %.0fs re-admission "
+                    "deadline under sustained load; force-admitting "
+                    "it ahead of fresh prefixes", t.key,
+                    self.cfg.defer_max_s)
+                t.resume()
+                self._count("resumed")
+                self._count("deferred_starvation_rescues")
         # Fresh-prefix-first: the most recently grown tenants are
         # serviced first, so a hot run's verdict lag stays at one
         # interval even when a cold backlog exists.

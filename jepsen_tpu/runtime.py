@@ -97,6 +97,17 @@ class GracefulShutdown:
             else (signal.SIGTERM, signal.SIGINT)
         self.stop = threading.Event()
         self._prev: Dict[int, Any] = {}
+        self._on_stop: List[Callable[[], None]] = []
+
+    def on_stop(self, fn: Callable[[], None]) -> "GracefulShutdown":
+        """Register a callback fired once when the first stop signal
+        lands (after ``stop`` is set) — for side resources the serving
+        loop doesn't poll, e.g. the checking-service orchestrator's
+        embedded web server (cli.py serve). Callbacks must be quick
+        and exception-safe; failures are logged, never raised into the
+        signal handler."""
+        self._on_stop.append(fn)
+        return self
 
     def _handle(self, signum, frame) -> None:
         if self.stop.is_set():
@@ -105,6 +116,11 @@ class GracefulShutdown:
         log.info("signal %s: finishing the in-flight work, then "
                  "shutting down (signal again to abort)", signum)
         self.stop.set()
+        for fn in self._on_stop:
+            try:
+                fn()
+            except Exception:
+                log.warning("on_stop callback failed", exc_info=True)
 
     def install(self) -> "GracefulShutdown":
         import signal

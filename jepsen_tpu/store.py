@@ -74,6 +74,19 @@ ONLINE_REGISTRY = "online-registry.json"
 # excludes it the way it excludes the latest symlinks).
 FLEET_DIR = "fleet"
 
+# Federated checking-service namespace (jepsen_tpu.service): the
+# cluster's coordination state lives under store/service/ — the budget
+# ledger (cluster-wide admission limits), per-tenant lease files
+# (which worker owns which live run), per-worker registry entries
+# (heartbeat + usage + capability, the web control plane's source),
+# and the durable SLO scale signal. Coordination state, never a test
+# (tests() excludes the whole directory).
+SERVICE_DIR = "service"
+SERVICE_BUDGET = "budget.json"
+SERVICE_ADVICE = "scale-advice.json"
+SERVICE_TENANTS_DIR = "tenants"
+SERVICE_WORKERS_DIR = "workers"
+
 
 class CampaignMismatch(ValueError):
     """An explicit campaign resume named a checkpoint belonging to a
@@ -306,7 +319,7 @@ class Store:
             return out
         for name_dir in sorted(self.base.iterdir()):
             if (not name_dir.is_dir() or name_dir.is_symlink()
-                    or name_dir.name == "latest"):
+                    or name_dir.name in ("latest", SERVICE_DIR)):
                 continue
             runs = [d.name for d in sorted(name_dir.iterdir())
                     if d.is_dir() and not d.is_symlink()
@@ -411,6 +424,45 @@ class Store:
     def save_online_registry(self, reg: dict) -> None:
         self.base.mkdir(parents=True, exist_ok=True)
         atomic_write_json(self.online_registry_path(), reg)
+
+    # ---------------------------------------------------------- service
+    def service_dir(self) -> Path:
+        """The federated checking service's cluster namespace
+        (jepsen_tpu.service, doc/service.md): budget ledger, tenant
+        leases, worker registry, scale advice — all shared-filesystem
+        coordination, never runs."""
+        return self.base / SERVICE_DIR
+
+    def service_budget_path(self) -> Path:
+        return self.service_dir() / SERVICE_BUDGET
+
+    def service_advice_path(self) -> Path:
+        return self.service_dir() / SERVICE_ADVICE
+
+    def service_tenant_lease_path(self, test_name: str, ts: str) -> Path:
+        # Flat filenames: run keys never contain path separators, and
+        # the payload carries the authoritative "run" key anyway.
+        return (self.service_dir() / SERVICE_TENANTS_DIR
+                / f"{test_name}__{ts}.json")
+
+    def service_worker_path(self, worker_id: str) -> Path:
+        return (self.service_dir() / SERVICE_WORKERS_DIR
+                / f"{worker_id}.json")
+
+    def service_workers(self) -> Dict[str, dict]:
+        """{worker_id: registry entry} for every worker that ever
+        published into this store's service namespace (the caller
+        filters liveness by heartbeat age)."""
+        out: Dict[str, dict] = {}
+        wdir = self.service_dir() / SERVICE_WORKERS_DIR
+        if not wdir.exists():
+            return out
+        for f in sorted(wdir.glob("*.json")):
+            try:
+                out[f.stem] = json.loads(f.read_text())
+            except Exception:
+                continue
+        return out
 
     def _run_json(self, test_name: str, ts: str, name: str
                   ) -> Optional[dict]:

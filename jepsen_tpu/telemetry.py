@@ -644,6 +644,34 @@ def counters_delta(base: Optional[dict], now: dict) -> dict:
     return out
 
 
+def merge_histogram_snapshots(snaps) -> dict:
+    """Fold several processes' histogram SUMMARIES (the snapshot()
+    shape: count/sum/min/max/p50/p99) into one cluster-wide view — the
+    checking service's cross-worker SLO aggregation. count/sum/min/max
+    merge exactly; percentiles cannot be recombined from summaries, so
+    the merged p50/p99 are the WORST (max) per-worker values — a
+    conservative upper bound, which is the right direction for an SLO
+    breach signal (doc/service.md)."""
+    out: dict = {}
+    for s in snaps:
+        for k, h in ((s or {}).get("histograms") or {}).items():
+            if not isinstance(h, dict) or not h.get("count"):
+                continue
+            m = out.get(k)
+            if m is None:
+                out[k] = dict(h)
+                continue
+            m["count"] += h["count"]
+            m["sum"] = round(m.get("sum", 0.0) + h.get("sum", 0.0), 6)
+            m["min"] = min(m["min"], h["min"])
+            m["max"] = max(m["max"], h["max"])
+            for p in ("p50", "p99"):
+                vals = [v for v in (m.get(p), h.get(p))
+                        if v is not None]
+                m[p] = max(vals) if vals else None
+    return out
+
+
 def merge_counter_snapshots(snaps) -> dict:
     """Sum the ``counters`` blocks of several processes' snapshots (or
     counters_delta outputs) into one — the fleet orchestrator's

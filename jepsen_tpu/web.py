@@ -112,6 +112,8 @@ class Handler(BaseHTTPRequestHandler):
             return self.index()
         if path == "/live":
             return self.live()
+        if path == "/service":
+            return self.service()
         if path.startswith("/files/"):
             return self.files(path[len("/files/"):])
         if path.startswith("/zip/"):
@@ -232,7 +234,8 @@ class Handler(BaseHTTPRequestHandler):
                     f"{html.escape(ts)}</a></td>"
                     f"<td>{vtxt}{badge}</td>"
                     f'<td><a href="/zip/{quote(rel)}">zip</a></td></tr>')
-        table = ('<p><a href="/live">live view</a></p>'
+        table = ('<p><a href="/live">live view</a> · '
+                 '<a href="/service">service</a></p>'
                  "<table><tr><th>test</th><th>run</th><th>valid?</th>"
                  "<th>export</th></tr>" + "".join(rows) + "</table>")
         self._page("Jepsen-TPU results", table)
@@ -286,8 +289,94 @@ class Handler(BaseHTTPRequestHandler):
                        if parts else
                        "<p>no metrics recorded in this process</p>")
         body = ('<meta http-equiv="refresh" content="2">'
-                '<p><a href="/">index</a></p>' + runs_tbl + metrics_tbl)
+                '<p><a href="/">index</a> · '
+                '<a href="/service">service</a></p>'
+                + runs_tbl + metrics_tbl)
         self._page("Jepsen-TPU live", body)
+
+    def service(self):
+        """The federated checking service's control plane: ONE page
+        over every worker's tenants, rendered from the shared store's
+        ``service/`` namespace (jepsen_tpu.service.service_summary) —
+        per-worker liveness/usage/stats, the tenant lease ledger with
+        takeover generations, the cluster budget, merged SLO
+        percentiles, and any standing scale advice. Works from any
+        host sharing the store; no worker is queried directly."""
+        from .service import service_summary
+        registry = self.store.service_workers()
+        s = service_summary(self.store, workers=registry)
+        now = time.time()
+        wrows = []
+        for wid, w in sorted(s["workers"].items()):
+            hb = float(w.get("hb") or 0.0)
+            alive = now - hb < 60.0
+            badge = ("live" if alive else "crashed")
+            st = w.get("stats") or {}
+            u = w.get("usage") or {}
+            wrows.append(
+                f"<tr><td>{html.escape(wid)}</td>"
+                f'<td><span class="badge badge-{badge}">{badge}'
+                f"</span></td>"
+                f"<td>{u.get('tenants', 0)}</td>"
+                f"<td>{round(u.get('ingest_ops_s') or 0.0, 1)}</td>"
+                f"<td>{st.get('checks', 0)}</td>"
+                f"<td>{st.get('finalized', 0)}</td>"
+                f"<td>{st.get('takeovers', 0)}</td>"
+                f"<td>{st.get('released', 0)}</td></tr>")
+        workers_tbl = (
+            "<h2>workers</h2><table><tr><th>worker</th><th>state</th>"
+            "<th>tenants</th><th>ingest ops/s</th><th>checks</th>"
+            "<th>finalized</th><th>takeovers</th><th>released</th>"
+            "</tr>" + "".join(wrows) + "</table>"
+            if wrows else "<p>no workers registered</p>")
+        trows = []
+        reg_tenants = {}
+        # Live workers' rows win: a crashed worker's frozen registry
+        # entry must not mask the survivor that took its tenants over
+        # (dead entries render only for tenants nobody live reports).
+        def _alive(w):
+            return now - float(w.get("hb") or 0.0) < 60.0
+        for wid, w in sorted(registry.items(),
+                             key=lambda kv: _alive(kv[1])):
+            for key, t in (w.get("tenants") or {}).items():
+                reg_tenants[key] = (wid, t)
+        for key, (wid, t) in sorted(reg_tenants.items()):
+            v = t.get("valid_so_far")
+            vtxt = {True: "✓ so far", False: "INVALID"}.get(
+                v, t.get("status", "?"))
+            cls = ("badge-violation" if v is False else "badge-clean"
+                   if v is True else "badge-live")
+            trows.append(
+                f"<tr><td>{html.escape(key)}</td>"
+                f"<td>{html.escape(wid)}</td>"
+                f"<td>{t.get('gen', '—')}</td>"
+                f"<td>{html.escape(str(t.get('status', '?')))}</td>"
+                f"<td>{t.get('checked_ops', 0)}</td>"
+                f'<td><span class="badge {cls}">'
+                f"{html.escape(vtxt)}</span></td></tr>")
+        tenants_tbl = (
+            "<h2>tenants</h2><table><tr><th>run</th><th>worker</th>"
+            "<th>gen</th><th>status</th><th>checked ops</th>"
+            "<th>verdict</th></tr>" + "".join(trows) + "</table>"
+            if trows else "<p>no tenants leased</p>")
+        slo = s.get("slo") or {}
+        adv = s.get("scale_advice")
+        meta = (
+            "<h2>cluster</h2><table>"
+            f"<tr><td>budget</td><td>{html.escape(json.dumps(s['budget']))}"
+            "</td></tr>"
+            f"<tr><td>leases</td><td>{s['leases']['tenants']} tenants, "
+            f"{s['leases']['done']} done, "
+            f"{s['leases']['takeovers']} takeovers</td></tr>"
+            f"<tr><td>ttfv</td><td>n={slo.get('count', 0)} "
+            f"p50={slo.get('p50')} p99={slo.get('p99')}</td></tr>"
+            f"<tr><td>scale advice</td><td>"
+            f"{html.escape(json.dumps(adv)) if adv else '—'}</td></tr>"
+            "</table>")
+        body = ('<meta http-equiv="refresh" content="2">'
+                '<p><a href="/">index</a> · <a href="/live">live</a>'
+                "</p>" + workers_tbl + tenants_tbl + meta)
+        self._page("Jepsen-TPU service", body)
 
     def files(self, rel: str):
         p = self._resolve(rel.rstrip("/"))

@@ -77,6 +77,13 @@ def pytest_configure(config):
                    "single-process pooled-verdict parity "
                    "(deterministic; runs in tier-1)")
     config.addinivalue_line(
+        "markers", "service: federated online checking service — "
+                   "leasable live tenants, dead-worker takeover with "
+                   "zero re-dispatched decided prefixes, cluster-wide "
+                   "admission budgets, cost-routed placement, "
+                   "takeover-storm breaker, SLO scale advice "
+                   "(deterministic; runs in tier-1)")
+    config.addinivalue_line(
         "markers", "telemetry: span tracer + metrics registry — "
                    "nesting/attributes, ring wraparound, Chrome-trace "
                    "export, snapshot determinism, no-op-when-off, and "

@@ -37,6 +37,13 @@ def test_bench_emits_contract_json():
                # startup).
                JT_BENCH_FLEET_WORKERS="1,2", JT_BENCH_FLEET_SEEDS="2",
                JT_BENCH_FLEET_B="32",
+               # Service probe at toy scale: one sweep point plus the
+               # kill-takeover measurement (two real workers, short
+               # lease TTL) — the tier-1 guard is the section's shape
+               # and skippability, not the latency figure itself.
+               JT_BENCH_SERVICE_WORKERS="1",
+               JT_BENCH_SERVICE_TENANTS="2", JT_BENCH_SERVICE_OPS="6",
+               JT_SERVICE_STAGGER_S="0", JT_LEASE_SKEW_S="0",
                # Tracing stays ambient-off: the section flips the
                # flight recorder on for its own traced passes only.
                JT_TRACE="0")
@@ -164,6 +171,26 @@ def test_bench_emits_contract_json():
     assert b["shed"] + b["deferred"] + b["widened"] > 0
     assert 0 <= b["shed_fraction"] <= 1
     assert d["xlong_history"]["synth_s"] >= 0
+    # Service section (ISSUE 11 acceptance): tenants-per-SLO vs real
+    # worker processes, plus the kill-a-worker takeover probe with
+    # bounded latency recorded per orphaned tenant.
+    sv = d["service"]
+    assert sv["tenants"] == 2 and sv["ops_per_tenant"] == 24
+    assert sv["host_cores"] >= 1
+    assert [p["workers"] for p in sv["points"]] == [1]
+    for p in sv["points"]:
+        assert p["e2e_s"] > 0 and p["tenants_per_s"] > 0
+        assert p["ttfv_p50_s"] is not None
+        assert p["ttfv_p99_s"] is not None
+        assert p["tenants_within_slo"] == 2
+        assert p["valid_ok"] is True
+    tk = sv["takeover"]
+    assert tk["tenants"] == 2 and tk["killed_owned"] >= 1
+    assert tk["measured"] == tk["killed_owned"]
+    assert tk["gen_bumps"] >= 1
+    assert tk["latency_p50_s"] is not None
+    assert 0 < tk["latency_p99_s"] < 60   # bounded: TTL + claim, not ∞
+    assert tk["valid_ok"] is True
     # Telemetry section (ISSUE 8 acceptance): the traced-overhead
     # measurement, span coverage of the checked path, and the
     # dispatch-gap (device-busy vs host-gap) breakdown.
