@@ -59,6 +59,12 @@ section measures span-tracing overhead against the ≤5% budget and the
 device-busy vs host-gap breakdown — doc/observability.md). JT_TRACE=1
 traces the WHOLE bench through the flight recorder and exports a
 Chrome-trace ``trace.json`` ($JT_TRACE_EXPORT overrides the path).
+JT_BENCH_BACKEND=pallas|xla|auto pins the WGL dispatch backend for
+the whole run (default auto: the cost router prices the Pallas
+megakernel against the lax.scan kernel from the startup rate probe);
+JT_BENCH_PROBE=0 skips that probe, JT_BENCH_BACKEND_COMPARE=0 skips
+the Pallas-vs-XLA rate table (JT_BENCH_COMPARE_WS / _B / _EVENTS size
+it — doc/scaling.md "Hand-schedule the inner loop").
 Narrow
 buckets all stay on device (the scheduler consolidates them into W
 classes); only tiny wide buckets route to the native CPU engine. The
@@ -107,6 +113,24 @@ def main():
                                ".jax_cache")
     enable_compilation_cache(_cache_root)
     os.environ.setdefault("JT_AOT_DIR", os.path.join(_cache_root, "aot"))
+
+    # WGL dispatch backend for every scheduler this process builds:
+    # JT_BENCH_BACKEND=pallas|xla|auto pins it ("auto" = the cost
+    # router decides per bucket from the measured rates below).
+    bench_backend = os.environ.get("JT_BENCH_BACKEND")
+    if bench_backend:
+        os.environ["JT_WGL_BACKEND"] = bench_backend
+    # Startup rate probe (ISSUE 12): measure both WGL device backends
+    # (lax.scan vs the Pallas megakernel) on one tiny workload and
+    # install the rates as the router overlay — what "chosen by the
+    # cost router, never hardcoded" prices from. JT_BENCH_PROBE=0
+    # skips (the router then keeps its unprobed defaults: scan only).
+    rate_probe = None
+    if os.environ.get("JT_BENCH_PROBE", "1") != "0":
+        from jepsen_tpu import fleet as _fleet
+        from jepsen_tpu.ops.pallas_wgl import probe_rates as _probe_rates
+        rate_probe = _probe_rates()
+        _fleet.set_measured_rates(rate_probe)
     import numpy as np
     from jepsen_tpu.checkers.linearizable import wgl_check
     from jepsen_tpu.history.columnar import columnar_to_ops
@@ -1158,6 +1182,11 @@ def main():
             "host_gap_frac": gap["host_gap_frac"],
             "n_gaps": gap["n_gaps"],
             "top_gap_causes": gap["top_gap_causes"][:5],
+            # Device-busy union per backend family (the family= span
+            # attribute): wgl = lax.scan kernels, wgl-pallas = the
+            # Pallas megakernel, graph = the MXU closure.
+            "device_busy_by_family": gap.get("device_busy_by_family",
+                                             {}),
             "ambient_trace": ambient,
             "trace_json": trace_json,
             "trace_events": trace_events,
@@ -1657,6 +1686,61 @@ def main():
                     f, indent=2)
                 f.write("\n")
 
+    # ---- Pallas-vs-XLA backend comparison (ISSUE 12): the measured
+    # rate table behind the cost router's crossover — both WGL device
+    # backends timed on the same synthetic bucket per W class, plus
+    # the startup probe the router actually priced from. The doc
+    # rate table (doc/scaling.md "Hand-schedule the inner loop") is
+    # this section, committed. JT_BENCH_BACKEND_COMPARE=0 skips;
+    # JT_BENCH_COMPARE_WS / _B / _EVENTS size it.
+    backend_compare = None
+    if os.environ.get("JT_BENCH_BACKEND_COMPARE", "1") != "0":
+        from jepsen_tpu.ops import pallas_wgl as _pw
+        from jepsen_tpu.ops.linearize import get_kernel as _bc_getk
+        ws = [int(w) for w in os.environ.get(
+            "JT_BENCH_COMPARE_WS", "4,6,8,10").split(",") if w.strip()]
+        CBB = int(os.environ.get("JT_BENCH_COMPARE_B", "256"))
+        CBE = int(os.environ.get("JT_BENCH_COMPARE_EVENTS", "256"))
+        points = []
+        for w in ws:
+            args_w = _pw.make_probe_batch(V=8, W=w, rows=CBB,
+                                          events=CBE)
+            t_x = _pw._time_kernel(_bc_getk(8, w, shared_target=True),
+                                   args_w, repeats)
+            point = {"W": w, "rows": CBB, "events": CBE,
+                     "xla_hist_per_s": round(CBB / max(t_x, 1e-9), 2),
+                     "pallas_hist_per_s": None,
+                     "pallas_speedup": None, "winner": "xla"}
+            if _pw.pallas_available() and _pw.pallas_supports(8, w):
+                try:
+                    pk = _pw.get_pallas_kernel(8, w, shared_target=True)
+                    t_p = _pw._time_kernel(pk, args_w, repeats)
+                    point["pallas_hist_per_s"] = round(
+                        CBB / max(t_p, 1e-9), 2)
+                    point["pallas_speedup"] = round(t_x / t_p, 3)
+                    if t_p < t_x:
+                        point["winner"] = "pallas"
+                except Exception as e:
+                    # A broken Pallas lowering must be DISTINGUISHABLE
+                    # from a legitimately-lost race — a null rate with
+                    # no error field would read as "scan won" on the
+                    # TPU box this table exists to measure.
+                    point["pallas_error"] = repr(e)[:200]
+            points.append(point)
+        wins = [p["W"] for p in points if p["winner"] == "pallas"]
+        backend_compare = {
+            "mode": _pw.pallas_mode(),
+            "backend_forced": bench_backend or "auto",
+            "points": points,
+            # Largest W at which the measured Pallas rate still beats
+            # the scan (None = the scan won everywhere, e.g. every
+            # interpret-mode host).
+            "crossover_w": max(wins) if wins else None,
+            "probe": rate_probe,
+            "headline_pallas_dispatches":
+                sched_stats.get("pallas_dispatches", 0) or 0,
+        }
+
     print(json.dumps({
         "metric": "linearizability_check_throughput_1kop_cas_e2e",
         "value": round(rate, 2),
@@ -1778,6 +1862,7 @@ def main():
             "share_of_e2e": round(t_synth / (t_synth + t_e2e), 4),
         },
         "synth_device": synth_section,
+        "backend_compare": backend_compare,
         "telemetry": tel_section,
         "online": online_section,
         "fleet": fleet_section,

@@ -123,6 +123,32 @@ def lease_skew_s() -> float:
 
 # ------------------------------------------------------ cost-based router
 
+# Process-wide probe-measured rate overlay (probe_and_persist /
+# set_measured_rates): defaults < measured < explicit env pins.
+_MEASURED_RATES: Dict[str, float] = {}
+
+# Rate keys and the env pins that override them (env beats probe: a
+# deployment that pins its crossover means it, exactly like
+# $JT_DISPATCH_OVERHEAD_US).
+_RATE_ENV = (("lane_ops_per_s", "JT_DISPATCH_COST_LANE_OPS_PER_S"),
+             ("host_s_per_event", "JT_HOST_S_PER_EVENT"),
+             ("macs_per_s", "JT_GRAPH_MACS_PER_S"),
+             ("graph_host_s_per_edge", "JT_GRAPH_HOST_S_PER_EDGE"),
+             ("pallas_lane_ops_per_s", "JT_PALLAS_LANE_OPS_PER_S"))
+
+
+def set_measured_rates(rates: Optional[Dict[str, float]]) -> None:
+    """Install probe-measured per-backend rates as the process-wide
+    overlay every fresh CostRouter prices from (None/{} clears). Only
+    known rate keys with truthy values apply — a failed probe never
+    zeroes a working default."""
+    _MEASURED_RATES.clear()
+    if rates:
+        known = {k for k, _ in _RATE_ENV}
+        _MEASURED_RATES.update({k: float(v) for k, v in rates.items()
+                                if k in known and v})
+
+
 def router_rates() -> Dict[str, float]:
     """The measured/assumed backend rates the router prices against.
     ``lane_ops_per_s`` is the scheduler's dispatch-cost rate (the same
@@ -131,22 +157,124 @@ def router_rates() -> Dict[str, float]:
     per-event cost from the measured W15/W16 device/native crossover
     (ops/linearize.py's wide-tail comment: ~0.4 s per ~1k-event row);
     ``macs_per_s`` prices the MXU closure; ``graph_host_s_per_edge``
-    the host DFS. All env-overridable — a deployment that measures its
-    own crossover pins it, exactly like $JT_DISPATCH_OVERHEAD_US."""
+    the host DFS; ``pallas_lane_ops_per_s`` the Pallas WGL megakernel
+    (0 = unprobed/unavailable, which prices it out of every route).
+    Precedence: defaults < probe-measured overlay (set_measured_rates
+    / probe_and_persist / persisted store rates) < explicit env pins —
+    a deployment that measures its own crossover pins it, exactly like
+    $JT_DISPATCH_OVERHEAD_US."""
     from .ops.schedule import DISPATCH_COST_LANE_OPS_PER_S
 
-    def f(env, dflt):
-        try:
-            return float(os.environ.get(env, dflt))
-        except ValueError:
-            return float(dflt)
-
-    return {
+    out = {
         "lane_ops_per_s": DISPATCH_COST_LANE_OPS_PER_S,
-        "host_s_per_event": f("JT_HOST_S_PER_EVENT", "4e-4"),
-        "macs_per_s": f("JT_GRAPH_MACS_PER_S", "1e12"),
-        "graph_host_s_per_edge": f("JT_GRAPH_HOST_S_PER_EDGE", "2e-6"),
+        "host_s_per_event": 4e-4,
+        "macs_per_s": 1e12,
+        "graph_host_s_per_edge": 2e-6,
+        "pallas_lane_ops_per_s": 0.0,
     }
+    out.update(_MEASURED_RATES)
+    for key, env in _RATE_ENV:
+        v = os.environ.get(env)
+        if v is not None:
+            try:
+                out[key] = float(v)
+            except ValueError:
+                pass
+    return out
+
+
+# ------------------------------ probe-refreshed, store-persisted rates
+
+ROUTER_RATES_DIR = "router-rates"
+
+_PROBED_RATES: Optional[Dict[str, float]] = None
+
+
+def rates_path(store_dir, host: Optional[str] = None) -> Path:
+    """This host's rate file: one file PER HOST (never a shared
+    read-modify-write document — concurrent workers on different
+    hosts must not race each other's calibration, the same reason
+    the lease protocol claims with O_EXCL)."""
+    host = host or socket.gethostname()
+    safe = "".join(c if c.isalnum() or c in "-._" else "_"
+                   for c in host) or "unknown-host"
+    return Path(store_dir) / ROUTER_RATES_DIR / f"{safe}.json"
+
+
+def persist_rates(store_dir, rates: Dict[str, float],
+                  host: Optional[str] = None) -> Path:
+    """Record this host's measured backend rates in the shared store
+    (one JSON file per hostname) so fleet workers on heterogeneous
+    hosts route from measurements, not defaults. Only known rate keys
+    persist; each host owns its own file outright, so workers never
+    clobber each other's calibration."""
+    path = rates_path(store_dir, host)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    known = {k for k, _ in _RATE_ENV}
+    atomic_write_json(path, {
+        "host": host or socket.gethostname(),
+        "rates": {k: float(v) for k, v in rates.items()
+                  if k in known and v},
+        "ts": time.time(),
+    })
+    return path
+
+
+def load_persisted_rates(store_dir,
+                         host: Optional[str] = None) -> Dict[str, float]:
+    """This host's persisted rate entry (empty when it never probed —
+    another host's calibration is wrong by definition on a
+    heterogeneous fleet, so there is no cross-host fallback)."""
+    ent = _read_json(rates_path(store_dir, host))
+    if not isinstance(ent, dict):
+        return {}
+    known = {k for k, _ in _RATE_ENV}
+    return {k: float(v) for k, v in (ent.get("rates") or {}).items()
+            if k in known and v}
+
+
+def probe_and_persist(store_dir=None, *, force: bool = False
+                      ) -> Dict[str, float]:
+    """The startup rate probe: measure the WGL device backends
+    (lax.scan and Pallas, ops.pallas_wgl.probe_rates) plus the host
+    oracle's per-event cost on one tiny workload, install the result
+    as the process-wide overlay (set_measured_rates), and persist it
+    under this host's key when a store dir is given. Memoized per
+    process — the probe pays two tiny kernel compiles once."""
+    global _PROBED_RATES
+    if _PROBED_RATES is None or force:
+        from .ops.pallas_wgl import probe_rates
+        out = probe_rates()
+        rates = {"lane_ops_per_s": out.get("lane_ops_per_s") or 0.0,
+                 "pallas_lane_ops_per_s":
+                     out.get("pallas_lane_ops_per_s") or 0.0}
+        try:
+            from .checkers.linearizable import wgl_check
+            from .workloads.synth import synth_cas_history
+            hs = [synth_cas_history(7 + i, n_procs=3, n_ops=40)
+                  for i in range(3)]
+            t0 = time.perf_counter()
+            for h in hs:
+                wgl_check(cas_register_model(), h)
+            dt = time.perf_counter() - t0
+            ev = sum(len(h) for h in hs)
+            if ev and dt > 0:
+                rates["host_s_per_event"] = dt / ev
+        except Exception:
+            pass
+        _PROBED_RATES = rates
+    set_measured_rates(_PROBED_RATES)
+    if store_dir is not None:
+        try:
+            persist_rates(store_dir, _PROBED_RATES)
+        except Exception:
+            log.warning("could not persist router rates", exc_info=True)
+    return dict(_PROBED_RATES)
+
+
+def cas_register_model():
+    from .models.core import cas_register
+    return cas_register()
 
 
 def pending_window(history) -> int:
@@ -202,8 +330,15 @@ class CostRouter:
     MAX_DEVICE_W = 22
 
     def __init__(self, rates: Optional[dict] = None,
-                 max_device_w: Optional[int] = None):
-        self.rates = {**router_rates(), **(rates or {})}
+                 max_device_w: Optional[int] = None,
+                 store_dir=None):
+        base = router_rates()
+        if store_dir is not None:
+            # Heterogeneous-fleet calibration: this host's persisted
+            # probe measurements (probe_and_persist) beat defaults;
+            # explicit ``rates`` beat everything.
+            base.update(load_persisted_rates(store_dir))
+        self.rates = {**base, **(rates or {})}
         if max_device_w is not None:
             self.max_device_w = int(max_device_w)
         else:
@@ -225,12 +360,25 @@ class CostRouter:
         """Per-unit cost of a linearizable unit at post-partition
         window ``w`` and ``n_events`` history lines: the device scan
         pays 2^w frontier lanes per event plus its amortized dispatch
-        overhead; the host oracle's per-event cost is near W-flat."""
+        overhead; the host oracle's per-event cost is near W-flat.
+        The Pallas megakernel (``wgl-pallas``) prices only when it is
+        CAPABLE (narrow window, kernel available) and PROBED (a
+        measured rate exists — startup probe, persisted store entry,
+        or env pin); absent either, the cost dict is bit-identical to
+        the pre-pallas router."""
         dev = (n_events * float(1 << min(int(w), 30))
                / self.rates["lane_ops_per_s"]
                + self._overhead_s() / max(int(rows), 1))
         host = n_events * self.rates["host_s_per_event"]
-        return {"wgl-device": dev, "host-oracle": host}
+        costs = {"wgl-device": dev, "host-oracle": host}
+        pr = float(self.rates.get("pallas_lane_ops_per_s") or 0.0)
+        if pr > 0:
+            from .ops.pallas_wgl import pallas_available, pallas_supports
+            if pallas_available() and pallas_supports(1, w):
+                costs["wgl-pallas"] = (
+                    n_events * float(1 << min(int(w), 30)) / pr
+                    + self._overhead_s() / max(int(rows), 1))
+        return costs
 
     def price_graph(self, n_vertices: int, n_edges: int,
                     rows: int = 1) -> Dict[str, float]:
@@ -338,11 +486,21 @@ def route_check(model, histories: Sequence, *, router: Optional[
         groups.setdefault(backend, []).append(i)
     results: List[Optional[dict]] = [None] * n
 
-    if groups.get("wgl-device"):
+    # Both WGL device groups ride the same fused columnar pipeline
+    # with the scheduler's per-chunk backend PINNED to the router's
+    # group decision (the router already decided the crossover;
+    # letting the scheduler re-price per chunk — or pick up a stray
+    # JT_WGL_BACKEND force — would let dispatches disagree with the
+    # plan and with the ``backend`` tag on the results).
+    for group, forced in (("wgl-device", "xla"),
+                          ("wgl-pallas", "pallas")):
+        if not groups.get(group):
+            continue
         from .ops.linearize import check_batch_columnar
-        idx = groups["wgl-device"]
-        rs = check_batch_columnar(model, [histories[i] for i in idx],
-                                  details=details)
+        idx = groups[group]
+        rs = check_batch_columnar(
+            model, [histories[i] for i in idx], details=details,
+            scheduler_opts={"wgl_backend": forced})
         for i, r in zip(idx, rs):
             results[i] = r
     if groups.get("host-oracle"):
@@ -680,7 +838,14 @@ def fleet_worker(campaign_dir, worker_id: str, *,
     ws = _load_spec(cdir)
     ttl = float(ws.get("lease_ttl_s") or lease_ttl_s())
     chunks = _chunk_map(ws)
-    router = CostRouter()
+    # Heterogeneous-host routing: JT_ROUTER_PROBE=1 measures this
+    # host's backend rates once and persists them under its hostname
+    # in the campaign dir; with or without the probe, the router
+    # prices from THIS host's persisted measurements when they exist
+    # (another worker's calibration is wrong by definition).
+    if os.environ.get("JT_ROUTER_PROBE", "0") == "1":
+        probe_and_persist(cdir)
+    router = CostRouter(store_dir=cdir)
     tel_base = telemetry.snapshot()
     seen: set = set()           # units observed complete (memoized)
     stats = {"worker": worker_id, "chunks": 0, "units": 0,
@@ -935,7 +1100,7 @@ def merge_campaign(campaign_dir) -> dict:
            "complete": complete, "units": len(ws["units"]),
            "missing": missing, "invalid": invalid, "seeds": units,
            "router": {"chosen": chosen, "est_cost_s": est,
-                      "table": CostRouter().table()},
+                      "table": CostRouter(store_dir=cdir).table()},
            "workers": workers, "leases": leases,
            "telemetry": {"source": "fleet",
                          "counters": telemetry.merge_counter_snapshots(

@@ -18,6 +18,10 @@ Modules:
   schedule   — streaming bucket scheduler + the degradation ladder
                (watchdog, retry, OOM bisection, poison-row quarantine),
                for both the WGL scan and the graph closure kernels
+  pallas_wgl — hand-scheduled Pallas TPU megakernel for the hot
+               narrow-window WGL buckets (VMEM-resident frontier,
+               streamed event blocks, in-kernel closure fixpoint);
+               the cost router's fourth backend (doc/scaling.md)
   faults     — the checker nemesis: deterministic fault injection at the
                encode/dispatch/decode boundaries (doc/resilience.md)
 

@@ -1,0 +1,470 @@
+"""Pallas TPU megakernel for the packed-frontier WGL search.
+
+The ``lax.scan`` kernel (ops.linearize) runs one event per scan step
+and re-enters ``lax.while_loop`` for every closure fixpoint — per-event
+XLA scheduling that leaves the hot post-partition W<=10 buckets
+dispatch/latency-bound (the r05 roofline: ``hbm_util`` 0.0018). This
+module hand-schedules the same search as ONE Pallas program per
+history batch:
+
+  * the packed ``[words(V), 2^W]`` uint32 frontier stays RESIDENT in
+    VMEM across *all* events of a history (grid = (batch, event
+    blocks); the frontier output block re-maps to the same VMEM tile
+    for every event block of a row, so it never round-trips to HBM
+    until the history is decided);
+  * events stream from HBM in ``JT_PALLAS_EVENT_BLOCK``-sized blocks —
+    Pallas' pipeline fetches block k+1 while block k computes, the
+    double-buffering the scan kernel pays dispatch overhead for;
+  * closure iterations run to fixpoint IN-KERNEL (a while loop over
+    VPU work on the resident frontier) instead of per-iteration XLA
+    round trips, and a decided row skips the remaining event blocks
+    outright (the scan must idempotently no-op through them);
+  * the OK-completion filter is a static select over the W shift-half
+    variants — no gathers, no ``lax.switch`` lowering hazards.
+
+Contract parity: ``check(ev_type, ev_slot, ev_slots, target) ->
+(valid, bad, frontier)`` — bit-identical outputs to
+``ops.linearize.make_kernel``'s vmapped form (same encoder arrays,
+same latched pre-failure closure on the first impossible completion),
+so ``fused_refine``, counterexample decode, the chunk journal, and the
+degradation ladder all work unchanged. The scheduler (ops.schedule)
+dispatches through this kernel when the COST ROUTER prices it under
+the scan (fleet.CostRouter's ``wgl-pallas`` backend, fed by the
+startup rate probe below) — never hardcoded; ``JT_ROUTER_PALLAS=0``
+removes the backend entirely and restores the pre-pallas path
+bit-identically.
+
+On hosts without a TPU the kernel runs in ``pltpu`` interpret mode —
+orders of magnitude slower (so the router never picks it there on
+measured rates) but semantically identical, which is what keeps the
+parity gate (tests/test_pallas.py) on the CPU tier-1 box.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .encode import EV_CLOSE, EV_FUSED, EV_OK, EV_PAD
+from .linearize import INT32_MAX, n_state_words, pack_rows
+
+# Widest state space the Pallas kernel accepts (two 32-state words —
+# the same packed bound as the scan kernel).
+PALLAS_MAX_STATES = 64
+
+
+def pallas_max_w() -> int:
+    """Widest pending window routed to the Pallas kernel. The win is
+    frontier residency + fused closure for the hot post-partition
+    buckets; past ~2^10 masks the frontier dominates VMEM and the
+    scan/wide routes (HBM-resident mask axis, frontier sharding) are
+    the right machinery. $JT_PALLAS_MAX_W overrides."""
+    try:
+        return max(1, int(os.environ.get("JT_PALLAS_MAX_W", "10")))
+    except ValueError:
+        return 10
+
+
+def event_block() -> int:
+    """Events per streamed block (the HBM->VMEM pipeline quantum).
+    $JT_PALLAS_EVENT_BLOCK overrides; kept a multiple of the
+    scheduler's EVENT_QUANTUM so padded chunk shapes divide evenly."""
+    try:
+        return max(64, int(os.environ.get("JT_PALLAS_EVENT_BLOCK",
+                                          "256")))
+    except ValueError:
+        return 256
+
+
+def pallas_mode() -> str:
+    """"compiled" on a TPU backend, "interpret" elsewhere (the tier-1
+    parity path), "off" when disabled. $JT_PALLAS_MODE forces a mode;
+    $JT_PALLAS=0 or $JT_ROUTER_PALLAS=0 disables outright (the
+    restore-the-scan-path switch the acceptance gate names)."""
+    if os.environ.get("JT_PALLAS", "1") == "0" or \
+            os.environ.get("JT_ROUTER_PALLAS", "1") == "0":
+        return "off"
+    m = os.environ.get("JT_PALLAS_MODE")
+    if m in ("compiled", "interpret", "off"):
+        return m
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return "off"
+    return "compiled" if backend == "tpu" else "interpret"
+
+
+def pallas_available() -> bool:
+    if pallas_mode() == "off":
+        return False
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        from jax.experimental.pallas import tpu  # noqa: F401
+    except Exception:
+        return False
+    return True
+
+
+def pallas_supports(V: int, W: int) -> bool:
+    """Capability gate: the shapes this kernel hosts. Wider windows
+    belong to the scan/wide/frontier routes; the router only ever
+    PRICES pallas for shapes this admits."""
+    return 1 <= int(W) <= pallas_max_w() and \
+        1 <= int(V) <= PALLAS_MAX_STATES
+
+
+# --------------------------------------------------------- kernel body
+
+def _kernel_body(V: int, W: int, WL: int, EB: int, shared: bool):
+    """Build the Pallas kernel function for static (V, W, w_live,
+    event-block, target-sharing) bounds. Grid is (batch, event
+    blocks); per grid step the body advances one row's resident
+    frontier through EB events. The closure/completion math mirrors
+    ops.linearize line for line (same packed formulation), which is
+    what makes the parity gate bit-exact."""
+    from jax.experimental import pallas as pl
+
+    NW = n_state_words(V)
+    M = 1 << W
+
+    def _apply(Ft, rowvecs):
+        # One slot application over every packed config: mirrors
+        # linearize._apply_slot + transition with F as per-word [M]
+        # arrays. ``rowvecs``: per-word [V] packed one-hot target rows
+        # for this slot's op (all-zero for empty slots => no-op).
+        out_words = list(Ft)
+        for i in range(WL):
+            hi, lo = M >> (i + 1), 1 << i
+            Fr = [f.reshape(hi, 2, lo) for f in out_words]
+            src = [fr[:, 0, :] for fr in Fr]
+            new = [None] * NW
+            for s in range(V):
+                bit = (src[s >> 5] >> jnp.uint32(s & 31)) & jnp.uint32(1)
+                for w in range(NW):
+                    contrib = bit * rowvecs[i][w][s]
+                    new[w] = contrib if new[w] is None else new[w] | contrib
+            out_words = [
+                jnp.concatenate([fr[:, :1, :],
+                                 fr[:, 1:, :] | n[:, None, :]], axis=1)
+                .reshape(M)
+                for fr, n in zip(Fr, new)]
+        return tuple(out_words)
+
+    def _closure(Ft, rowvecs):
+        # Fixpoint in-kernel: monotone OR, <= live-slot iterations;
+        # the while carry is the resident frontier itself.
+        def cond(c):
+            return c[-1]
+
+        def body(c):
+            F0 = c[:NW]
+            Fn = _apply(F0, rowvecs)
+            changed = (Fn[0] != F0[0]).any()
+            for a, b in zip(Fn[1:], F0[1:]):
+                changed = changed | (a != b).any()
+            return Fn + (changed,)
+
+        out = lax.while_loop(cond, body, Ft + (jnp.bool_(True),))
+        return out[:NW]
+
+    def _complete(Ft, slot):
+        # OK-completion for a DYNAMIC slot as a select over the WL
+        # static shift-half variants (linearize._complete_slot's
+        # branches, minus the lax.switch — predicated selects lower
+        # cleanly in Mosaic).
+        out = None
+        for i in range(WL):
+            hi, lo = M >> (i + 1), 1 << i
+            comp = []
+            for f in Ft:
+                fr = f.reshape(hi, 2, lo)
+                comp.append(jnp.concatenate(
+                    [fr[:, 1:, :], jnp.zeros_like(fr[:, 1:, :])],
+                    axis=1).reshape(M))
+            if out is None:
+                out = tuple(comp)
+            else:
+                sel = slot == i
+                out = tuple(jnp.where(sel, c, o)
+                            for c, o in zip(comp, out))
+        return out
+
+    def kernel(ev_type_ref, ev_slot_ref, ev_slots_ref, rows_ref,
+               valid_ref, bad_ref, front_ref):
+        nb = pl.program_id(1)
+
+        @pl.when(nb == 0)
+        def _init():
+            valid_ref[0, 0] = jnp.int32(1)
+            bad_ref[0, 0] = jnp.int32(INT32_MAX)
+            row_ids = lax.broadcasted_iota(jnp.int32, (NW, M), 0)
+            col_ids = lax.broadcasted_iota(jnp.int32, (NW, M), 1)
+            front_ref[0] = jnp.where(
+                (row_ids == 0) & (col_ids == 0),
+                jnp.uint32(1), jnp.uint32(0))
+
+        def ev_step(e, carry):
+            typ = ev_type_ref[0, e]
+            # A decided row (first impossible completion already
+            # latched) skips every remaining event outright — the
+            # scan kernel has to idempotently no-op through them.
+            live = (valid_ref[0, 0] == 1) & (typ != EV_PAD)
+
+            @pl.when(live)
+            def _():
+                slot = ev_slot_ref[0, e]
+                F = front_ref[0]
+                Ft = tuple(F[w] for w in range(NW))
+                rowvecs = []
+                for i in range(WL):
+                    k_i = ev_slots_ref[0, e, i]
+                    if shared:
+                        rowvecs.append(tuple(
+                            rows_ref[w, pl.ds(k_i, 1), :][0]
+                            for w in range(NW)))
+                    else:
+                        rowvecs.append(tuple(
+                            rows_ref[0, w, pl.ds(k_i, 1), :][0]
+                            for w in range(NW)))
+                Fc = _closure(Ft, rowvecs)
+                F_ok = _complete(Fc, slot)
+                union = F_ok[0]
+                for f in F_ok[1:]:
+                    union = union | f
+                is_ok = (typ == EV_OK) | (typ == EV_FUSED)
+                is_close = typ == EV_CLOSE
+                empty = is_ok & jnp.logical_not((union != 0).any())
+
+                @pl.when(empty)
+                def _fail():
+                    # Latch the pre-completion closure — the frontier
+                    # the host decodes the Knossos-parity
+                    # counterexample sample from.
+                    valid_ref[0, 0] = jnp.int32(0)
+                    bad_ref[0, 0] = (nb * EB + e).astype(jnp.int32)
+                    for w in range(NW):
+                        front_ref[0, w] = Fc[w]
+
+                @pl.when(jnp.logical_not(empty))
+                def _advance():
+                    for w in range(NW):
+                        front_ref[0, w] = jnp.where(
+                            is_ok, F_ok[w],
+                            jnp.where(is_close, Fc[w], Ft[w]))
+
+            return carry
+
+        lax.fori_loop(0, EB, ev_step, jnp.int32(0))
+
+    return kernel
+
+
+def _compiler_params(pltpu):
+    """Best-effort Mosaic params: batch rows are independent grid
+    steps; event blocks of one row must run in order (the resident
+    frontier carries across them)."""
+    for name in ("CompilerParams", "TPUCompilerParams"):
+        cls = getattr(pltpu, name, None)
+        if cls is not None:
+            try:
+                return cls(dimension_semantics=("parallel", "arbitrary"))
+            except Exception:
+                continue
+    return None
+
+
+def make_pallas_kernel(V: int, W: int, *, shared_target: bool = False,
+                       w_live: Optional[int] = None,
+                       interpret: Optional[bool] = None):
+    """Build the batched Pallas checker with the registry-kernel
+    contract: ``check(ev_type [B,N], ev_slot [B,N], ev_slots [B,N,Wt],
+    target [K+1,V] | [B,K+1,V]) -> (valid [B] bool, bad [B] int32,
+    frontier [B, words(V), 2^W] uint32)``. jit-wrapped; one trace per
+    input shape, exactly like the scan kernels."""
+    assert pallas_supports(V, W), (V, W)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    NW, M = n_state_words(V), 1 << W
+    WL = W if w_live is None else max(1, min(int(w_live), W))
+    EB = event_block()
+    if interpret is None:
+        interpret = pallas_mode() != "compiled"
+    kernel = _kernel_body(V, W, WL, EB, shared_target)
+    kw: dict = {}
+    if not interpret:
+        params = _compiler_params(pltpu)
+        if params is not None:
+            kw["compiler_params"] = params
+
+    def check(ev_type, ev_slot, ev_slots, target):
+        ev_type = ev_type.astype(jnp.int32)
+        ev_slot = ev_slot.astype(jnp.int32)
+        ev_slots = ev_slots.astype(jnp.int32)
+        B, N = ev_type.shape
+        K1 = target.shape[-2]
+        Np = ((N + EB - 1) // EB) * EB
+        if Np != N:
+            # EV_PAD steps are no-ops; slot tables pad to the
+            # all-invalid sentinel row like every other pad path.
+            ev_type = jnp.pad(ev_type, ((0, 0), (0, Np - N)))
+            ev_slot = jnp.pad(ev_slot, ((0, 0), (0, Np - N)))
+            ev_slots = jnp.pad(ev_slots,
+                               ((0, 0), (0, Np - N), (0, 0)),
+                               constant_values=K1 - 1)
+        Wt = ev_slots.shape[2]
+        packed = pack_rows(target, V)
+        if shared_target:
+            rows = jnp.stack(packed)                      # [NW, K1, V]
+            rows_spec = pl.BlockSpec((NW, K1, V),
+                                     lambda b, nb: (0, 0, 0),
+                                     memory_space=pltpu.VMEM)
+        else:
+            rows = jnp.stack(packed, axis=1)           # [B, NW, K1, V]
+            rows_spec = pl.BlockSpec((1, NW, K1, V),
+                                     lambda b, nb: (b, 0, 0, 0),
+                                     memory_space=pltpu.VMEM)
+        grid = (B, Np // EB)
+        valid_i, bad, front = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, EB), lambda b, nb: (b, nb),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, EB), lambda b, nb: (b, nb),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, EB, Wt), lambda b, nb: (b, nb, 0),
+                             memory_space=pltpu.SMEM),
+                rows_spec,
+            ],
+            out_shape=(
+                jax.ShapeDtypeStruct((B, 1), jnp.int32),
+                jax.ShapeDtypeStruct((B, 1), jnp.int32),
+                jax.ShapeDtypeStruct((B, NW, M), jnp.uint32),
+            ),
+            out_specs=(
+                pl.BlockSpec((1, 1), lambda b, nb: (b, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1), lambda b, nb: (b, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, NW, M), lambda b, nb: (b, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ),
+            interpret=interpret,
+            **kw,
+        )(ev_type, ev_slot, ev_slots, rows)
+        return valid_i[:, 0] != 0, bad[:, 0], front
+
+    return jax.jit(check)
+
+
+# ------------------------------------------------------------ registry
+
+_PALLAS_REGISTRY: Dict[Tuple, object] = {}
+
+
+def get_pallas_kernel(V: int, W: int, *, shared_target: bool = False,
+                      w_live: Optional[int] = None):
+    """Resolve (build + cache) the compiled Pallas checker — the
+    pallas twin of linearize.get_kernel. Keyed per (V, W, sharing,
+    w_live, mode); jit handles per-shape compiles underneath."""
+    if w_live is None or w_live >= W:
+        w_live = W
+    key = (V, W, bool(shared_target), int(w_live), pallas_mode())
+    k = _PALLAS_REGISTRY.get(key)
+    if k is None:
+        k = make_pallas_kernel(V, W, shared_target=shared_target,
+                               w_live=w_live)
+        _PALLAS_REGISTRY[key] = k
+    return k
+
+
+# ----------------------------------------------------- startup probe
+
+def make_probe_batch(V: int = 4, W: int = 6, rows: int = 32,
+                     events: int = 64):
+    """Synthetic always-valid encoded arrays exercising the full
+    closure + completion math with no model machinery: one identity op
+    resident in slot 0, completed every event. The probe and the
+    bench's backend_compare section both measure against this."""
+    K1 = 2
+    ev_type = np.full((rows, events), EV_OK, np.int8)
+    ev_slot = np.zeros((rows, events), np.int8)
+    ev_slots = np.full((rows, events, W), K1 - 1, np.int8)
+    ev_slots[:, :, 0] = 0
+    target = np.full((K1, V), -1, np.int32)
+    target[0] = np.arange(V, dtype=np.int32)
+    return ev_type, ev_slot, ev_slots, target
+
+
+def _time_kernel(kern, args, repeats: int = 3) -> float:
+    jax.block_until_ready(kern(*args))          # compile outside clock
+    ts = []
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(kern(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def probe_rates(rows: int = 32, events: int = 64, V: int = 4,
+                W: int = 6, repeats: int = 3) -> dict:
+    """The startup rate probe: measure both WGL device backends'
+    sustained rate on the same tiny workload, in the cost router's
+    own units (frontier-lane events per second — the ``n_events * 2^W
+    / rate`` basis price_wgl divides by). Returns
+    ``{"lane_ops_per_s", "pallas_lane_ops_per_s", "probe_s", "mode",
+    "parity"}``; the pallas rate is 0.0 when the kernel is
+    unavailable or failed, which prices it out of every route."""
+    from .linearize import get_kernel
+
+    args = make_probe_batch(V, W, rows, events)
+    basis = rows * events * float(1 << W)
+    out = {"mode": pallas_mode(), "probe_s": None, "parity": None,
+           "lane_ops_per_s": 0.0, "pallas_lane_ops_per_s": 0.0}
+    t_all = time.perf_counter()
+    xk = get_kernel(V, W, shared_target=True)
+    out["lane_ops_per_s"] = basis / max(_time_kernel(xk, args, repeats),
+                                        1e-9)
+    if pallas_available() and pallas_supports(V, W):
+        try:
+            pk = get_pallas_kernel(V, W, shared_target=True)
+            out["pallas_lane_ops_per_s"] = basis / max(
+                _time_kernel(pk, args, repeats), 1e-9)
+            xv, xb, xf = (np.asarray(a) for a in xk(*args))
+            pv, pb, pf = (np.asarray(a) for a in pk(*args))
+            out["parity"] = bool(
+                (xv == pv).all() and (xb == pb).all()
+                and (xf == pf).all())
+            if out["parity"] is False:
+                # A kernel that disagrees with the scan must never win
+                # a route on speed.
+                out["pallas_lane_ops_per_s"] = 0.0
+        except Exception:
+            out["pallas_lane_ops_per_s"] = 0.0
+    out["probe_s"] = round(time.perf_counter() - t_all, 4)
+    return out
+
+
+def router_prefers_pallas(V: int, W: int, n_events: int,
+                          rows: int = 1,
+                          rates: Optional[dict] = None) -> bool:
+    """The scheduler's routing question, answered by the fleet cost
+    router's own pricing (never a hardcoded preference): does the
+    measured ``wgl-pallas`` rate undercut ``wgl-device`` for this
+    bucket shape? False whenever the kernel is unavailable,
+    unsupported, or unpriced (no probe ran and no rate is pinned)."""
+    if not (pallas_available() and pallas_supports(V, W)):
+        return False
+    from ..fleet import CostRouter
+    costs = CostRouter(rates=rates).price_wgl(W, int(n_events),
+                                              max(int(rows), 1))
+    pc = costs.get("wgl-pallas")
+    return pc is not None and pc < costs["wgl-device"]

@@ -440,7 +440,8 @@ _AOT_LOCK = threading.Lock()
 # beyond the persistent StableHLO cache (this ships the FINAL
 # executable, skipping trace+lower+compile entirely). Disabled when
 # unset or when JT_COMPILE_CACHE=0 (the hermetic-tests contract).
-AOT_STATS = {"hits": 0, "misses": 0, "exported": 0, "rejected": 0}
+AOT_STATS = {"hits": 0, "misses": 0, "exported": 0, "rejected": 0,
+             "unsupported": 0}
 _AOT_MISSING: set = set()      # keys probed on disk and absent
 
 
@@ -526,10 +527,18 @@ def _aot_store(key: Tuple, compiled) -> None:
     if path is None:
         return
     try:
-        import pickle
-
         from jax.experimental import serialize_executable as se
         payload, in_tree, out_tree = se.serialize(compiled)
+    except Exception:
+        # Not every executable serializes — Pallas custom-call
+        # lowerings are the known case. Count it (aot.unsupported) and
+        # fall through to the persistent compile cache / parked
+        # in-memory executable instead of erroring the pre-warm
+        # thread: shipping is an accelerator, never a failure mode.
+        _aot_bump("unsupported")
+        return
+    try:
+        import pickle
         os.makedirs(os.path.dirname(path), mode=0o700, exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
         fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
@@ -576,9 +585,12 @@ def _aot_key(V, W, w_live, shared, donate, Bp, Np, slot_dtype, K1):
 
 def _spec_key(spec: Tuple) -> Tuple:
     """Registry key for a pre-warm spec — a plain kernel-shape tuple,
-    or ("fused", (member specs...)) for a dispatch-group megakernel."""
+    ("fused", (member specs...)) for a dispatch-group megakernel, or
+    ("pallas",) + shape tuple for the Pallas WGL kernel."""
     if spec and spec[0] == "fused":
         return ("fused",) + tuple(_aot_key(*m) for m in spec[1])
+    if spec and spec[0] == "pallas":
+        return ("pallas",) + _aot_key(*spec[1:])
     return _aot_key(*spec)
 
 
@@ -609,6 +621,12 @@ def _compile_spec(spec: Tuple) -> None:
                     tuple(m[:4] for m in members),
                     donate=bool(members[0][4]))
                 shapes = [s for m in members for s in _member_shapes(m)]
+            elif spec[0] == "pallas":
+                from .pallas_wgl import get_pallas_kernel
+                (V, W, w_live, shared, _donate, *_rest) = spec[1:]
+                kern = get_pallas_kernel(V, W, shared_target=shared,
+                                         w_live=w_live)
+                shapes = _member_shapes(spec[1:])
             else:
                 (V, W, w_live, shared, donate, *_rest) = spec
                 kern = get_kernel(V, W, shared_target=shared,
@@ -644,6 +662,8 @@ def prewarm_kernels(specs: Iterable[Tuple]) -> List[threading.Thread]:
                 continue
             _AOT_INFLIGHT[key] = threading.Event()
         name = ("jepsen-prewarm-fused" if spec[0] == "fused"
+                else f"jepsen-prewarm-pallas-W{spec[2]}"
+                if spec[0] == "pallas"
                 else f"jepsen-prewarm-W{spec[1]}")
         t = threading.Thread(target=_compile_spec, args=(tuple(spec),),
                              name=name, daemon=True)
@@ -780,8 +800,24 @@ class BucketScheduler:
                  shard_min_rows: Optional[int] = None,
                  max_queue: Optional[int] = None,
                  event_route_events: Optional[int] = None,
-                 resident: Optional[ResidentState] = None):
+                 resident: Optional[ResidentState] = None,
+                 wgl_backend: Optional[str] = None):
         self.return_frontier = return_frontier
+        # WGL dispatch backend for narrow chunks: "auto" (default)
+        # asks the fleet cost router to price the Pallas megakernel
+        # against the lax.scan kernel from the MEASURED backend rates
+        # (fleet.router_rates — startup probe / persisted store rates /
+        # env pins) per bucket shape; "pallas" / "xla" force. With no
+        # measured pallas rate, or under $JT_ROUTER_PALLAS=0, auto is
+        # bit-identical to the pre-pallas scheduler.
+        if wgl_backend is None:
+            wgl_backend = os.environ.get("JT_WGL_BACKEND", "auto")
+        if wgl_backend not in ("auto", "xla", "pallas"):
+            log.warning("ignoring unknown wgl_backend=%r (want "
+                        "auto|xla|pallas)", wgl_backend)
+            wgl_backend = "auto"
+        self.wgl_backend = wgl_backend
+        self._backend_choice: Dict[Tuple, bool] = {}
         self.max_classes = (DEFAULT_MAX_CLASSES if max_classes is None
                             else max_classes)
         self.chunk_rows = (DEFAULT_CHUNK_ROWS if chunk_rows is None
@@ -871,6 +907,8 @@ class BucketScheduler:
             "prewarm_wedged": 0, "abandoned_buckets": 0,
             "faults_injected": 0, "backpressure_events": 0,
             "event_routed_rows": 0, "event_routed_dispatches": 0,
+            "pallas_dispatches": 0, "pallas_rows": 0,
+            "wgl_backend": self.wgl_backend,
         }
         self._t0 = None
         self._first_dispatch_t = None
@@ -964,13 +1002,56 @@ class BucketScheduler:
             batch.V, batch.W, shared_target=batch.shared_target,
             donate=self.donate, w_live=batch.eff_w_live)
 
+    def _pallas_for(self, batch: EncodedBatch) -> bool:
+        """Does this bucket's dispatch ride the Pallas WGL megakernel?
+        Forced backends short-circuit; "auto" asks the fleet cost
+        router to price both device backends from the measured rates
+        (memoized per bucket shape — the router's answer is stable
+        within one run)."""
+        if self.wgl_backend == "xla":
+            return False
+        from .pallas_wgl import (pallas_available, pallas_supports,
+                                 router_prefers_pallas)
+        if not (pallas_available()
+                and pallas_supports(batch.V, batch.W)):
+            return False
+        if self.wgl_backend == "pallas":
+            return True
+        key = (batch.V, batch.W,
+               _round_up(batch.n_events, EVENT_QUANTUM))
+        hit = self._backend_choice.get(key)
+        if hit is None:
+            hit = router_prefers_pallas(batch.V, batch.W,
+                                        batch.n_events,
+                                        max(batch.batch, 1))
+            self._backend_choice[key] = hit
+        return hit
+
+    def _resolve_pallas(self, batch: EncodedBatch, Bp: int, Np: int):
+        """Pallas twin of _resolve: a parked pre-warm/shipped
+        executable first (the same _AOT registry, key prefixed
+        "pallas"), else the jit-wrapped kernel registry."""
+        key = ("pallas",) + _aot_key(
+            batch.V, batch.W, batch.eff_w_live, batch.shared_target,
+            False, Bp, Np, batch.ev_slots.dtype, batch.target.shape[1])
+        compiled = self._resolve_key(key)
+        if compiled is not None:
+            return compiled
+        from .pallas_wgl import get_pallas_kernel
+        return get_pallas_kernel(batch.V, batch.W,
+                                 shared_target=batch.shared_target,
+                                 w_live=batch.eff_w_live)
+
     def _ship(self, batch: EncodedBatch, lo: int, hi: int, Bp: int,
               Np: int, tag: str):
         """The ONE dispatch sequence both the pipelined path and the
         ladder's synchronous re-dispatches run — fault hooks, pad,
         kernel launch (async) — so the retried path can never drift
         from the path it is retrying. Returns (lazy out, decode
-        delay)."""
+        delay). The cost-routed backend choice (Pallas megakernel vs
+        lax.scan) happens HERE, under the same fault hooks and
+        telemetry spans, so the ladder retries whatever backend the
+        router chose."""
         with self._stats_lock:
             ordinal = self._chunk_seq
             self._chunk_seq += 1
@@ -983,14 +1064,26 @@ class BucketScheduler:
         delay = 0.0
         if self.faults is not None:
             delay = self.faults.sleep_for(self.faults.fire("dispatch"))
-        with telemetry.span("dispatch", cat="device", V=batch.V,
-                            W=batch.W, rows=hi - lo, chunk=ordinal,
-                            tag=tag):
-            kern = self._resolve(batch, Bp, Np)
-            log_kernel_shapes(batch.V, batch.W, "data1",
-                              batch.shared_target, self.donate, Bp, Np,
-                              batch.eff_w_live)
-            DISPATCH_LOG.append((tag, batch.V, batch.W, hi - lo))
+        use_pallas = self._pallas_for(batch)
+        family = "wgl-pallas" if use_pallas else "wgl"
+        with telemetry.span("dispatch", cat="device", family=family,
+                            V=batch.V, W=batch.W, rows=hi - lo,
+                            chunk=ordinal, tag=tag):
+            if use_pallas:
+                kern = self._resolve_pallas(batch, Bp, Np)
+                log_kernel_shapes(batch.V, batch.W, "pallas",
+                                  batch.shared_target, False, Bp, Np,
+                                  batch.eff_w_live)
+                DISPATCH_LOG.append(("pallas", batch.V, batch.W,
+                                     hi - lo))
+                self._inc("pallas_dispatches")
+                self._inc("pallas_rows", hi - lo)
+            else:
+                kern = self._resolve(batch, Bp, Np)
+                log_kernel_shapes(batch.V, batch.W, "data1",
+                                  batch.shared_target, self.donate, Bp,
+                                  Np, batch.eff_w_live)
+                DISPATCH_LOG.append((tag, batch.V, batch.W, hi - lo))
             self._inc("dispatches")
             out = kern(ev_type, ev_slot, ev_slots,
                        np.ascontiguousarray(batch.target[0])
@@ -1030,18 +1123,50 @@ class BucketScheduler:
                 out, delay = self._ship(run.batch, lo, hi, Bp, Np,
                                         "data1")
                 outs = [out]
+            elif (pall := [self._pallas_for(run.batch)
+                           for run, _, _, _ in members]) and \
+                    any(pall) and pall.count(False) <= 1:
+                # A Pallas member owns its launch economics (the whole
+                # chunk retires in ONE kernel launch with the frontier
+                # resident on-chip), so a fused XLA megakernel buys it
+                # nothing — and one leftover scan member has nothing
+                # to fuse WITH: ship each member through the one
+                # dispatch sequence instead. Fault ordinals still fire
+                # once per member, exactly as fusion promises.
+                outs = []
+                delay = 0.0
+                for run, lo, hi, Bp in members:
+                    Np = _round_up(run.batch.n_events, EVENT_QUANTUM)
+                    out, d = self._ship(run.batch, lo, hi, Bp, Np,
+                                        "data1")
+                    outs.append(out)
+                    delay += d
             else:
+                # >=2 scan members (plus possibly Pallas members, each
+                # shipped individually IN MEMBER ORDER — ordinals and
+                # fault hooks must fire in the same sequence either
+                # way): the scan members still retire as ONE fused XLA
+                # call, so a Pallas-routed shape in the group never
+                # costs the rest of the group its fusion.
+                outs_by_pos: List = [None] * len(members)
+                fused_pos: List[int] = []
                 flat: List = []
                 specs: List[Tuple] = []
                 delay = 0.0
                 with self._stats_lock:
                     group_id = self.stats["fused_groups"]
-                for run, lo, hi, Bp in members:
+                for pos, (run, lo, hi, Bp) in enumerate(members):
                     b = run.batch
+                    Np = _round_up(b.n_events, EVENT_QUANTUM)
+                    if pall[pos]:
+                        out, d = self._ship(b, lo, hi, Bp, Np,
+                                            "data1")
+                        outs_by_pos[pos] = out
+                        delay += d
+                        continue
                     with self._stats_lock:
                         ordinal = self._chunk_seq
                         self._chunk_seq += 1
-                    Np = _round_up(b.n_events, EVENT_QUANTUM)
                     # Fault hooks fire once per MEMBER, not per group:
                     # the nemesis ordinals (FaultPlan chunk=N) count
                     # chunks, and fusion must not shift them — the
@@ -1063,6 +1188,7 @@ class BucketScheduler:
                         np.ascontiguousarray(b.target[0])
                         if b.shared_target else target])
                     specs.append(self._member_spec(b, Bp, Np))
+                    fused_pos.append(pos)
                     log_kernel_shapes(b.V, b.W, "data1",
                                       b.shared_target, self.donate, Bp,
                                       Np, b.eff_w_live)
@@ -1080,16 +1206,19 @@ class BucketScheduler:
                     self._warmed_groups.add(gspec)
                     prewarm_kernels([gspec])
                 with telemetry.span(
-                        "dispatch", cat="device", fused=True,
-                        fuse_group=group_id, members=len(members),
-                        rows=sum(hi - lo for _, lo, hi, _ in members),
+                        "dispatch", cat="device", family="wgl",
+                        fused=True,
+                        fuse_group=group_id, members=len(fused_pos),
+                        rows=sum(members[p][2] - members[p][1]
+                                 for p in fused_pos),
                         ws=[m[1] for m in specs]):
                     kern = self._resolve_group(spec_t)
                     self._inc("dispatches")
                     self._inc("fused_groups")
                     out_flat = kern(*flat)
-                outs = [tuple(out_flat[3 * i:3 * i + 3])
-                        for i in range(len(members))]
+                for i, pos in enumerate(fused_pos):
+                    outs_by_pos[pos] = tuple(out_flat[3 * i:3 * i + 3])
+                outs = outs_by_pos
         except Exception as e:
             if classify_failure(e) is None:
                 raise
@@ -1429,8 +1558,17 @@ class BucketScheduler:
             # analyzer's device-busy union under fault injection.
             results, cause = None, outs
         else:
+            # One wait covers the whole group; a group that mixed
+            # backends gets the honest "mixed" label rather than
+            # silently crediting all its wait to the scan family
+            # (device_busy_by_family is the table doc/observability.md
+            # tells readers to trust).
+            fams = {"wgl-pallas" if self._pallas_for(run.batch)
+                    else "wgl" for run, _, _, _ in members}
             wait_sp = telemetry.span(
-                "device.wait", cat="device", members=len(members),
+                "device.wait", cat="device",
+                family=fams.pop() if len(fams) == 1 else "mixed",
+                members=len(members),
                 rows=sum(hi - lo for _, lo, hi, _ in members))
             try:
                 if len(members) == 1:
@@ -1472,7 +1610,7 @@ class BucketScheduler:
         whose full degradation ladder is the retry."""
         n_disp = -(-mb.n_events // EVENT_CHUNK)
         try:
-            with telemetry.span("dispatch", cat="device",
+            with telemetry.span("dispatch", cat="device", family="wgl",
                                 route="event-chunked", V=mb.V, W=mb.W,
                                 rows=mb.batch, events=mb.n_events):
                 out = self._exec_event_chunked(mb, 0, mb.batch)
@@ -1504,8 +1642,8 @@ class BucketScheduler:
                 # count toward dispatch economics like any other ship.
                 self._inc("dispatches")
                 with telemetry.span("dispatch", cat="device",
-                                    route="wide", V=mb.V, W=mb.W,
-                                    rows=mb.batch):
+                                    family="wgl", route="wide",
+                                    V=mb.V, W=mb.W, rows=mb.batch):
                     out = run_encoded_batch(mb, self.return_frontier)
                 if attempt:
                     for i in mb.indices:
@@ -1676,11 +1814,17 @@ class BucketScheduler:
                     return
             Bp, chunks = self._chunk_plan(mb)
             if self.prewarm and mb.W <= DATA_MAX_SLOTS:
-                spec = (mb.V, mb.W, mb.eff_w_live, mb.shared_target,
-                        self.donate, Bp,
-                        _round_up(mb.n_events, EVENT_QUANTUM),
-                        mb.ev_slots.dtype, mb.target.shape[1])
-                skey = _aot_key(*spec)
+                if self._pallas_for(mb):
+                    spec = ("pallas", mb.V, mb.W, mb.eff_w_live,
+                            mb.shared_target, False, Bp,
+                            _round_up(mb.n_events, EVENT_QUANTUM),
+                            mb.ev_slots.dtype, mb.target.shape[1])
+                else:
+                    spec = (mb.V, mb.W, mb.eff_w_live,
+                            mb.shared_target, self.donate, Bp,
+                            _round_up(mb.n_events, EVENT_QUANTUM),
+                            mb.ev_slots.dtype, mb.target.shape[1])
+                skey = _spec_key(spec)
                 if skey not in warmed:
                     warmed.add(skey)
                     prewarm_kernels([spec])

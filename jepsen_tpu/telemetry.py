@@ -378,8 +378,11 @@ def gaps(records: Optional[Sequence[dict]] = None, *,
     ``campaign.seed``, ``run.case``...) are excluded — they enclose
     every gap by construction and would always top the ranking while
     naming nothing actionable. Time no leaf span covers is
-    ``(untraced)``. Returns fractions, gap count, and the top causes
-    by attributed seconds."""
+    ``(untraced)``. Returns fractions, gap count, the top causes by
+    attributed seconds, and ``device_busy_by_family`` — the busy union
+    broken down per backend family (the ``family=`` span attribute:
+    ``wgl`` for the lax.scan kernels, ``wgl-pallas`` for the Pallas
+    megakernel, ``graph`` for the MXU closure)."""
     recs = list(records) if records is not None else spans()
     dev = []
     host = []
@@ -389,20 +392,33 @@ def gaps(records: Optional[Sequence[dict]] = None, *,
         t0 = float(r.get("ts", 0.0))
         t1 = t0 + float(r.get("dur", 0.0))
         if r.get("cat") == "device":
-            dev.append((t0, t1))
+            fam = (r.get("args") or {}).get("family") or "(untagged)"
+            dev.append((t0, t1, fam))
         else:
             host.append((t0, t1, r.get("name", "?")))
     if not dev:
         return {"window_s": 0.0, "device_busy_s": 0.0, "host_gap_s": 0.0,
                 "device_busy_frac": None, "host_gap_frac": None,
-                "n_gaps": 0, "top_gap_causes": []}
-    dev.sort()
-    merged = [list(dev[0])]
-    for t0, t1 in dev[1:]:
-        if t0 <= merged[-1][1]:
-            merged[-1][1] = max(merged[-1][1], t1)
-        else:
-            merged.append([t0, t1])
+                "n_gaps": 0, "top_gap_causes": [],
+                "device_busy_by_family": {}}
+
+    def _merge(ivs):
+        ivs = sorted(ivs)
+        out = [list(ivs[0])]
+        for t0, t1 in ivs[1:]:
+            if t0 <= out[-1][1]:
+                out[-1][1] = max(out[-1][1], t1)
+            else:
+                out.append([t0, t1])
+        return out
+
+    by_fam_ivs: Dict[str, list] = {}
+    for t0, t1, fam in dev:
+        by_fam_ivs.setdefault(fam, []).append((t0, t1))
+    by_family = {
+        fam: round(sum(b - a for a, b in _merge(ivs)) / 1e6, 6)
+        for fam, ivs in sorted(by_fam_ivs.items())}
+    merged = _merge([(t0, t1) for t0, t1, _ in dev])
     # Leaf filter by bisect against the merged device intervals (a
     # full pairwise scan is O(hosts x devices) — minutes of CPU on a
     # default-size ring): a host span is a wrapper iff the first
@@ -468,6 +484,7 @@ def gaps(records: Optional[Sequence[dict]] = None, *,
         "n_gaps": len(gap_ivs),
         "top_gap_causes": [[name, round(s / 1e6, 6)]
                            for name, s in order],
+        "device_busy_by_family": by_family,
     }
 
 

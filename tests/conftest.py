@@ -84,6 +84,13 @@ def pytest_configure(config):
                    "takeover-storm breaker, SLO scale advice "
                    "(deterministic; runs in tier-1)")
     config.addinivalue_line(
+        "markers", "pallas: Pallas WGL megakernel — interpret-mode "
+                   "parity vs the host oracle and the lax.scan "
+                   "kernel, fault-schedule parity, journal "
+                   "kill-and-resume, cost-router crossover, and the "
+                   "JT_ROUTER_PALLAS=0 restore switch (deterministic; "
+                   "runs in tier-1)")
+    config.addinivalue_line(
         "markers", "telemetry: span tracer + metrics registry — "
                    "nesting/attributes, ring wraparound, Chrome-trace "
                    "export, snapshot determinism, no-op-when-off, and "

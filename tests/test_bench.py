@@ -44,6 +44,14 @@ def test_bench_emits_contract_json():
                JT_BENCH_SERVICE_WORKERS="1",
                JT_BENCH_SERVICE_TENANTS="2", JT_BENCH_SERVICE_OPS="6",
                JT_SERVICE_STAGGER_S="0", JT_LEASE_SKEW_S="0",
+               # Backend sections at toy scale: the JT_BENCH_BACKEND
+               # knob must be accepted, the startup probe must run,
+               # and the Pallas-vs-XLA table must emit one honest
+               # point (interpret mode on this CPU box — the guard is
+               # the shape, not the crossover).
+               JT_BENCH_BACKEND="auto",
+               JT_BENCH_COMPARE_WS="4", JT_BENCH_COMPARE_B="8",
+               JT_BENCH_COMPARE_EVENTS="64",
                # Tracing stays ambient-off: the section flips the
                # flight recorder on for its own traced passes only.
                JT_TRACE="0")
@@ -204,5 +212,28 @@ def test_bench_emits_contract_json():
     assert 0 <= tl["device_busy_frac"] <= 1
     assert 0 <= tl["host_gap_frac"] <= 1
     assert isinstance(tl["top_gap_causes"], list)
+    # Per-backend-family device-busy breakdown (ISSUE 12 satellite):
+    # the traced pass dispatched through the WGL family.
+    assert isinstance(tl["device_busy_by_family"], dict)
+    assert any(k.startswith("wgl") for k in tl["device_busy_by_family"])
     # JT_TRACE unset/0: no ambient trace, no trace.json emitted.
     assert tl["ambient_trace"] is False and tl["trace_json"] is None
+    # Backend-compare section (ISSUE 12 acceptance): the measured
+    # Pallas-vs-XLA rate per W class, the router's crossover, and the
+    # startup probe cost — honest on a CPU box (interpret mode, scan
+    # wins, crossover None is legal).
+    bc = d["backend_compare"]
+    assert bc["mode"] in ("compiled", "interpret", "off")
+    assert bc["backend_forced"] == "auto"
+    assert [p["W"] for p in bc["points"]] == [4]
+    p0 = bc["points"][0]
+    assert p0["rows"] == 8 and p0["xla_hist_per_s"] > 0
+    assert p0["winner"] in ("xla", "pallas")
+    if bc["mode"] != "off":
+        assert p0["pallas_hist_per_s"] > 0
+        assert p0["pallas_speedup"] > 0
+        assert bc["probe"]["lane_ops_per_s"] > 0
+        assert bc["probe"]["pallas_lane_ops_per_s"] > 0
+        assert bc["probe"]["parity"] is True
+    assert "crossover_w" in bc
+    assert bc["headline_pallas_dispatches"] >= 0

@@ -1,0 +1,317 @@
+"""Pallas WGL megakernel (ops.pallas_wgl): the fourth cost-routed
+backend, parity-gated on the CPU tier-1 box via ``pltpu`` interpret
+mode.
+
+The contract under test: the hand-scheduled kernel is bit-identical to
+the ``lax.scan`` registry kernel (same verdicts, same bad indices,
+same latched frontiers) on raw encoded buckets, field-for-field
+identical to the host oracle through the full checker stack — fault
+free AND under every single-fault schedule — resumes through the
+chunk journal with zero re-dispatched decided rows, is CHOSEN by the
+fleet cost router only when the measured rates favor it (never
+hardcoded), and vanishes bit-identically under JT_ROUTER_PALLAS=0.
+
+Interpret mode is orders of magnitude slower than the scan on CPU, so
+workloads here are deliberately tiny; the measured-hardware story
+lives in bench.py's backend_compare section.
+"""
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.linearizable import prepare_history, wgl_check
+from jepsen_tpu.history.core import index
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.ops import pallas_wgl as pw
+from jepsen_tpu.ops.encode import bucket_encode
+from jepsen_tpu.ops.faults import (FaultInjector, FaultPlan, InjectedKill,
+                                   single_fault_schedules)
+from jepsen_tpu.ops.linearize import (DISPATCH_LOG, check_batch_tpu,
+                                      check_columnar, get_kernel)
+from jepsen_tpu.store import ChunkJournal
+from jepsen_tpu.workloads.synth import synth_cas_columnar, synth_cas_history
+
+pytestmark = pytest.mark.pallas
+
+MODEL = cas_register()
+
+# One scheduler shape for every stacked-path test in the module, so
+# interpret-mode kernel compiles are paid once (the registry and jit
+# caches are process-wide).
+SCHED = {"wgl_backend": "pallas", "chunk_rows": 8}
+
+
+def corpus(n=18, seed0=7100):
+    return [synth_cas_history(seed0 + i, n_procs=2 + i % 4, n_ops=12,
+                              corrupt=0.5 if i % 2 else 0.0,
+                              p_info=0.25 if i % 5 == 0 else 0.0)
+            for i in range(n)]
+
+
+def assert_field_parity(got, want, ctx=""):
+    for i, (g, w) in enumerate(zip(got, want, strict=True)):
+        assert g["valid"] == w["valid"], (ctx, i)
+        if g["valid"] is False:
+            assert g["op"]["index"] == w["op"]["index"], (ctx, i)
+        assert g.get("configs") == w.get("configs"), (ctx, i)
+
+
+@pytest.fixture(scope="module")
+def hists():
+    return corpus()
+
+
+@pytest.fixture(scope="module")
+def host_oracle(hists):
+    return [wgl_check(MODEL, h) for h in hists]
+
+
+@pytest.fixture(scope="module")
+def pallas_baseline(hists):
+    """Fault-free verdicts through the pallas-forced scheduler — also
+    warms every interpret-mode kernel shape, so the fault runs below
+    never pay a compile under a nemesis-scale watchdog deadline."""
+    return check_batch_tpu(MODEL, hists, scheduler_opts=dict(SCHED))
+
+
+# ------------------------------------------------- raw kernel parity
+
+def test_kernel_bit_parity_vs_scan():
+    """The Pallas kernel and the lax.scan kernel produce IDENTICAL
+    (valid, bad, frontier) triples on raw encoded buckets — invalid
+    rows, latched pre-failure closures, shared and per-row targets."""
+    hs = corpus(n=24, seed0=7300)
+    for h in hs:
+        index(h)
+    prepared = [prepare_history(h) for h in hs]
+    buckets = bucket_encode(MODEL, prepared, max_states=64,
+                            max_slots=16, fuse=True)
+    checked = invalid = 0
+    for b in buckets:
+        if not b.batch or not pw.pallas_supports(b.V, b.W):
+            continue
+        xk = get_kernel(b.V, b.W, shared_target=b.shared_target,
+                        w_live=b.eff_w_live)
+        pk = pw.get_pallas_kernel(b.V, b.W,
+                                  shared_target=b.shared_target,
+                                  w_live=b.eff_w_live)
+        tgt = b.target[0] if b.shared_target else b.target
+        args = (b.ev_type, b.ev_slot, b.ev_slots, tgt)
+        xv, xb, xf = (np.asarray(a) for a in xk(*args))
+        pv, pb, pf = (np.asarray(a) for a in pk(*args))
+        np.testing.assert_array_equal(xv, pv)
+        np.testing.assert_array_equal(xb, pb)
+        np.testing.assert_array_equal(xf, pf)
+        checked += b.batch
+        invalid += int((~xv).sum())
+    assert checked >= 20
+    assert invalid >= 1, "corpus must exercise the failure latch"
+
+
+def test_kernel_pads_ragged_event_axes():
+    """Event axes that don't divide the stream block still decide
+    identically (the wrapper's EV_PAD tail is a no-op)."""
+    args = pw.make_probe_batch(V=4, W=4, rows=4, events=70)
+    xk = get_kernel(4, 4, shared_target=True)
+    pk = pw.get_pallas_kernel(4, 4, shared_target=True)
+    for a, b in zip(xk(*args), pk(*args)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------- stacked-path parity
+
+def test_corpus_field_parity_vs_host_oracle(hists, host_oracle,
+                                            pallas_baseline):
+    assert_field_parity(pallas_baseline, host_oracle, "host")
+    assert any(r["valid"] is False for r in host_oracle)
+
+
+def test_pallas_backend_actually_dispatches(hists):
+    DISPATCH_LOG.clear()
+    check_batch_tpu(MODEL, hists, scheduler_opts=dict(SCHED))
+    assert any(t[0] == "pallas" for t in DISPATCH_LOG)
+
+
+def test_parity_under_every_single_fault_schedule(hists,
+                                                  pallas_baseline):
+    """The degradation ladder wraps the Pallas backend like any other
+    dispatch: under every single-fault schedule the pallas-forced run
+    still yields field-identical verdicts for 100% of histories."""
+    for name, plan in single_fault_schedules():
+        inj = FaultInjector(plan)
+        got = check_batch_tpu(MODEL, hists, faults=inj,
+                              scheduler_opts=dict(SCHED))
+        assert_field_parity(got, pallas_baseline, name)
+        assert inj.log, f"schedule {name} never engaged"
+
+
+# ------------------------------------------- journal kill-and-resume
+
+def test_kill_and_resume_zero_redispatch(tmp_path):
+    """SIGKILL-shaped interruption mid-run, then resume through the
+    same ChunkJournal: decided rows never re-dispatch (on the pallas
+    backend exactly as on the scan), and verdicts match the
+    uninterrupted run."""
+    cols = synth_cas_columnar(40, seed=9, n_ops=10, corrupt=0.3)
+    base_v, base_b = check_columnar(MODEL, cols,
+                                    scheduler_opts=dict(SCHED))
+    key = {"digest": "pallas-kill-resume"}
+    j1 = ChunkJournal(tmp_path / "j.jsonl", key)
+    inj = FaultInjector(FaultPlan.single("dispatch", "kill", chunk=2,
+                                         deadline_s=60.0))
+    with pytest.raises(InjectedKill):
+        check_columnar(MODEL, cols, faults=inj, journal=j1,
+                       scheduler_opts=dict(SCHED))
+    j1.close()
+    j2 = ChunkJournal(tmp_path / "j.jsonl", key, resume=True)
+    decided = j2.decided()
+    assert decided and len(decided) < cols.batch
+    DISPATCH_LOG.clear()
+    v, b = check_columnar(MODEL, cols, journal=j2,
+                          scheduler_opts=dict(SCHED))
+    np.testing.assert_array_equal(v, base_v)
+    np.testing.assert_array_equal(b, base_b)
+    assert j2.resume_hits == len(decided)
+    redispatched = sum(n for _, _, _, n in DISPATCH_LOG)
+    assert redispatched <= cols.batch - len(decided)
+    j2.finish()
+
+
+# --------------------------------------------------- cost routing
+
+def test_router_prices_and_chooses_pallas_under_device_rates():
+    from jepsen_tpu.fleet import CostRouter
+    fast = {"pallas_lane_ops_per_s": 1e12, "lane_ops_per_s": 1e8}
+    r = CostRouter(rates=fast)
+    backend, costs = r.choose_wgl(8, 1000)
+    assert backend == "wgl-pallas"
+    assert costs["wgl-pallas"] < costs["wgl-device"]
+    # Past the capability window the kernel is never even priced.
+    wide = r.price_wgl(pw.pallas_max_w() + 2, 1000)
+    assert "wgl-pallas" not in wide
+    # Unprobed default: no pallas rate, no pallas backend — the
+    # pre-pallas cost dict, bit-identical.
+    r0 = CostRouter(rates={"pallas_lane_ops_per_s": 0.0})
+    assert set(r0.price_wgl(8, 1000)) == {"wgl-device", "host-oracle"}
+
+
+def test_scheduler_auto_routes_by_measured_rates(hists, monkeypatch):
+    """"auto" consults the router's measured rates: a device-favoring
+    pallas rate flips the dispatch onto the megakernel; no rate keeps
+    the scan — never a hardcoded preference."""
+    from jepsen_tpu import fleet
+    monkeypatch.setenv("JT_PALLAS_LANE_OPS_PER_S", "1e12")
+    DISPATCH_LOG.clear()
+    check_batch_tpu(MODEL, hists[:6],
+                    scheduler_opts={"wgl_backend": "auto",
+                                    "chunk_rows": 8})
+    assert any(t[0] == "pallas" for t in DISPATCH_LOG)
+    monkeypatch.delenv("JT_PALLAS_LANE_OPS_PER_S")
+    fleet.set_measured_rates(None)
+    DISPATCH_LOG.clear()
+    check_batch_tpu(MODEL, hists[:6],
+                    scheduler_opts={"wgl_backend": "auto",
+                                    "chunk_rows": 8})
+    assert not any(t[0] == "pallas" for t in DISPATCH_LOG)
+
+
+def test_route_check_dispatches_pallas_group(hists, host_oracle):
+    from jepsen_tpu.fleet import CostRouter, route_check
+    router = CostRouter(rates={"pallas_lane_ops_per_s": 1e12,
+                               "lane_ops_per_s": 1.0})
+    results, routing = route_check(MODEL, hists[:8], router=router)
+    assert routing["backends"].get("wgl-pallas", 0) == 8
+    for r, w in zip(results, host_oracle[:8]):
+        assert r["backend"] == "wgl-pallas"
+        assert r["valid"] == w["valid"]
+
+
+def test_rates_persist_and_reload_per_host(tmp_path):
+    from jepsen_tpu.fleet import (CostRouter, load_persisted_rates,
+                                  persist_rates)
+    persist_rates(tmp_path, {"pallas_lane_ops_per_s": 5e9,
+                             "lane_ops_per_s": 2e9,
+                             "bogus_key": 1.0}, host="hostA")
+    persist_rates(tmp_path, {"pallas_lane_ops_per_s": 7e9},
+                  host="hostB")
+    got = load_persisted_rates(tmp_path, host="hostA")
+    assert got == {"pallas_lane_ops_per_s": 5e9, "lane_ops_per_s": 2e9}
+    # No cross-host fallback on a heterogeneous fleet.
+    assert load_persisted_rates(tmp_path, host="hostC") == {}
+    r = CostRouter(store_dir=tmp_path)        # this host never probed
+    assert r.rates["pallas_lane_ops_per_s"] == 0.0
+
+
+def test_probe_measures_both_backends():
+    out = pw.probe_rates(rows=4, events=64, repeats=1)
+    assert out["lane_ops_per_s"] > 0
+    assert out["pallas_lane_ops_per_s"] > 0      # interpret mode runs
+    assert out["parity"] is True
+    assert out["probe_s"] > 0
+    assert out["mode"] in ("interpret", "compiled")
+
+
+def test_pallas_member_does_not_defuse_scan_members(monkeypatch):
+    """A dispatch group holding one Pallas-routed member plus >=2
+    scan members ships the Pallas chunk solo and keeps the scan
+    members in ONE fused XLA call — routing a shape to the megakernel
+    must never cost the REST of the group its fusion (the whole point
+    of fused dispatch on the latency-bound path)."""
+    from jepsen_tpu.workloads.synth import synth_wide_window_history
+    monkeypatch.setenv("JT_PALLAS_MAX_W", "4")        # narrow only
+    monkeypatch.setenv("JT_PALLAS_LANE_OPS_PER_S", "1e12")
+    hs = [synth_cas_history(8200 + i, n_procs=2, n_ops=12)
+          for i in range(24)]
+    hs += [synth_wide_window_history(width=6, seed=s) for s in range(8)]
+    hs += [synth_wide_window_history(width=8, seed=s) for s in range(8)]
+    want = [wgl_check(MODEL, h) for h in hs]
+    DISPATCH_LOG.clear()
+    got = check_batch_tpu(MODEL, hs, scheduler_opts={
+        "wgl_backend": "auto", "chunk_rows": 4, "fuse_width": 4,
+        "shard_min_rows": 1 << 30})
+    kinds = [t[0] for t in DISPATCH_LOG]
+    assert kinds.count("pallas") >= 1, kinds
+    assert kinds.count("data1fused") >= 2, kinds
+    for i, (g, w) in enumerate(zip(got, want, strict=True)):
+        assert g["valid"] == w["valid"], i
+        if g["valid"] is False:
+            assert g["op"]["index"] == w["op"]["index"], i
+
+
+# ------------------------------------------------- the restore switch
+
+def test_router_disable_restores_scan_path(hists, pallas_baseline,
+                                           monkeypatch):
+    """JT_ROUTER_PALLAS=0 removes the backend entirely: even a FORCED
+    pallas scheduler falls back to the scan kernels (zero pallas
+    dispatches) with identical verdicts — the r11 path, restored."""
+    monkeypatch.setenv("JT_ROUTER_PALLAS", "0")
+    assert pw.pallas_mode() == "off"
+    assert not pw.pallas_available()
+    DISPATCH_LOG.clear()
+    got = check_batch_tpu(MODEL, hists, scheduler_opts=dict(SCHED))
+    assert not any(t[0] == "pallas" for t in DISPATCH_LOG)
+    assert any(t[0] in ("data1", "data1fused") for t in DISPATCH_LOG)
+    assert_field_parity(got, pallas_baseline, "disabled")
+
+
+# ------------------------------------------------ AOT satellite
+
+def test_aot_rejecting_pallas_lowering_counts_unsupported(tmp_path,
+                                                          monkeypatch):
+    """serialize_executable rejecting a lowering records
+    aot_unsupported and falls through instead of erroring the
+    pre-warm thread (the compile-cache path still parks the
+    executable in-memory)."""
+    from jepsen_tpu.ops import schedule as sm
+    monkeypatch.setenv("JT_COMPILE_CACHE", "1")
+    monkeypatch.setenv("JT_AOT_DIR", str(tmp_path / "aot"))
+
+    class Unserializable:
+        pass                        # se.serialize chokes on this
+
+    before = dict(sm.AOT_STATS)
+    sm._aot_store(("pallas-test-key",), Unserializable())
+    assert sm.AOT_STATS["unsupported"] == before["unsupported"] + 1
+    assert sm.AOT_STATS["exported"] == before["exported"]
+    assert not list((tmp_path / "aot").glob("*")) \
+        if (tmp_path / "aot").exists() else True
