@@ -77,6 +77,100 @@ import os
 import time
 from pathlib import Path
 
+# ------------------------------------------------ regression sentinel
+#
+# ``python bench.py --compare PREV.json`` runs the bench and adds a
+# ``regression`` section to the one JSON line: every rate-like metric
+# below, present in both rounds, compared at a relative tolerance
+# (``--tolerance``, default 0.20 — CPU containers are noisy; a real
+# TPU round can tighten it). Exit code 3 when any rate regressed past
+# tolerance — the next BENCH round machine-checks itself against the
+# last instead of trusting a human diff. ``--current CUR.json`` skips
+# the bench and compares two committed files (the self-compare /
+# fixture mode tests and CI use; no jax import on that path).
+
+#: Dotted paths of the throughput figures a round must not silently
+#: lose. Higher is better for every one of them; keys absent from
+#: either side (older rounds, skipped sections) are skipped, never
+#: guessed.
+RATE_KEYS = (
+    "value",                                # the headline hist/s
+    "device_rate",
+    "native_cpu_rate",
+    "converted_e2e_rate",
+    "store_recheck_rate",
+    "fold_total_queue_rate",
+    "scheduler.streamed_e2e_rate",
+    "graph_checker.graphs_per_s",
+    "run_durability.ops_per_s_wal_on",
+    "run_durability.salvage_ops_per_s",
+    "long_history.routed.events_per_s",
+    "xlong_history.events_per_s",
+    "synth_device.device_hist_per_s",
+    "synth_device.host_hist_per_s",
+    "synth_device.streamed_gen_check_subs_per_s",
+    "online.verdicts_per_s_while_writing",
+)
+
+
+def _dig(d, path):
+    cur = d
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def unwrap_bench(d: dict) -> dict:
+    """Accept both the raw bench line and the committed BENCH_r*.json
+    shape (the driver wraps the parsed line under ``parsed`` next to
+    cmd/rc/note)."""
+    if isinstance(d, dict) and "metric" not in d and \
+            isinstance(d.get("parsed"), dict):
+        return d["parsed"]
+    return d
+
+
+def compare_bench(prev: dict, cur: dict,
+                  tolerance: float = 0.20) -> dict:
+    """Per-rate deltas of ``cur`` vs ``prev`` (both bench JSON
+    objects) under a relative tolerance. A metric REGRESSES when
+    ``cur < prev * (1 - tolerance)``; improvements are reported but
+    never fail. Returns the ``regression`` section: ``{"baseline",
+    "tolerance", "rates": {key: {prev, cur, ratio, regressed}},
+    "regressions": [keys], "ok": bool}``."""
+    prev = unwrap_bench(prev)
+    cur = unwrap_bench(cur)
+    rates = {}
+    regressions = []
+    for key in RATE_KEYS:
+        pv, cv = _dig(prev, key), _dig(cur, key)
+        if not isinstance(pv, (int, float)) or \
+                not isinstance(cv, (int, float)) or \
+                isinstance(pv, bool) or isinstance(cv, bool) or \
+                pv <= 0:
+            continue
+        ratio = cv / pv
+        regressed = cv < pv * (1.0 - tolerance)
+        rates[key] = {"prev": round(float(pv), 3),
+                      "cur": round(float(cv), 3),
+                      "ratio": round(ratio, 4),
+                      "regressed": regressed}
+        if regressed:
+            regressions.append(key)
+    out = {"tolerance": tolerance, "compared": len(rates),
+           "rates": rates, "regressions": regressions,
+           "ok": bool(rates) and not regressions}
+    if not rates:
+        # Zero comparable rates is a FAILED comparison, not a pass: a
+        # malformed baseline (a failed round's wrapper with parsed:
+        # null, a foreign schema) must not read as "machine-checked
+        # clean" in CI.
+        out["error"] = ("no comparable rate metrics between the two "
+                        "files (malformed baseline?)")
+    return out
+
 
 def _pct_nearest(xs, p, digits=4):
     """Nearest-rank percentile over a SORTED list — the telemetry
@@ -90,7 +184,7 @@ def _pct_nearest(xs, p, digits=4):
     return round(xs[i], digits)
 
 
-def main():
+def main(compare: dict = None, tolerance: float = 0.20) -> int:
     B = int(os.environ.get("JT_BENCH_B", "10000"))
     n_ops = int(os.environ.get("JT_BENCH_OPS", "500"))
     repeats = int(os.environ.get("JT_BENCH_REPEATS", "3"))
@@ -1741,7 +1835,7 @@ def main():
                 sched_stats.get("pallas_dispatches", 0) or 0,
         }
 
-    print(json.dumps({
+    out = {
         "metric": "linearizability_check_throughput_1kop_cas_e2e",
         "value": round(rate, 2),
         "unit": "histories/sec",
@@ -1867,8 +1961,49 @@ def main():
         "online": online_section,
         "fleet": fleet_section,
         "service": service_section,
-    }))
+    }
+    rc = 0
+    if compare is not None:
+        out["regression"] = compare_bench(compare, out,
+                                          tolerance=tolerance)
+        if not out["regression"]["ok"]:
+            rc = 3
+    print(json.dumps(out))
+    return rc
+
+
+def _cli() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="headline bench; --compare PREV.json adds the "
+                    "regression sentinel section")
+    ap.add_argument("--compare", default=None, metavar="PREV",
+                    help="Previous BENCH json to machine-check this "
+                         "round against (exit 3 on a rate regression "
+                         "past --tolerance)")
+    ap.add_argument("--current", default=None, metavar="CUR",
+                    help="With --compare: skip running the bench and "
+                         "compare CUR.json against PREV.json (the "
+                         "fixture/self-compare mode; no jax needed)")
+    ap.add_argument("--tolerance", type=float, default=0.20,
+                    help="Relative rate-regression tolerance "
+                         "(default 0.20)")
+    args = ap.parse_args()
+    if args.current and not args.compare:
+        ap.error("--current needs --compare")
+    prev = None
+    if args.compare:
+        with open(args.compare) as f:
+            prev = json.load(f)
+    if args.current:
+        with open(args.current) as f:
+            cur = json.load(f)
+        reg = compare_bench(prev, cur, tolerance=args.tolerance)
+        print(json.dumps({"regression": reg}))
+        return 0 if reg["ok"] else 3
+    return main(compare=prev, tolerance=args.tolerance)
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(_cli())

@@ -1,0 +1,273 @@
+"""SLO burn-rate alerting over the durable metrics series.
+
+The series files (``telemetry.series``) give the cluster a time axis;
+this module is the evaluator that turns it into actionable, durable
+alerts. Rules (multi-window burn-rate discipline, simplified to the
+one window the 5 s frame cadence supports):
+
+  * ``ttfv_slo`` — the cluster-merged ``online.ttfv_s`` p99 (the
+    conservative-max cross-worker merge) against the budget ledger's
+    ``slo_ttfv_s``. The **burn rate** is p99/SLO: ≥1 means the error
+    budget is burning at all; ≥ ``PAGE_BURN`` (2x) escalates severity
+    to ``page`` — the scale-up signal ``service.py`` already acts on,
+    now durable and visible.
+  * rate rules — cluster-wide rates over the trailing window
+    (``series.cluster_rate``) against thresholds:
+    ``online.backpressure`` (ingest stalled behind the checker),
+    ``online.shed`` (interim checks degraded to the host oracle),
+    ``scheduler.quarantined_rows`` (poison rows — ANY rate fires:
+    quarantine is a correctness-adjacent signal), and
+    ``service.takeovers`` (lease-takeover spike — worker death or
+    lease-clock trouble). Burn rate = observed rate / threshold.
+
+Alerts are **edge-triggered** into ``store/telemetry/alerts.jsonl``
+(atomic whole-line appends, torn-tail-tolerant reads): one ``firing``
+record when a rule transitions inactive→active, one ``resolved``
+record on the way back — a steadily-breaching cluster writes two
+lines, not one per tick. ``active_alerts`` replays the log into the
+currently-firing set, which the web ``/live`` and ``/service`` views
+render as badges and ``jepsen-tpu metrics`` can expose.
+
+The evaluator runs inside every online daemon / service worker tick
+(cadence-bounded by ``JT_ALERT_EVAL_S``, default 10 s; ``JT_ALERTS=0``
+disables). Thresholds: ``JT_ALERT_BACKPRESSURE_RATE`` (default 5/s),
+``JT_ALERT_SHED_RATE`` (1/s), ``JT_ALERT_TAKEOVER_RATE`` (0.5/s);
+``slo_ttfv_s`` comes from the service budget ledger (0 = rule off).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from . import series, telemetry
+
+ALERTS_MAGIC = "JTALRT1"
+ALERTS_FILE = "alerts.jsonl"
+
+#: Burn-rate multiple past which severity escalates warn -> page.
+PAGE_BURN = 2.0
+
+#: Trailing window the rate rules evaluate over, seconds.
+WINDOW_S = 60.0
+
+
+def enabled() -> bool:
+    return os.environ.get("JT_ALERTS", "1") != "0"
+
+
+def eval_interval_s() -> float:
+    try:
+        return max(0.0, float(os.environ.get("JT_ALERT_EVAL_S", "10")))
+    except ValueError:
+        return 10.0
+
+
+def _env_rate(name: str, dflt: float) -> float:
+    try:
+        return float(os.environ.get(name, dflt))
+    except (TypeError, ValueError):
+        return float(dflt)
+
+
+def alerts_path(store_base) -> Path:
+    return series.telemetry_dir(store_base) / ALERTS_FILE
+
+
+# ----------------------------------------------------------- evaluate
+
+def evaluate(store_base, *, budget: Optional[dict] = None,
+             now: Optional[float] = None,
+             window_s: float = WINDOW_S) -> List[dict]:
+    """One stateless evaluation pass: every rule's CURRENT state over
+    the store's series files. Returns the firing alerts (possibly
+    empty) — each ``{"alert", "severity", "value", "threshold",
+    "burn_rate", "window_s"}``. Recording/edge-triggering is
+    ``AlertLog``'s job; keeping evaluation pure makes it testable and
+    lets ``jepsen-tpu metrics``/web render without write access.
+
+    The series files are read ONCE per pass (``series.all_series``);
+    every rule — the merged-percentile SLO, the per-counter cluster
+    rates, the label-set quarantine sweep — computes from that one
+    in-memory result, so an evaluator ticking every 10 s costs one
+    scan of N ring files, not one per rule."""
+    now = time.time() if now is None else now
+    data = series.all_series(store_base)   # ONE read of every ring
+    out: List[dict] = []
+
+    def fire(name, value, threshold, *, unit):
+        burn = (value / threshold) if threshold else None
+        out.append({
+            "alert": name,
+            "severity": ("page" if burn is not None
+                         and burn >= PAGE_BURN else "warn"),
+            "value": round(float(value), 6),
+            "threshold": round(float(threshold), 6),
+            "burn_rate": round(burn, 4) if burn is not None else None,
+            "unit": unit, "window_s": window_s,
+        })
+
+    def rate(counter):
+        rates = [r for r in
+                 (series.rate_over_window(frames, counter, window_s,
+                                          now=now)
+                  for frames in data.values())
+                 if r is not None]
+        return sum(rates) if rates else None
+
+    # SLO rule: cluster-merged ttfv p99 vs the budget ledger.
+    slo = float((budget or {}).get("slo_ttfv_s") or 0.0)
+    if slo > 0:
+        fresh = [frames[-1].get("snap") or {}
+                 for frames in data.values()
+                 if now - float(frames[-1].get("t") or 0)
+                 <= 10 * window_s]
+        merged = telemetry.merge_histogram_snapshots(fresh)
+        p99 = (merged.get("online.ttfv_s") or {}).get("p99")
+        if p99 is not None and float(p99) > slo:
+            fire("ttfv_slo", float(p99), slo, unit="s")
+
+    # Rate rules: cluster-wide rates over the trailing window.
+    for counter, env, dflt in (
+            ("online.backpressure", "JT_ALERT_BACKPRESSURE_RATE", 5.0),
+            ("online.shed", "JT_ALERT_SHED_RATE", 1.0),
+            ("service.takeovers", "JT_ALERT_TAKEOVER_RATE", 0.5)):
+        thr = _env_rate(env, dflt)
+        if thr <= 0:
+            continue
+        r = rate(counter)
+        if r is not None and r > thr:
+            fire(f"{counter}.rate", r, thr, unit="1/s")
+
+    # Quarantine: ANY sustained rate is a correctness-adjacent page —
+    # across EVERY label set the schedulers emit (family=wgl, graph,
+    # future backends): match by decoded metric name, never a
+    # hardcoded label combination.
+    qkeys = {k for frames in data.values() for fr in frames[-1:]
+             for k in ((fr.get("snap") or {}).get("counters") or {})
+             if telemetry.parse_key(k)[0]
+             == "scheduler.quarantined_rows"}
+    qrate = sum(r for r in (rate(k) for k in sorted(qkeys))
+                if r is not None) if qkeys else None
+    if qrate:
+        out.append({"alert": "scheduler.quarantine.rate",
+                    "severity": "page",
+                    "value": round(float(qrate), 6), "threshold": 0.0,
+                    "burn_rate": None, "unit": "1/s",
+                    "window_s": window_s})
+    return out
+
+
+# ------------------------------------------------------- durable log
+
+class AlertLog:
+    """Edge-triggered durable alert recorder for ONE evaluator.
+
+    ``record(firing)`` diffs the firing set against this evaluator's
+    last view and appends only transitions: ``state: "firing"`` when a
+    rule newly fires (payload included), ``state: "resolved"`` when it
+    stops. Appends are whole-line + flush + fsync; concurrent workers
+    appending to the shared log interleave at line granularity (O_APPEND
+    semantics), and readers tolerate a torn tail. Dedup is per-writer:
+    two workers may both announce one cluster-wide breach — the reader
+    dedups by alert name, and two firings beat a missed one."""
+
+    def __init__(self, store_base, worker_id: str = ""):
+        self.path = alerts_path(store_base)
+        self.worker_id = worker_id or series.worker_key()
+        self._active: Dict[str, dict] = {}
+
+    def record(self, firing: List[dict],
+               now: Optional[float] = None) -> List[dict]:
+        """Append the transitions; returns the newly-fired alerts."""
+        now = time.time() if now is None else now
+        cur = {a["alert"]: a for a in firing}
+        new = [a for k, a in cur.items() if k not in self._active]
+        gone = [k for k in self._active if k not in cur]
+        lines = []
+        for a in new:
+            lines.append({"alerts": ALERTS_MAGIC, "state": "firing",
+                          "at": round(now, 3), "by": self.worker_id,
+                          **a})
+        for k in gone:
+            lines.append({"alerts": ALERTS_MAGIC, "state": "resolved",
+                          "at": round(now, 3), "by": self.worker_id,
+                          "alert": k})
+        if lines:
+            try:
+                self.path.parent.mkdir(parents=True, exist_ok=True)
+                with open(self.path, "a") as f:
+                    for rec in lines:
+                        f.write(json.dumps(rec, default=str) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+            except OSError:
+                # Alerting is diagnostics, never a fault — but a
+                # failed append must NOT mark the transition as
+                # announced, or one transient write error (disk
+                # full) silently drops a firing alert from the
+                # durable log for its whole duration. Keep the old
+                # view; the next evaluation retries the same edge.
+                return []
+        self._active = cur
+        return new
+
+
+class AlertEvaluator:
+    """The tick hook a daemon owns: cadence-bounded evaluate + record.
+    ``maybe_eval()`` is free until ``JT_ALERT_EVAL_S`` elapsed —
+    callable from every tick unconditionally, like
+    ``SeriesWriter.maybe_append``."""
+
+    def __init__(self, store_base, worker_id: str = "",
+                 budget_fn=None):
+        self.store_base = store_base
+        self.log = AlertLog(store_base, worker_id)
+        self.budget_fn = budget_fn
+        self._last = -1e18
+
+    def maybe_eval(self, now: Optional[float] = None) -> List[dict]:
+        nowm = time.monotonic()
+        if nowm - self._last < eval_interval_s():
+            return []
+        self._last = nowm
+        try:
+            budget = self.budget_fn() if self.budget_fn else None
+            firing = evaluate(self.store_base, budget=budget, now=now)
+            new = self.log.record(firing, now=now)
+            for a in new:
+                telemetry.event("alert.fired", alert=a["alert"],
+                                severity=a["severity"])
+                telemetry.REGISTRY.counter(
+                    "alerts.fired", severity=a["severity"]).inc()
+            return new
+        except Exception:
+            return []            # never let alerting fail a worker
+
+
+# ------------------------------------------------------------ reading
+
+def read_log(store_base, limit: int = 1024) -> List[dict]:
+    """The alert log's newest ``limit`` records, tolerant of a torn
+    tail and foreign lines (series.read_magic_jsonl — the shared read
+    discipline)."""
+    return series.read_magic_jsonl(alerts_path(store_base),
+                                   "alerts", ALERTS_MAGIC)[-limit:]
+
+
+def active_alerts(store_base) -> List[dict]:
+    """Replay the log into the currently-firing set (newest payload
+    per alert name wins; a ``resolved`` record clears it) — what the
+    web views badge."""
+    active: Dict[str, dict] = {}
+    for rec in read_log(store_base):
+        name = rec.get("alert")
+        if not name:
+            continue
+        if rec.get("state") == "resolved":
+            active.pop(name, None)
+        else:
+            active[name] = rec
+    return [active[k] for k in sorted(active)]

@@ -1049,17 +1049,24 @@ def fleet_cmd() -> dict:
 
 
 def trace_cmd() -> dict:
-    """``trace --file trace.jsonl``: summarize / export a recorded
-    span trace (the JSONL sink ``JT_TRACE=<path>`` streams — see
-    jepsen_tpu.telemetry and doc/observability.md). Prints one JSON
-    line: per-name span totals, optional dispatch-gap report
-    (``--gaps`` — device-busy vs host-gap fractions and the top gap
-    causes, the plateau diagnostic), and ``--export OUT`` writes the
-    Chrome-trace/Perfetto ``trace.json`` form (load at
-    chrome://tracing or ui.perfetto.dev)."""
+    """``trace --file trace.jsonl`` / ``trace --merge DIR``: summarize
+    / export recorded span traces (the JSONL sink ``JT_TRACE=<path>``
+    streams — see jepsen_tpu.telemetry and doc/observability.md).
+    ``--file`` works on one sink; ``--merge DIR`` fuses every
+    ``*.jsonl`` sink in DIR onto one wall-clock-aligned timeline with
+    per-worker process lanes and correlation-id flow arrows
+    (telemetry.merge_traces — the cross-worker takeover view). Prints
+    one JSON line: per-name span totals, optional dispatch-gap report
+    (``--gaps`` — device-busy vs host-gap fractions, top gap causes,
+    and per-worker/per-family busy attribution on merged traces), and
+    ``--export OUT`` writes the Chrome-trace/Perfetto ``trace.json``
+    form (load at chrome://tracing or ui.perfetto.dev)."""
     def add_opts(p):
-        p.add_argument("--file", required=True,
+        p.add_argument("--file", default=None,
                        help="JSONL trace file (a JT_TRACE=<path> sink)")
+        p.add_argument("--merge", default=None, metavar="DIR",
+                       help="Fuse every *.jsonl sink in DIR into one "
+                            "cross-worker timeline")
         p.add_argument("--export", default=None, metavar="OUT",
                        help="Also write Chrome-trace trace.json here")
         p.add_argument("--gaps", action="store_true", default=False,
@@ -1069,20 +1076,42 @@ def trace_cmd() -> dict:
 
     def run(opts):
         import json as _json
+        from pathlib import Path as _Path
 
         from . import telemetry
 
-        try:
-            records = telemetry.read_trace(opts.file)
-        except OSError as e:
-            print(f"can't read {opts.file}: {e}")
+        if bool(opts.file) == bool(opts.merge):
+            print("trace wants exactly one of --file or --merge DIR")
             return 254
+        if opts.merge:
+            paths = sorted(_Path(opts.merge).glob("*.jsonl"))
+            if not paths:
+                print(f"no *.jsonl traces under {opts.merge}")
+                return 254
+            records = telemetry.merge_traces(paths)
+            source = {"merged": [str(p) for p in paths]}
+        else:
+            try:
+                records = telemetry.read_trace(opts.file)
+            except OSError as e:
+                print(f"can't read {opts.file}: {e}")
+                return 254
+            source = {"file": opts.file}
         summary = telemetry.summarize(records)
         by = summary["by_name"]
         top = sorted(by, key=lambda k: -by[k]["total_s"])[:opts.top]
-        out = {"file": opts.file, "spans": summary["spans"],
+        out = {**source, "spans": summary["spans"],
                "events": summary["events"],
                "by_name": {k: by[k] for k in top}}
+        if opts.merge:
+            corrs = sorted({r["corr"] for r in records
+                            if isinstance(r, dict) and r.get("corr")})
+            out["workers"] = sorted({r.get("pid") for r in records
+                                     if isinstance(r, dict)
+                                     and r.get("ph") == "M"
+                                     and r.get("name")
+                                     == "process_name"})
+            out["correlations"] = corrs[:64]
         if opts.gaps:
             out["gaps"] = telemetry.gaps(records)
         if opts.export:
@@ -1095,10 +1124,58 @@ def trace_cmd() -> dict:
     return {"trace": {"add_opts": add_opts, "run": run}}
 
 
+def metrics_cmd() -> dict:
+    """``metrics [--merged]``: the OpenMetrics/Prometheus text
+    exposition OFFLINE from the store's durable series files
+    (store/telemetry/<host>-<pid>.series.jsonl — jepsen_tpu.series),
+    byte-compatible with what ``web.py /metrics`` serves live. Default:
+    one exposition per worker, each sample labeled ``worker=<key>``
+    (who counted what); ``--merged``: the cluster-merged view —
+    counters summed, histogram buckets summed, percentiles
+    conservative-max. ``--alerts`` appends the currently-firing alert
+    set as one JSON line after the exposition."""
+    def add_opts(p):
+        p.add_argument("--store", default="store",
+                       help="Store root (default ./store)")
+        p.add_argument("--merged", action="store_true", default=False,
+                       help="One cluster-merged exposition instead of "
+                            "per-worker samples")
+        p.add_argument("--alerts", action="store_true", default=False,
+                       help="Also print the firing alert set (JSON)")
+
+    def run(opts):
+        import json as _json
+
+        from . import alerts, series, telemetry
+
+        if opts.merged:
+            text = telemetry.openmetrics(
+                series.merged_latest(opts.store))
+        else:
+            parts = []
+            for key, frame in sorted(
+                    series.latest_frames(opts.store).items()):
+                parts.append(telemetry.openmetrics(
+                    frame.get("snap") or {}, labels={"worker": key}))
+            text = "".join(parts)
+        if not text:
+            print(f"# no series frames under "
+                  f"{series.telemetry_dir(opts.store)}")
+            return 1
+        print(text, end="")
+        if opts.alerts:
+            print(_json.dumps(
+                {"alerts": alerts.active_alerts(opts.store)},
+                default=str))
+        return 0
+
+    return {"metrics": {"add_opts": add_opts, "run": run}}
+
+
 def main(argv: Optional[Sequence[str]] = None) -> None:
     run_cli({**suite_cmd(), **serve_cmd(), **recheck_cmd(),
              **salvage_cmd(), **fuzz_cmd(), **fleet_cmd(),
-             **trace_cmd(), **watch_cmd()}, argv)
+             **trace_cmd(), **metrics_cmd(), **watch_cmd()}, argv)
 
 
 if __name__ == "__main__":

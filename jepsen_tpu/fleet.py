@@ -838,6 +838,17 @@ def fleet_worker(campaign_dir, worker_id: str, *,
     ws = _load_spec(cdir)
     ttl = float(ws.get("lease_ttl_s") or lease_ttl_s())
     chunks = _chunk_map(ws)
+    # The campaign id from the WORK SPEC becomes this worker process's
+    # correlation default: every unit span (and everything dispatched
+    # under it) names the campaign in a merged cluster trace.
+    prev_corr = telemetry.set_correlation(
+        ws.get("corr") or f"fleet:{ws.get('name', '?')}")
+    # Periodic registry frames into the shared STORE's series
+    # namespace (the campaign dir is store/<name>/fleet — frames
+    # belong to the store root, one ring per worker process).
+    from . import series as series_mod
+    swriter = series_mod.SeriesWriter(ws.get("store_base") or cdir) \
+        if series_mod.enabled() else None
     # Heterogeneous-host routing: JT_ROUTER_PROBE=1 measures this
     # host's backend rates once and persists them under its hostname
     # in the campaign dir; with or without the probe, the router
@@ -857,6 +868,24 @@ def fleet_worker(campaign_dir, worker_id: str, *,
     except ValueError:
         pass
 
+    try:
+        with telemetry.span("fleet.worker", worker=worker_id):
+            _fleet_worker_loop(cdir, ws, chunks, worker_id, ttl,
+                               router, stats, stop, test_sleep, seen,
+                               swriter)
+    finally:
+        telemetry.set_correlation(prev_corr)
+        if swriter is not None:
+            swriter.close(final_frame=True)
+    summary = {**stats, "router": router.summary(),
+               "telemetry": telemetry.counters_delta(
+                   tel_base, telemetry.snapshot())}
+    atomic_write_json(cdir / f"worker-{worker_id}.json", summary)
+    return summary
+
+
+def _fleet_worker_loop(cdir, ws, chunks, worker_id, ttl, router,
+                       stats, stop, test_sleep, seen, swriter) -> None:
     def chunk_done(units) -> bool:
         for u in units:
             u = int(u)
@@ -868,54 +897,49 @@ def fleet_worker(campaign_dir, worker_id: str, *,
             return False
         return True
 
-    with telemetry.span("fleet.worker", worker=worker_id):
-        while not (stop is not None and stop.is_set()):
-            claimed_any = False
-            for k, units in chunks.items():
-                if stop is not None and stop.is_set():
-                    break
-                if chunk_done(units):
-                    continue
-                gen = claim_chunk(cdir, k, units, worker_id, ttl)
-                if gen is None:
-                    continue
-                claimed_any = True
-                stats["chunks"] += 1
-                if gen > 0:
-                    stats["takeovers"] += 1
-                    log.info("worker %s took over chunk %d at "
-                             "generation %d (previous lease expired)",
-                             worker_id, k, gen)
-                hb = LeaseHeartbeat(cdir, k, units, worker_id, gen,
-                                    ttl).start()
-                try:
-                    finished = _process_chunk(
-                        cdir, ws, units, worker_id, hb, router, stats,
-                        stop, test_sleep)
-                finally:
-                    hb.stop()
-                if finished and not hb.lost.is_set():
-                    mark_done(cdir, k, units, worker_id, gen)
-                elif hb.lost.is_set():
-                    stats["abandoned"] += 1
-                    log.warning("worker %s lost chunk %d's lease "
-                                "mid-flight; abandoning it cleanly",
-                                worker_id, k)
-            if campaign_complete(cdir, ws, seen=seen):
+    while not (stop is not None and stop.is_set()):
+        if swriter is not None:
+            swriter.maybe_append()
+        claimed_any = False
+        for k, units in chunks.items():
+            if stop is not None and stop.is_set():
                 break
-            if not claimed_any:
-                # Everything left is leased to live workers: wait for
-                # them to finish — or for their heartbeats to lapse.
-                if stop is not None and stop.wait(
-                        min(1.0, ttl / 3.0)):
-                    break
-                if stop is None:
-                    time.sleep(min(1.0, ttl / 3.0))
-    summary = {**stats, "router": router.summary(),
-               "telemetry": telemetry.counters_delta(
-                   tel_base, telemetry.snapshot())}
-    atomic_write_json(cdir / f"worker-{worker_id}.json", summary)
-    return summary
+            if chunk_done(units):
+                continue
+            gen = claim_chunk(cdir, k, units, worker_id, ttl)
+            if gen is None:
+                continue
+            claimed_any = True
+            stats["chunks"] += 1
+            if gen > 0:
+                stats["takeovers"] += 1
+                log.info("worker %s took over chunk %d at "
+                         "generation %d (previous lease expired)",
+                         worker_id, k, gen)
+            hb = LeaseHeartbeat(cdir, k, units, worker_id, gen,
+                                ttl).start()
+            try:
+                finished = _process_chunk(
+                    cdir, ws, units, worker_id, hb, router, stats,
+                    stop, test_sleep)
+            finally:
+                hb.stop()
+            if finished and not hb.lost.is_set():
+                mark_done(cdir, k, units, worker_id, gen)
+            elif hb.lost.is_set():
+                stats["abandoned"] += 1
+                log.warning("worker %s lost chunk %d's lease "
+                            "mid-flight; abandoning it cleanly",
+                            worker_id, k)
+        if campaign_complete(cdir, ws, seen=seen):
+            break
+        if not claimed_any:
+            # Everything left is leased to live workers: wait for
+            # them to finish — or for their heartbeats to lapse.
+            if stop is not None and stop.wait(min(1.0, ttl / 3.0)):
+                break
+            if stop is None:
+                time.sleep(min(1.0, ttl / 3.0))
 
 
 def _process_chunk(cdir: Path, ws: dict, units, worker_id: str,
@@ -1406,8 +1430,14 @@ def _work_spec(name, kind, units, spec, model, synth, test, timestamps,
         # worker forfeits) vs lease traffic.
         lease_chunk = max(1, len(units or ())
                           // max(4 * max(workers, 1), 1))
+    created = time.time()
     return {
         "fleet": FLEET_MAGIC, "name": name, "kind": kind,
+        # The campaign's correlation id: every worker that joins this
+        # spec stamps its spans with it (telemetry.set_correlation),
+        # so a merged cluster trace groups all workers' unit spans
+        # under one flow (doc/observability.md).
+        "corr": f"fleet:{name}:{int(created)}",
         "model": model, "synth": synth,
         "units": [int(u) for u in (units or ())],
         "spec": (dataclasses.asdict(spec) if spec is not None
@@ -1420,7 +1450,7 @@ def _work_spec(name, kind, units, spec, model, synth, test, timestamps,
                              else lease_ttl_s()),
         "neighborhood": int(neighborhood),
         "max_witnesses": int(max_witnesses),
-        "created": time.time(),
+        "created": created,
     }
 
 

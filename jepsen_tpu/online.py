@@ -93,6 +93,8 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
+from . import alerts as alerts_mod
+from . import series as series_mod
 from . import telemetry
 from .history.core import index
 from .history.ops import FAIL, INVOKE, OK, Op
@@ -422,6 +424,18 @@ class OnlineTenant:
         if fv is not None:
             self.first_violation = fv
 
+    def corr_id(self) -> str:
+        """This tenant's correlation id: run key + writer INCARNATION
+        (the WAL segment's inode — the same identity the decided-
+        prefix journal and verdict staleness checks key on). Every
+        worker that ever serves this tenant — the original owner, a
+        takeover survivor — derives the same id from the shared file,
+        which is exactly what lets ``telemetry.merge_traces`` connect
+        their spans across processes (doc/observability.md)."""
+        if self.state.ino >= 0:
+            return f"{self.key}#{self.state.ino}"
+        return self.key
+
     def _verdict_stale(self, v: dict) -> bool:
         """A stored final verdict is stale when the WAL at this path
         is a different segment (inode) than the one it was computed
@@ -602,31 +616,34 @@ class OnlineTenant:
         if k < d.cfg.min_check_ops or k == self.checked_ops \
                 or k in self._decided:
             return
-        d._fire("encode")
-        history = checkable_prefix(self.ops)
-        d._fire("dispatch")
-        r, prov = d.engine.check(history, shed=shed)
-        verdict = r.get("valid")
-        bad = _bad_index(r)
-        if verdict in (True, False):
-            # Only explicit verdicts are DECIDED: an "unknown" is
-            # neither journaled (a restart should re-try it) nor
-            # latched — but checked_ops still advances, so this
-            # incarnation doesn't hot-loop the same undecidable
-            # prefix every poll.
-            if self.journal is not None:
-                self.journal.record([k], [verdict], [bad], [prov])
-            self._decided[k] = (bool(verdict), bad, prov)
-        self.checked_ops = k
-        self._widen_counted = False
-        self.stats["checks"] += 1
-        self.stats["host_checks" if prov == "online-host"
-                   else "device_checks"] += 1
-        self.last_check_t = time.monotonic()
-        d._count("checks")
-        d._count("host_checks" if prov == "online-host"
-                 else "device_checks")
-        self._note_verdict(verdict, bad, k, prov)
+        with telemetry.correlation_scope(self.corr_id()), \
+                telemetry.span("online.check", tenant=self.key,
+                               ops=k, shed=bool(shed)):
+            d._fire("encode")
+            history = checkable_prefix(self.ops)
+            d._fire("dispatch")
+            r, prov = d.engine.check(history, shed=shed)
+            verdict = r.get("valid")
+            bad = _bad_index(r)
+            if verdict in (True, False):
+                # Only explicit verdicts are DECIDED: an "unknown" is
+                # neither journaled (a restart should re-try it) nor
+                # latched — but checked_ops still advances, so this
+                # incarnation doesn't hot-loop the same undecidable
+                # prefix every poll.
+                if self.journal is not None:
+                    self.journal.record([k], [verdict], [bad], [prov])
+                self._decided[k] = (bool(verdict), bad, prov)
+            self.checked_ops = k
+            self._widen_counted = False
+            self.stats["checks"] += 1
+            self.stats["host_checks" if prov == "online-host"
+                       else "device_checks"] += 1
+            self.last_check_t = time.monotonic()
+            d._count("checks")
+            d._count("host_checks" if prov == "online-host"
+                     else "device_checks")
+            self._note_verdict(verdict, bad, k, prov)
 
     # --------------------------------------------------------- finalize
     def should_finalize(self) -> bool:
@@ -696,6 +713,12 @@ class OnlineTenant:
         d = self.daemon
         d._fire("encode")
         self._drain_tail()
+        with telemetry.correlation_scope(self.corr_id()), \
+                telemetry.span("online.finalize", tenant=self.key,
+                               ops=len(self.ops)):
+            self._finalize_inner(d)
+
+    def _finalize_inner(self, d) -> None:
         if self.state.header is None:
             # Killed before the header fsync: nothing salvageable
             # (Store.salvage raises "empty WAL" on the same file).
@@ -831,6 +854,14 @@ class OnlineDaemon:
                       "ingested_ops": 0,
                       "deferred_starvation_rescues": 0}
         self._t0 = time.monotonic()
+        # Cluster observability plane: periodic registry frames into
+        # this worker's series ring file plus the cadence-bounded SLO
+        # alert evaluator (doc/observability.md). Both are tick hooks
+        # that cost one monotonic compare when not due.
+        self._series = series_mod.SeriesWriter(self.store.base) \
+            if series_mod.enabled() else None
+        self._alerts = alerts_mod.AlertEvaluator(self.store.base) \
+            if alerts_mod.enabled() else None
 
     # ---------------------------------------------------------- helpers
     def _count(self, key: str, n: int = 1) -> None:
@@ -1001,6 +1032,10 @@ class OnlineDaemon:
         for t in sorted(self._active(), key=lambda t: -t.last_growth):
             self._service_check(t, level)
         self._persist_registry()
+        if self._series is not None:
+            self._series.maybe_append()
+        if self._alerts is not None:
+            self._alerts.maybe_eval()
         return level
 
     def _persist_registry(self) -> None:
@@ -1057,6 +1092,11 @@ class OnlineDaemon:
         for t in self.tenants.values():
             t.close()
         self._persist_registry()
+        if self._series is not None:
+            # The shutdown frame: the series' last word for this
+            # worker is its final counter state, not mid-flight.
+            self._series.close(final_frame=True)
+            self._series = None
 
 
 def watch_store(store: Optional[Store] = None, *, model=None,

@@ -532,6 +532,24 @@ def run(test: dict, analyze: bool = True) -> dict:
     test["wal"] = wal
 
     from contextlib import ExitStack
+    # Correlation id for the cluster trace plane: a stored run's spans
+    # carry its run dir (test-name/timestamp). Install only when no
+    # outer id exists — a fleet worker's campaign id, or run_seeds'
+    # campaign scope, outranks the per-run dir by design ("the id
+    # names the cluster-level unit of work", doc/observability.md).
+    _corr_prev, _corr_set = None, False
+    if store is not None and telemetry.correlation() is None:
+        d = Path(store.dir)
+        _corr_prev = telemetry.set_correlation(
+            f"run:{d.parent.name}/{d.name}")
+        _corr_set = True
+
+    def _restore_corr():
+        nonlocal _corr_set
+        if _corr_set:
+            telemetry.set_correlation(_corr_prev)
+            _corr_set = False
+
     run_sp = telemetry.begin("run.lifecycle",
                              name=test.get("name", "noname"),
                              seed=test.get("seed"))
@@ -593,12 +611,16 @@ def run(test: dict, analyze: bool = True) -> dict:
         if wal is not None:
             wal.close()
         run_sp.set(error=type(e).__name__).end()
+        _restore_corr()
         raise
     run_sp.end()
 
-    if not analyze:
-        return test
-    return analyze_run(test)
+    try:
+        if not analyze:
+            return test
+        return analyze_run(test)
+    finally:
+        _restore_corr()
 
 
 def analyze_run(test: dict) -> dict:
@@ -617,6 +639,12 @@ def analyze_run(test: dict) -> dict:
     test["results"] = results
     if store is not None:
         store.save_results(test["results"])
+        if store.store is not None:
+            # One durable series frame per completed run: plain runs
+            # participate in the cluster metrics time-series without
+            # any daemon cadence (jepsen_tpu.series).
+            from .series import append_frame
+            append_frame(store.store.base)
     wal = test.get("wal")
     if wal is not None:
         wal.stamp_phase("analyzed")
@@ -759,9 +787,17 @@ def run_seeds(builder: Callable[[int], dict], seeds,
     tests: List[dict] = []
     handles: List = []
     ckpt = None
+    corr_prev, corr_set = None, False
     try:
         for s in seeds:
             t = builder(s)
+            if not corr_set and telemetry.correlation() is None:
+                # One correlation id for the WHOLE campaign: seeds are
+                # the campaign's units, and a merged trace should group
+                # them (per-run ids stay for standalone runs).
+                corr_prev = telemetry.set_correlation(
+                    f"campaign:{t.get('name', 'noname')}")
+                corr_set = True
             if store:
                 from . import store as store_mod
                 root = store_root if store_root is not None \
@@ -861,6 +897,8 @@ def run_seeds(builder: Callable[[int], dict], seeds,
     finally:
         # Safety net for mid-batch crashes (stop_logging is idempotent;
         # an interrupted campaign keeps its checkpoint on disk).
+        if corr_set:
+            telemetry.set_correlation(corr_prev)
         if ckpt is not None:
             ckpt.close()
         for handle in handles:

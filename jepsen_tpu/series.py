@@ -1,0 +1,406 @@
+"""Durable metrics time-series: periodic registry frames per worker.
+
+The telemetry registry (PR 8) answers "what has THIS process counted
+so far"; cross-worker state was heartbeat-cadence JSON snapshots that
+overwrite themselves — nothing could answer "what was the cluster's
+shed rate over the last minute" after the fact. This module is the
+durable time axis: every worker (fleet worker, ``serve`` worker,
+``watch`` daemon, plain runs) appends periodic ``Registry.snapshot()``
+frames to its own ring file under
+
+    store/telemetry/<host>-<pid>.series.jsonl
+
+one JSON line per frame::
+
+    {"series": "JTSER1", "t": <wall s>, "host": ..., "pid": ...,
+     "worker": <host>-<pid>, "corr": <correlation id or null>,
+     "snap": {counters/gauges/histograms}}
+
+Write discipline is the WAL's (history/wal.py): whole-line appends,
+flush every frame, fsync group-committed (``JT_SERIES_FSYNC_MS``), so
+a reader tolerates exactly one torn tail and a crash loses at most
+one fsync window of frames. The file is a bounded ring: past
+``JT_SERIES_MAX_BYTES`` the writer compacts in place (tmp + rename,
+newest frames kept) — an always-on worker's series never grows
+unboundedly, and the newest window (what every query below reads) is
+what survives.
+
+Readers: ``read_series`` (torn-tail tolerant, the ``tolerant=True``
+discipline every log reader here shares), ``latest_frames`` (one
+newest frame per worker file), ``merged_latest`` (the cluster view —
+counters summed via ``telemetry.merge_counter_snapshots``, histograms
+via ``merge_histogram_snapshots``' conservative-max percentiles,
+numeric gauges summed), and the windowed queries the alert evaluator
+(``telemetry.alerts``) and ``bench --compare`` build on:
+``rate_over_window`` (counter delta / elapsed), ``gauge_last``, and
+``histogram_window`` (merged summary over a window's frames).
+
+Recording is on by default at a 5 s cadence (``JT_SERIES_INTERVAL_S``;
+``JT_SERIES=0`` disables) — the ≤5% overhead gate in tier-1
+(tests/test_obsplane.py) pins the cost of the append path itself.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from . import telemetry
+
+SERIES_MAGIC = "JTSER1"
+
+#: The store-level namespace (store/telemetry/) — series ring files
+#: plus the alert log live here; store.Store.tests() excludes it.
+#: Kept literal in both modules (store.TELEMETRY_DIR is the same
+#: string): store imports telemetry which imports this module — a
+#: store import here would cycle. tests/test_obsplane pins the two
+#: equal.
+TELEMETRY_DIR = "telemetry"
+
+SERIES_SUFFIX = ".series.jsonl"
+
+
+def enabled() -> bool:
+    """$JT_SERIES=0 disables periodic series recording (tests that
+    count exact filesystem traffic, stores on read-only media)."""
+    return os.environ.get("JT_SERIES", "1") != "0"
+
+
+def interval_s() -> float:
+    try:
+        return max(0.0, float(os.environ.get("JT_SERIES_INTERVAL_S",
+                                             "5")))
+    except ValueError:
+        return 5.0
+
+
+def max_bytes() -> int:
+    try:
+        return max(1 << 16, int(os.environ.get("JT_SERIES_MAX_BYTES",
+                                               str(4 << 20))))
+    except ValueError:
+        return 4 << 20
+
+
+def fsync_ms() -> float:
+    try:
+        return float(os.environ.get("JT_SERIES_FSYNC_MS", "1000"))
+    except ValueError:
+        return 1000.0
+
+
+def telemetry_dir(store_base) -> Path:
+    return Path(store_base) / TELEMETRY_DIR
+
+
+def worker_key(host: Optional[str] = None,
+               pid: Optional[int] = None) -> str:
+    host = host or socket.gethostname()
+    pid = os.getpid() if pid is None else int(pid)
+    safe = "".join(c if c.isalnum() or c in "-._" else "_"
+                   for c in host) or "unknown-host"
+    return f"{safe}-{pid}"
+
+
+def series_path(store_base, host: Optional[str] = None,
+                pid: Optional[int] = None) -> Path:
+    """This worker's ring file — one file PER (host, pid), never a
+    shared read-modify-write document (the router-rates / lease-file
+    rationale: concurrent workers must not race each other's tails)."""
+    return telemetry_dir(store_base) / (worker_key(host, pid)
+                                        + SERIES_SUFFIX)
+
+
+class SeriesWriter:
+    """One worker's periodic frame appender.
+
+    ``maybe_append()`` is the tick hook: free until ``interval_s`` has
+    elapsed since the last frame (one monotonic read), then one
+    snapshot + one whole-line append. ``append()`` forces a frame (run
+    completion, daemon shutdown). The writer owns compaction: when the
+    file passes ``max_bytes`` the newest frames are rewritten through
+    a tmp + atomic rename — readers never see a torn ring."""
+
+    def __init__(self, store_base, *,
+                 interval: Optional[float] = None,
+                 limit_bytes: Optional[int] = None,
+                 source=None):
+        self.path = series_path(store_base)
+        self.interval = interval_s() if interval is None \
+            else float(interval)
+        self.limit = max_bytes() if limit_bytes is None \
+            else int(limit_bytes)
+        self.source = source or telemetry.snapshot
+        self.frames_written = 0
+        self.compactions = 0
+        self._f = None
+        self._last = -1e18           # monotonic s of the last frame
+        self._last_sync = time.monotonic()
+
+    # ------------------------------------------------------- writing
+    def maybe_append(self, now: Optional[float] = None) -> bool:
+        """Append a frame iff the cadence is due. Returns True when a
+        frame landed — the cheap path is one monotonic read and a
+        compare, which is what lets every tick loop call this
+        unconditionally."""
+        now = time.monotonic() if now is None else now
+        if now - self._last < self.interval:
+            return False
+        return self.append(now=now)
+
+    def append(self, now: Optional[float] = None) -> bool:
+        """Append one frame unconditionally (still best-effort: series
+        recording is diagnostics — an unwritable store must never fail
+        the worker)."""
+        self._last = time.monotonic() if now is None else now
+        try:
+            snap = self.source()
+            frame = {"series": SERIES_MAGIC,
+                     "t": round(time.time(), 6),
+                     "host": socket.gethostname(), "pid": os.getpid(),
+                     "worker": worker_key(),
+                     "corr": telemetry.correlation(),
+                     "snap": snap}
+            line = json.dumps(frame, default=str) + "\n"
+            if self._f is None:
+                self.path.parent.mkdir(parents=True, exist_ok=True)
+                self._f = open(self.path, "a")
+            self._f.write(line)
+            self._f.flush()
+            self._maybe_fsync()
+            self.frames_written += 1
+            if self._f.tell() > self.limit:
+                self._compact()
+            return True
+        except Exception:
+            return False
+
+    def _maybe_fsync(self) -> None:
+        """Group-commit the frames (the WAL's discipline): fsync when
+        the window elapsed, bounding both the fsync rate and the
+        frames a crash can lose."""
+        win = fsync_ms()
+        nowm = time.monotonic()
+        if win <= 0 or (nowm - self._last_sync) * 1000.0 >= win:
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass
+            self._last_sync = nowm
+
+    def _compact(self) -> None:
+        """Ring bound: rewrite keeping the newest frames that fit half
+        the budget, tmp + atomic rename (readers tolerate the swap the
+        same way the WAL tailer tolerates rotation: a fresh full read
+        of a SMALLER file)."""
+        self._f.close()
+        self._f = None
+        frames = read_series(self.path)
+        keep: List[str] = []
+        budget = self.limit // 2
+        total = 0
+        for fr in reversed(frames):
+            line = json.dumps(fr, default=str) + "\n"
+            total += len(line)
+            if total > budget and keep:
+                break
+            keep.append(line)
+        tmp = self.path.with_name(self.path.name
+                                  + f".compact.{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.writelines(reversed(keep))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self.compactions += 1
+        self._f = open(self.path, "a")
+
+    def close(self, final_frame: bool = False) -> None:
+        if final_frame:
+            self.append()
+        if self._f is not None:
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass
+            try:
+                self._f.close()
+            except Exception:
+                pass
+            self._f = None
+
+
+def append_frame(store_base) -> bool:
+    """One-shot frame append (plain runs at completion) — a throwaway
+    writer with no cadence state. Respects the enable switch."""
+    if not enabled():
+        return False
+    w = SeriesWriter(store_base, interval=0)
+    try:
+        return w.append()
+    finally:
+        w.close()
+
+
+# ------------------------------------------------------------ reading
+
+def read_magic_jsonl(path, magic_key: str, magic: str) -> List[dict]:
+    """The shared tolerant log reader (one copy of the discipline the
+    WAL/journal/trace readers all follow): whole lines only — a torn
+    final line (the writer's in-flight append or a kill mid-write) or
+    any corrupt line ends the read at the last good prefix — and only
+    records carrying ``magic_key == magic`` count (foreign files
+    answer [], never raise). The series files and the alert log both
+    read through here."""
+    out: List[dict] = []
+    try:
+        with open(path, "rb") as f:
+            for line in f:
+                if not line.endswith(b"\n"):
+                    break
+                try:
+                    fr = json.loads(line)
+                except Exception:
+                    break
+                if isinstance(fr, dict) and fr.get(magic_key) == magic:
+                    out.append(fr)
+    except OSError:
+        pass
+    return out
+
+
+def read_series(path) -> List[dict]:
+    """All whole frames of one ring file, oldest first (torn-tail
+    tolerant — ``read_magic_jsonl``)."""
+    return read_magic_jsonl(path, "series", SERIES_MAGIC)
+
+
+def series_files(store_base) -> List[Path]:
+    d = telemetry_dir(store_base)
+    if not d.exists():
+        return []
+    return sorted(d.glob(f"*{SERIES_SUFFIX}"))
+
+
+def all_series(store_base) -> Dict[str, List[dict]]:
+    """{worker_key: frames} for every ring file in the store."""
+    out: Dict[str, List[dict]] = {}
+    for p in series_files(store_base):
+        frames = read_series(p)
+        if frames:
+            key = p.name[:-len(SERIES_SUFFIX)]
+            out[key] = frames
+    return out
+
+
+def latest_frames(store_base) -> Dict[str, dict]:
+    """The newest frame per worker — the cluster's last-known state
+    (what ``merged_latest`` and the offline exposition fold)."""
+    return {k: frames[-1]
+            for k, frames in all_series(store_base).items()}
+
+
+def merged_latest(store_base, *, max_age_s: float = 0.0,
+                  exclude=()) -> dict:
+    """Cluster-merged snapshot from every worker's newest frame:
+    counters summed, histograms merged with conservative-max
+    percentiles (``telemetry.merge_histogram_snapshots``), numeric
+    gauges summed. ``max_age_s`` > 0 drops frames older than that — a
+    long-dead worker's final counters should not haunt a live scrape
+    forever (0 keeps everything: offline analysis wants the dead
+    workers too). ``exclude`` drops named worker keys — the live
+    ``/metrics?merged=1`` scrape excludes its OWN key before folding
+    its live registry in, or the serving process would count twice
+    (once from its durable frame, once live)."""
+    now = time.time()
+    snaps = []
+    for key, fr in latest_frames(store_base).items():
+        if key in exclude:
+            continue
+        if max_age_s > 0 and now - float(fr.get("t") or 0) > max_age_s:
+            continue
+        snaps.append(fr.get("snap") or {})
+    out: dict = {}
+    counters = telemetry.merge_counter_snapshots(snaps)
+    if counters:
+        out["counters"] = {k: counters[k] for k in sorted(counters)}
+    gauges = telemetry.merge_gauge_snapshots(snaps)
+    if gauges:
+        out["gauges"] = {k: gauges[k] for k in sorted(gauges)}
+    hists = telemetry.merge_histogram_snapshots(snaps)
+    if hists:
+        out["histograms"] = {k: hists[k] for k in sorted(hists)}
+    return out
+
+
+# ---------------------------------------------------- windowed queries
+
+def _window(frames: List[dict], window_s: float,
+            now: Optional[float] = None) -> List[dict]:
+    now = time.time() if now is None else now
+    lo = now - window_s
+    return [fr for fr in frames if float(fr.get("t") or 0) >= lo]
+
+
+def rate_over_window(frames: List[dict], counter: str,
+                     window_s: float,
+                     now: Optional[float] = None) -> Optional[float]:
+    """Counter rate (units/s) over the trailing window of ONE worker's
+    frames: (last - first) / elapsed across the window's frames. None
+    when fewer than two frames land in the window (no rate is honest —
+    0.0 would claim quiescence on one sample). A counter absent from a
+    frame reads 0 (registries only materialize touched keys)."""
+    win = _window(frames, window_s, now)
+    if len(win) < 2:
+        return None
+    t0, t1 = float(win[0]["t"]), float(win[-1]["t"])
+    if t1 <= t0:
+        return None
+
+    def val(fr):
+        v = ((fr.get("snap") or {}).get("counters") or {}) \
+            .get(counter, 0)
+        return float(v) if isinstance(v, (int, float)) else 0.0
+
+    return max(0.0, (val(win[-1]) - val(win[0])) / (t1 - t0))
+
+
+def cluster_rate(store_base, counter: str, window_s: float,
+                 now: Optional[float] = None) -> Optional[float]:
+    """Sum of per-worker rates over the window — the cluster-wide rate
+    the alert rules threshold on. None when NO worker had a computable
+    rate (distinct from a true 0.0 across quiet workers)."""
+    rates = [r for r in
+             (rate_over_window(frames, counter, window_s, now)
+              for frames in all_series(store_base).values())
+             if r is not None]
+    return sum(rates) if rates else None
+
+
+def gauge_last(frames: List[dict], name: str):
+    """The newest frame's value for a gauge (None when never set)."""
+    for fr in reversed(frames):
+        g = ((fr.get("snap") or {}).get("gauges") or {})
+        if name in g:
+            return g[name]
+    return None
+
+
+def histogram_window(frames: List[dict], name: str, window_s: float,
+                     now: Optional[float] = None) -> Optional[dict]:
+    """Merged histogram summary over the window's frames (same
+    conservative-max percentile semantics as the cross-worker merge —
+    the right direction for an SLO breach signal). None when the
+    window holds no observations of ``name``."""
+    win = _window(frames, window_s, now)
+    merged = telemetry.merge_histogram_snapshots(
+        [fr.get("snap") or {} for fr in win[-1:]])
+    # Histograms are process-cumulative: the newest frame in the
+    # window IS the window's distribution upper bound; merging every
+    # frame would multiply-count. Cross-frame merge only applies
+    # across WORKERS, which merged_latest owns.
+    return merged.get(name)

@@ -265,6 +265,11 @@ class ServiceWorker(OnlineDaemon):
         self._advice_cooldown_s = max(self.ttl, 5.0)
         self._budget: dict = load_budget(self.store)
         self._peers: Dict[str, dict] = {}
+        # The base daemon's alert evaluator gains the cluster budget
+        # (the ttfv SLO rule's threshold) and this worker's name.
+        if self._alerts is not None:
+            self._alerts.budget_fn = lambda: self._budget
+            self._alerts.log.worker_id = worker_id
 
     # ------------------------------------------------------ capabilities
     def _caps(self) -> dict:
@@ -329,6 +334,19 @@ class ServiceWorker(OnlineDaemon):
         est = est if est is not None else (0, 0)
         self._est_cache[key] = (est, now)
         return est
+
+    def _tenant_corr(self, name: str, ts: str) -> str:
+        """The tenant's correlation id — run key + WAL segment inode
+        (OnlineTenant.corr_id's formula, computed before the tenant
+        object exists): stamped into the lease record and onto the
+        takeover span, so a killed owner's tenant spans and the
+        survivor's takeover connect in a merged trace."""
+        try:
+            ino = os.stat(self.store.run_dir(name, ts)
+                          / WAL_FILE).st_ino
+            return f"{name}/{ts}#{ino}"
+        except OSError:
+            return f"{name}/{ts}"
 
     def _jitter(self, key: tuple) -> float:
         """Deterministic per-(worker, tenant) takeover stagger in
@@ -455,7 +473,9 @@ class ServiceWorker(OnlineDaemon):
                 # peer).
                 self._svc_count("claim_budget_deferred")
                 continue
-            gen = claim_lease(lpath, {"run": f"{name}/{ts}"},
+            corr = self._tenant_corr(name, ts)
+            gen = claim_lease(lpath, {"run": f"{name}/{ts}",
+                                      "corr": corr},
                               self.worker_id, self.ttl)
             if gen is None:
                 continue
@@ -465,8 +485,14 @@ class ServiceWorker(OnlineDaemon):
             t.lease_gen = gen
             self.tenants[key] = t
             with self._hb_lock:
+                # corr cached at claim time: renewals re-stamp it
+                # into the lease record without re-statting the WAL
+                # every sweep (the segment identity is fixed for the
+                # lease's whole life — a rotation drops the tenant
+                # through the verdict/journal staleness paths anyway).
                 self.owned[key] = {"gen": gen, "path": lpath,
-                                   "renewed": time.monotonic()}
+                                   "renewed": time.monotonic(),
+                                   "corr": corr}
             self._svc_count("claims")
             if t.status != "done":
                 self._count("admitted")
@@ -483,6 +509,7 @@ class ServiceWorker(OnlineDaemon):
                 self._svc_count("handoffs")
             elif gen > 0:
                 self._svc_count("takeovers")
+                lat = None
                 if hb > 0:
                     # Orphan latency: how long the tenant sat between
                     # its old owner's lease expiring and this re-claim
@@ -491,6 +518,18 @@ class ServiceWorker(OnlineDaemon):
                     self.takeover_latencies.append(round(lat, 4))
                     telemetry.REGISTRY.histogram(
                         "service.takeover_s").observe(lat)
+                # The takeover SPAN carries the tenant's correlation
+                # id: in a merged cluster trace the dead owner's
+                # check spans for this tenant and this survivor's
+                # takeover share one id (the r13 acceptance artifact).
+                with telemetry.correlation_scope(corr), \
+                        telemetry.span("service.takeover",
+                                       tenant=f"{name}/{ts}",
+                                       gen=gen,
+                                       worker=self.worker_id,
+                                       orphan_s=lat):
+                    telemetry.event("service.takeover.claimed",
+                                    tenant=f"{name}/{ts}", gen=gen)
                 log.info("worker %s took over tenant %s/%s at "
                          "generation %d", self.worker_id, name, ts,
                          gen)
@@ -559,7 +598,8 @@ class ServiceWorker(OnlineDaemon):
                         if key in self._hb_lost:
                             continue
                         if renew_lease(lease["path"],
-                                       {"run": f"{key[0]}/{key[1]}"},
+                                       {"run": f"{key[0]}/{key[1]}",
+                                        "corr": lease.get("corr")},
                                        self.worker_id, lease["gen"],
                                        ttl=self.ttl):
                             lease["renewed"] = time.monotonic()
@@ -577,7 +617,8 @@ class ServiceWorker(OnlineDaemon):
         with self._hb_lock:
             for key, lease in list(self.owned.items()):
                 t = self.tenants.get(key)
-                extra = {"run": f"{key[0]}/{key[1]}"}
+                extra = {"run": f"{key[0]}/{key[1]}",
+                         "corr": lease.get("corr")}
                 due = nowm - lease["renewed"] >= self.ttl / 3.0
                 lost = key in self._hb_lost
                 if not lost and due:
@@ -645,7 +686,8 @@ class ServiceWorker(OnlineDaemon):
                 t = self.tenants.get(key)
                 if t is not None and t.status == "done":
                     mark_lease_done(lease["path"],
-                                    {"run": f"{key[0]}/{key[1]}"},
+                                    {"run": f"{key[0]}/{key[1]}",
+                                     "corr": lease.get("corr")},
                                     self.worker_id, lease["gen"])
                     del self.owned[key]
 

@@ -87,6 +87,12 @@ SERVICE_ADVICE = "scale-advice.json"
 SERVICE_TENANTS_DIR = "tenants"
 SERVICE_WORKERS_DIR = "workers"
 
+# Cluster observability namespace (jepsen_tpu.series / .alerts): the
+# per-worker metrics time-series ring files and the durable alert log
+# live under store/telemetry/ — diagnostics state, never a test
+# (tests() excludes the whole directory, like service/).
+TELEMETRY_DIR = "telemetry"
+
 
 class CampaignMismatch(ValueError):
     """An explicit campaign resume named a checkpoint belonging to a
@@ -319,7 +325,8 @@ class Store:
             return out
         for name_dir in sorted(self.base.iterdir()):
             if (not name_dir.is_dir() or name_dir.is_symlink()
-                    or name_dir.name in ("latest", SERVICE_DIR)):
+                    or name_dir.name in ("latest", SERVICE_DIR,
+                                         TELEMETRY_DIR)):
                 continue
             runs = [d.name for d in sorted(name_dir.iterdir())
                     if d.is_dir() and not d.is_symlink()
@@ -432,6 +439,12 @@ class Store:
         leases, worker registry, scale advice — all shared-filesystem
         coordination, never runs."""
         return self.base / SERVICE_DIR
+
+    def telemetry_dir(self) -> Path:
+        """The cluster observability namespace (store/telemetry/):
+        per-worker series ring files + the alert log (jepsen_tpu
+        .series / .alerts, doc/observability.md)."""
+        return self.base / TELEMETRY_DIR
 
     def service_budget_path(self) -> Path:
         return self.service_dir() / SERVICE_BUDGET

@@ -374,19 +374,33 @@ def register_test(**opts) -> dict:
 
 class SetsClient(ServiceClient):
     """Blind adds + one final whole-set read over /set/jepsen
-    (sets.clj:103-133's insert/select)."""
+    (sets.clj:103-133's insert/select).
+
+    The FINAL read (``op["final"]``) retries transport faults under
+    the shared final-read deadline (local_common
+    .final_read_deadline_s — scaled from the test's own cadence and
+    timeout knobs, not a fixed sleep): it runs in the post-time-limit
+    final phase, possibly right after a restart-nemesis kill, and the
+    verdict hinges on it landing — one connection-refused during
+    daemon startup must not turn the whole run into "Set was never
+    read"."""
 
     def invoke(self, test, op):
         f = op["f"]
+
+        def read_once():
+            r = self._req("GET", "/set/jepsen")
+            return {**op, "type": "ok",
+                    "value": [int(v) for v in r["vs"]]}
 
         def body():
             if f == "add":
                 self._req("POST", "/set/jepsen", {"v": op["value"]})
                 return {**op, "type": "ok"}
             if f == "read":
-                r = self._req("GET", "/set/jepsen")
-                return {**op, "type": "ok",
-                        "value": [int(v) for v in r["vs"]]}
+                if not op.get("final"):
+                    return read_once()
+                return self.retrying(test, read_once)
             raise ValueError(f"unknown op {f}")
 
         return self.guarded(op, body, mutating=f == "add")
@@ -394,14 +408,23 @@ class SetsClient(ServiceClient):
 
 def sets_workload(opts: dict) -> dict:
     """Sequential-int adds, then a final read, checked by the cockroach
-    sets fold (lost/unexpected/duplicate/revived, sets.clj:21-101)."""
+    sets fold (lost/unexpected/duplicate/revived, sets.clj:21-101).
+
+    The final read rides the ``final_generator`` seam (local_common
+    service_test / etcd._with_nemesis): it runs AFTER the time-limited
+    main phase, outside the budget, so a slow host that stretches the
+    add phase past the limit still reads the set — the checker's
+    "Set was never read" unknown is reserved for genuinely read-less
+    histories, not scheduler weather (the tier-1 deflake)."""
     from ..ops.folds import crdb_set_checker_tpu
     n_ops = opts.get("n_ops", 150)
     adds = g.seq({"type": "invoke", "f": "add", "value": i}
                  for i in itertools.count())
     main = g.limit(n_ops, g.stagger(1 / 100, adds))
-    final = g.once({"type": "invoke", "f": "read", "value": None})
-    return {"generator": g.phases(main, final),
+    final = g.once({"type": "invoke", "f": "read", "value": None,
+                    "final": True})
+    return {"generator": main,
+            "final_generator": final,
             "checker": crdb_set_checker_tpu(),
             "model": None}
 

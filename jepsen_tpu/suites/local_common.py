@@ -33,11 +33,43 @@ from .etcd import (CasdDB, _casd_pauser, _casd_restarter, _with_nemesis,
                    derive_concurrency)
 
 
+def final_read_deadline_s(test) -> float:
+    """Retry budget for a workload's VERDICT-BEARING final reads
+    (sets / dirty-read strong reads): they run in the post-time-limit
+    final phase, possibly while a restart nemesis still has the
+    daemon down, and a fast-failing connection error there turns the
+    whole run into a "never read" unknown. Scaled from the test's OWN
+    knobs — several nemesis cycles plus several client timeouts — not
+    a fixed sleep, so slow hosts that stretch every phase stretch the
+    deadline with it (the r13 deflake discipline)."""
+    cadence = float(test.get("nemesis_cadence") or 1.0)
+    timeout = float(test.get("client_timeout") or 0.5)
+    return max(5.0, 4 * cadence + 10 * timeout)
+
+
 class ServiceClient(Client):
     """Base HTTP client for casd's coordination endpoints with the
     etcd-suite error discipline (etcd.clj:101-136): timeouts and
     mid-flight resets on mutating ops are :info (may have applied),
-    definite rejections and read faults are :fail."""
+    definite rejections and read faults are :fail.
+
+    ``retrying(test, body)`` runs a read body under the
+    final-read-deadline retry loop: transport faults retry until the
+    deadline (an HTTPError is a real server answer and propagates) —
+    the final-phase read primitive."""
+
+    def retrying(self, test, body):
+        import time as _time
+        deadline = _time.monotonic() + final_read_deadline_s(test)
+        while True:
+            try:
+                return body()
+            except urllib.error.HTTPError:
+                raise               # a real server answer
+            except (ConnectionError, OSError, urllib.error.URLError):
+                if _time.monotonic() > deadline:
+                    raise
+                _time.sleep(0.05)
 
     def __init__(self, timeout: float = 0.5):
         self.timeout = timeout

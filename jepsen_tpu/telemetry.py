@@ -49,14 +49,42 @@ Metric naming scheme (doc/observability.md): dotted
 deterministic: keys sort, floats round, and two snapshots of the same
 state compare equal — ``store.save_results`` merges one canonical
 ``telemetry`` block into ``results.json`` from it.
+
+The cluster observability plane (doc/observability.md "The cluster
+plane") builds three layers on this spine:
+
+  * **correlation ids** — every span/event record carries the active
+    correlation id (``corr``): the campaign id for fleet units, the
+    tenant key + writer incarnation for online/service tenants, the
+    run dir for plain runs. A process-wide default
+    (``set_correlation`` / $JT_CORR, inherited by spawned workers)
+    plus a thread-local override (``correlation_scope``) mean a child
+    worker's spans inherit the id that names the cluster-level unit
+    of work, so ``merge_traces`` can lay N workers' traces on one
+    timeline and draw flow arrows between the spans that belong to
+    the same tenant/campaign.
+  * **OpenMetrics export** — ``openmetrics(snapshot)`` renders any
+    registry snapshot (live or series-merged) in the Prometheus text
+    exposition format; ``web.py /metrics`` and ``jepsen-tpu metrics``
+    serve it. Histograms carry real cumulative ``le`` buckets
+    (maintained incrementally in ``observe`` — the reservoir only
+    feeds p50/p99), so a scrape is a first-class histogram, not a
+    summary impostor.
+  * **series / alerts** — ``telemetry.series`` (durable per-worker
+    snapshot frames under ``store/telemetry/``) and
+    ``telemetry.alerts`` (the SLO burn-rate evaluator over them) are
+    sibling modules re-exported here.
 """
 from __future__ import annotations
 
+import bisect
 import json
 import os
 import threading
 import time
 from collections import deque
+from contextlib import contextmanager
+from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence
 
 # ------------------------------------------------------------- config
@@ -76,6 +104,55 @@ _TLS = threading.local()
 _IDS = iter(range(1, 1 << 62)).__next__
 _ID_LOCK = threading.Lock()
 
+# Correlation id: the cluster-level unit of work this process's (or
+# this thread's) spans belong to. Process default inherits $JT_CORR so
+# spawned fleet/service workers carry their parent's campaign id
+# without any code in between; a thread-local stack overrides it for
+# per-tenant scopes inside one multi-tenant daemon.
+_CORR: Optional[str] = os.environ.get("JT_CORR") or None
+
+# Per-sink wall-clock anchor: the first record appended to a JSONL
+# sink additionally carries ``wall_s`` (time.time() at emit), so
+# merge_traces can align N processes' monotonic-relative timestamps
+# onto one wall-clock axis. Reset whenever the sink is reconfigured.
+_SINK_ANCHORED = False
+
+
+def set_correlation(cid: Optional[str]) -> Optional[str]:
+    """Set the PROCESS-default correlation id (None clears). Returns
+    the previous value so callers can restore it (runtime.run does:
+    the run-dir id must not leak past the run, and must not clobber a
+    campaign id a fleet worker already installed)."""
+    global _CORR
+    prev = _CORR
+    _CORR = cid
+    return prev
+
+
+def correlation() -> Optional[str]:
+    """The active correlation id: the innermost ``correlation_scope``
+    on THIS thread, else the process default."""
+    stack = getattr(_TLS, "corr", None)
+    if stack:
+        return stack[-1]
+    return _CORR
+
+
+@contextmanager
+def correlation_scope(cid: Optional[str]):
+    """Thread-local correlation override — the per-tenant scope a
+    multi-tenant daemon wraps around one tenant's check/finalize so
+    every span underneath (encode, dispatch, decode...) inherits the
+    tenant's id while a sibling tenant's spans carry its own."""
+    stack = getattr(_TLS, "corr", None)
+    if stack is None:
+        stack = _TLS.corr = []
+    stack.append(cid)
+    try:
+        yield
+    finally:
+        stack.pop()
+
 
 def _next_id() -> int:
     with _ID_LOCK:
@@ -94,8 +171,10 @@ def configure(trace=None, ring: Optional[int] = None) -> None:
     path (recorder + JSONL sink), False/None/"0" (off), or "env" to
     re-read $JT_TRACE. Reconfiguring swaps in a fresh ring buffer and
     closes any open sink — the test/bench seam."""
-    global _ENABLED, _SINK_PATH, _SINK, _RING, _CONFIGURED
+    global _ENABLED, _SINK_PATH, _SINK, _RING, _CONFIGURED, \
+        _SINK_ANCHORED
     with _CONF_LOCK:
+        _SINK_ANCHORED = False
         if trace == "env":
             trace = os.environ.get("JT_TRACE")
             if trace in (None, "", "0"):
@@ -136,8 +215,10 @@ def enabled() -> bool:
 def _emit(rec: dict) -> None:
     """Record one completed span/event: ring buffer always, sink when
     configured. Sink writes are whole-line appends under the config
-    lock — records from retire/prewarm threads never interleave."""
-    global _SINK
+    lock — records from retire/prewarm threads never interleave. The
+    first record a sink sees is additionally stamped with ``wall_s``
+    (the merge_traces cross-process clock anchor)."""
+    global _SINK, _SINK_ANCHORED
     _RING.append(rec)
     if _SINK_PATH is None:
         return
@@ -145,6 +226,16 @@ def _emit(rec: dict) -> None:
         try:
             if _SINK is None:
                 _SINK = open(_SINK_PATH, "a")
+            if not _SINK_ANCHORED:
+                # wall_s names the wall-clock instant whose trace-
+                # relative coordinate is wall_ts (NOT this record's
+                # ts, which is its span's start): both are sampled at
+                # the same emit instant, so the pair is skew-free.
+                rec = {**rec, "wall_s": round(time.time(), 6),
+                       "wall_ts": (time.monotonic_ns() - _EPOCH_NS)
+                       / 1e3,
+                       "pid": os.getpid()}
+                _SINK_ANCHORED = True
             _SINK.write(json.dumps(rec, default=str) + "\n")
             _SINK.flush()
         except Exception:
@@ -170,7 +261,8 @@ class Span:
     completes it and emits the record. Attribute updates before end
     ride ``set(**attrs)`` (e.g. a count only known at the end)."""
 
-    __slots__ = ("name", "cat", "t0", "attrs", "sid", "parent", "_done")
+    __slots__ = ("name", "cat", "t0", "attrs", "sid", "parent", "corr",
+                 "_done")
 
     def __init__(self, name: str, cat: str, attrs: Optional[dict],
                  parent: Optional[int]):
@@ -180,6 +272,9 @@ class Span:
         self.attrs = attrs
         self.sid = _next_id()
         self.parent = parent
+        # Captured at creation: end() may run after the enclosing
+        # correlation_scope already popped.
+        self.corr = correlation()
         self._done = False
 
     def set(self, **attrs) -> "Span":
@@ -205,6 +300,8 @@ class Span:
                "id": self.sid}
         if self.parent is not None:
             rec["parent"] = self.parent
+        if self.corr is not None:
+            rec["corr"] = self.corr
         if self.attrs:
             rec["args"] = self.attrs
         _emit(rec)
@@ -272,6 +369,9 @@ def event(name: str, /, cat: str = "event", **attrs) -> None:
     rec = {"ph": "i", "name": name, "cat": cat,
            "ts": (time.monotonic_ns() - _EPOCH_NS) / 1e3,
            "tid": t.ident, "tname": t.name}
+    corr = correlation()
+    if corr is not None:
+        rec["corr"] = corr
     if attrs:
         rec["args"] = attrs
     _emit(rec)
@@ -293,25 +393,50 @@ def reset() -> None:
 
 def export_chrome(path, records: Optional[Sequence[dict]] = None) -> int:
     """Write records (default: the flight recorder) as a Chrome-trace /
-    Perfetto ``trace.json``. Returns the number of trace events."""
+    Perfetto ``trace.json``. Returns the number of trace events.
+
+    Accepts both raw single-process records and ``merge_traces``
+    output: records may carry their own ``pid`` (per-worker process
+    lanes), ``"M"`` metadata records (process/thread names) pass
+    through, and flow records (``ph`` s/t/f — the correlation-id
+    arrows) keep their binding id. Malformed records — an unclosed
+    span a ring wrap orphaned, a torn line's partial dict — degrade to
+    defaults; an export must never crash on its input."""
     recs = list(records) if records is not None else spans()
     pid = os.getpid()
     evs = []
-    tnames = {}
+    tnames: Dict[tuple, str] = {}
     for r in recs:
+        if not isinstance(r, dict):
+            continue
+        ph = r.get("ph", "X")
+        rpid = r.get("pid", pid)
+        if ph == "M":
+            evs.append({"name": r.get("name", "?"), "ph": "M",
+                        "pid": rpid, "tid": r.get("tid", 0),
+                        "args": r.get("args") or {}})
+            continue
+        args = dict(r.get("args") or {})
+        if r.get("corr") is not None:
+            args.setdefault("corr", r["corr"])
         ev = {"name": r.get("name", "?"), "cat": r.get("cat", "host"),
-              "ph": r.get("ph", "X"), "ts": r.get("ts", 0.0),
-              "pid": pid, "tid": r.get("tid", 0),
-              "args": r.get("args") or {}}
-        if r.get("ph", "X") == "X":
+              "ph": ph, "ts": r.get("ts", 0.0),
+              "pid": rpid, "tid": r.get("tid", 0),
+              "args": args}
+        if ph == "X":
             ev["dur"] = r.get("dur", 0.0)
+        elif ph in ("s", "t", "f"):
+            ev["id"] = r.get("id", 0)
+            if ph == "f":
+                ev["bp"] = "e"         # bind to the enclosing slice
         else:
             ev["s"] = "t"              # thread-scoped instant
         evs.append(ev)
-        if r.get("tname") and r.get("tid") not in tnames:
-            tnames[r["tid"]] = r["tname"]
-    for tid, tname in tnames.items():
-        evs.append({"name": "thread_name", "ph": "M", "pid": pid,
+        key = (rpid, r.get("tid"))
+        if r.get("tname") and key not in tnames:
+            tnames[key] = r["tname"]
+    for (rpid, tid), tname in tnames.items():
+        evs.append({"name": "thread_name", "ph": "M", "pid": rpid,
                     "tid": tid, "args": {"name": tname}})
     with open(path, "w") as f:
         # default=str matches the JSONL sink's _emit: attrs may carry
@@ -337,6 +462,105 @@ def read_trace(path) -> List[dict]:
     return out
 
 
+def merge_traces(paths: Sequence) -> List[dict]:
+    """Fuse N per-worker JSONL traces into ONE record list on a
+    common timeline — the cross-worker correlation view
+    (``jepsen-tpu trace --merge DIR``).
+
+    Each worker's timestamps are monotonic-relative to its own
+    process epoch; the sink's first record carries a (wall_s,
+    wall_ts) anchor pair sampled at one instant, so every file's
+    records shift onto a shared wall-clock axis (a file with no
+    anchor — an old-format sink — keeps its relative times). Every
+    record gets the worker's ``pid`` lane (the sink-stamped pid when
+    present, else a per-file ordinal) plus a ``process_name``
+    metadata record naming the source file, so Chrome/Perfetto
+    renders one lane per worker. Records sharing a correlation id
+    across DIFFERENT workers additionally grow flow events (ph
+    s/t/f, one chain per corr id): the takeover arrows — a killed
+    worker's tenant spans connect to the survivor's.
+    """
+    def _anchor(r):
+        """(origin-µs, pid) of an anchor record, or None. A sink can
+        hold SEVERAL anchors: a restarted worker reusing the same
+        JT_TRACE path appends a fresh anchor (configure resets
+        _SINK_ANCHORED) with a new monotonic epoch and pid — each
+        incarnation's records must shift by ITS anchor, not the first
+        boot's, or they render hours off in a dead pid's lane."""
+        if "wall_s" not in r or "wall_ts" not in r:
+            return None
+        try:
+            return (float(r["wall_s"]) * 1e6 - float(r["wall_ts"]),
+                    r.get("pid"))
+        except (TypeError, ValueError):
+            return None
+
+    per_file: List[List[dict]] = [read_trace(p) for p in paths]
+    # Re-base onto the earliest anchored origin so merged timestamps
+    # start near zero (Chrome renders huge absolute µs poorly).
+    known = [a[0] for recs in per_file
+             for a in (_anchor(r) for r in recs) if a is not None]
+    base = min(known) if known else 0.0
+    merged: List[dict] = []
+    by_corr: Dict[str, List[dict]] = {}
+    for i, (p, recs) in enumerate(zip(paths, per_file)):
+        first = next((a for a in (_anchor(r) for r in recs)
+                      if a is not None), None)
+        # Segment state: records before the first anchor inherit it
+        # (the anchor is the file's first record by construction, but
+        # stay tolerant of hand-edited sinks).
+        origin = first[0] if first else None
+        pid = first[1] if first and isinstance(first[1], int) \
+            else i + 1
+        named: set = set()
+        for r in recs:
+            a = _anchor(r)
+            if a is not None:
+                origin = a[0]
+                pid = a[1] if isinstance(a[1], int) else pid
+            if pid not in named:
+                named.add(pid)
+                merged.append({"ph": "M", "name": "process_name",
+                               "pid": pid, "tid": 0,
+                               "args": {"name": Path(p).stem}})
+            r = dict(r)
+            r["pid"] = pid
+            shift = (origin - base) if origin is not None else 0.0
+            try:
+                r["ts"] = float(r.get("ts", 0.0)) + shift
+            except (TypeError, ValueError):
+                r["ts"] = shift
+            merged.append(r)
+            corr = r.get("corr")
+            if corr is not None and r.get("ph", "X") in ("X", "i"):
+                by_corr.setdefault(str(corr), []).append(r)
+    # Flow arrows only where a corr id actually crosses workers — an
+    # id confined to one process is already one lane.
+    for corr, recs in sorted(by_corr.items()):
+        if len({r["pid"] for r in recs}) < 2:
+            continue
+        recs.sort(key=lambda r: r.get("ts", 0.0))
+        fid = _flow_id(corr)
+        for j, r in enumerate(recs):
+            ph = "s" if j == 0 else ("f" if j == len(recs) - 1
+                                     else "t")
+            merged.append({"ph": ph, "name": f"corr:{corr}",
+                           "cat": "flow", "id": fid,
+                           # Nudge inside the slice so the enclosing-
+                           # slice binding holds for zero-offset spans.
+                           "ts": float(r.get("ts", 0.0)) + 0.01,
+                           "pid": r["pid"], "tid": r.get("tid", 0)})
+    merged.sort(key=lambda r: (r.get("ph") != "M",
+                               r.get("ts", 0.0)))
+    return merged
+
+
+def _flow_id(corr: str) -> int:
+    import hashlib
+    return int.from_bytes(
+        hashlib.sha256(corr.encode()).digest()[:4], "big")
+
+
 def summarize(records: Optional[Sequence[dict]] = None) -> dict:
     """Per-name span totals over a record set (default: the flight
     recorder) — the ``jepsen-tpu trace`` summary body."""
@@ -344,9 +568,14 @@ def summarize(records: Optional[Sequence[dict]] = None) -> dict:
     by: Dict[str, dict] = {}
     n_spans = n_events = 0
     for r in recs:
-        if r.get("ph") == "i":
+        if not isinstance(r, dict):
+            continue
+        ph = r.get("ph", "X")
+        if ph == "i":
             n_events += 1
             continue
+        if ph != "X":
+            continue           # metadata / flow records: not spans
         n_spans += 1
         d = by.setdefault(r.get("name", "?"),
                           {"count": 0, "total_us": 0.0, "max_us": 0.0})
@@ -382,7 +611,12 @@ def gaps(records: Optional[Sequence[dict]] = None, *,
     attributed seconds, and ``device_busy_by_family`` — the busy union
     broken down per backend family (the ``family=`` span attribute:
     ``wgl`` for the lax.scan kernels, ``wgl-pallas`` for the Pallas
-    megakernel, ``graph`` for the MXU closure)."""
+    megakernel, ``graph`` for the MXU closure). Over a merge_traces
+    record set (records carrying per-worker ``pid`` lanes) the report
+    additionally attributes cluster-wide device-busy per worker AND
+    per family: ``device_busy_by_worker`` is {worker: {family:
+    seconds}} — which worker's device did the cluster's work, and
+    through which backend."""
     recs = list(records) if records is not None else spans()
     dev = []
     host = []
@@ -393,14 +627,15 @@ def gaps(records: Optional[Sequence[dict]] = None, *,
         t1 = t0 + float(r.get("dur", 0.0))
         if r.get("cat") == "device":
             fam = (r.get("args") or {}).get("family") or "(untagged)"
-            dev.append((t0, t1, fam))
+            dev.append((t0, t1, fam, r.get("pid")))
         else:
             host.append((t0, t1, r.get("name", "?")))
     if not dev:
         return {"window_s": 0.0, "device_busy_s": 0.0, "host_gap_s": 0.0,
                 "device_busy_frac": None, "host_gap_frac": None,
                 "n_gaps": 0, "top_gap_causes": [],
-                "device_busy_by_family": {}}
+                "device_busy_by_family": {},
+                "device_busy_by_worker": {}}
 
     def _merge(ivs):
         ivs = sorted(ivs)
@@ -413,17 +648,24 @@ def gaps(records: Optional[Sequence[dict]] = None, *,
         return out
 
     by_fam_ivs: Dict[str, list] = {}
-    for t0, t1, fam in dev:
+    by_worker_ivs: Dict[str, Dict[str, list]] = {}
+    for t0, t1, fam, pid in dev:
         by_fam_ivs.setdefault(fam, []).append((t0, t1))
+        if pid is not None:
+            by_worker_ivs.setdefault(str(pid), {}) \
+                .setdefault(fam, []).append((t0, t1))
     by_family = {
         fam: round(sum(b - a for a, b in _merge(ivs)) / 1e6, 6)
         for fam, ivs in sorted(by_fam_ivs.items())}
-    merged = _merge([(t0, t1) for t0, t1, _ in dev])
+    by_worker = {
+        w: {fam: round(sum(b - a for a, b in _merge(ivs)) / 1e6, 6)
+            for fam, ivs in sorted(fams.items())}
+        for w, fams in sorted(by_worker_ivs.items())}
+    merged = _merge([(t0, t1) for t0, t1, _, _ in dev])
     # Leaf filter by bisect against the merged device intervals (a
     # full pairwise scan is O(hosts x devices) — minutes of CPU on a
     # default-size ring): a host span is a wrapper iff the first
     # merged interval starting at/after it also ends inside it.
-    import bisect
     starts = [a for a, _ in merged]
 
     def _wrapper(h0, h1):
@@ -485,6 +727,7 @@ def gaps(records: Optional[Sequence[dict]] = None, *,
         "top_gap_causes": [[name, round(s / 1e6, 6)]
                            for name, s in order],
         "device_busy_by_family": by_family,
+        "device_busy_by_worker": by_worker,
     }
 
 
@@ -520,6 +763,15 @@ class _Gauge:
             self._reg._gauges[self._k] = v
 
 
+#: Fixed histogram bucket upper bounds (seconds-or-ms scale agnostic —
+#: log-spaced over the latency range every recorded histogram spans).
+#: Maintained incrementally in observe() so a snapshot carries REAL
+#: cumulative ``le`` buckets for the Prometheus exposition; the
+#: reservoir keeps feeding p50/p99 (exact over the recent window).
+HIST_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 120.0)
+
+
 class _Histogram:
     __slots__ = ("_reg", "_k")
 
@@ -535,7 +787,8 @@ class _Histogram:
             if h is None:
                 h = self._reg._hists[self._k] = {
                     "count": 0, "sum": 0.0, "min": v, "max": v,
-                    "_res": deque(maxlen=self.RESERVOIR)}
+                    "_res": deque(maxlen=self.RESERVOIR),
+                    "_b": [0] * (len(HIST_BUCKETS) + 1)}
             h["count"] += 1
             h["sum"] += v
             if v < h["min"]:
@@ -543,6 +796,7 @@ class _Histogram:
             if v > h["max"]:
                 h["max"] = v
             h["_res"].append(v)
+            h["_b"][bisect.bisect_left(HIST_BUCKETS, v)] += 1
 
 
 class Registry:
@@ -611,6 +865,16 @@ class Registry:
                              "min": round(h["min"], 6),
                              "max": round(h["max"], 6),
                              "p50": _pct(xs, 50), "p99": _pct(xs, 99)}
+                    raw = h.get("_b")
+                    if raw:
+                        # Cumulative le counts (Prometheus histogram
+                        # semantics); "+Inf" always equals count.
+                        cum, buckets = 0, {}
+                        for le, n in zip(HIST_BUCKETS, raw):
+                            cum += n
+                            buckets[repr(le)] = cum
+                        buckets["+Inf"] = h["count"]
+                        hs[k]["buckets"] = buckets
                 out["histograms"] = hs
             return out
 
@@ -663,12 +927,20 @@ def counters_delta(base: Optional[dict], now: dict) -> dict:
 
 def merge_histogram_snapshots(snaps) -> dict:
     """Fold several processes' histogram SUMMARIES (the snapshot()
-    shape: count/sum/min/max/p50/p99) into one cluster-wide view — the
-    checking service's cross-worker SLO aggregation. count/sum/min/max
-    merge exactly; percentiles cannot be recombined from summaries, so
-    the merged p50/p99 are the WORST (max) per-worker values — a
-    conservative upper bound, which is the right direction for an SLO
-    breach signal (doc/service.md)."""
+    shape: count/sum/min/max/p50/p99 + optional cumulative buckets)
+    into one cluster-wide view — the checking service's cross-worker
+    SLO aggregation. count/sum/min/max merge exactly, and bucket
+    counts sum per ``le`` bound (identical bound sets — one code base
+    emits them — otherwise buckets drop rather than lie); percentiles
+    cannot be recombined from summaries, so the merged p50/p99 are the
+    WORST (max) per-worker values — a conservative upper bound, which
+    is the right direction for an SLO breach signal (doc/service.md).
+
+    Tolerant by contract: empty input, None members, snapshots with no
+    ``histograms`` block, empty-summary members, and members whose
+    metric keys are disjoint (each worker's labels differ) all merge
+    without a KeyError — a cluster view must survive whatever a
+    half-written registry file serves it."""
     out: dict = {}
     for s in snaps:
         for k, h in ((s or {}).get("histograms") or {}).items():
@@ -678,14 +950,23 @@ def merge_histogram_snapshots(snaps) -> dict:
             if m is None:
                 out[k] = dict(h)
                 continue
-            m["count"] += h["count"]
-            m["sum"] = round(m.get("sum", 0.0) + h.get("sum", 0.0), 6)
-            m["min"] = min(m["min"], h["min"])
-            m["max"] = max(m["max"], h["max"])
+            m["count"] = m.get("count", 0) + h["count"]
+            m["sum"] = round(m.get("sum", 0.0)
+                             + (h.get("sum") or 0.0), 6)
+            for f, pick in (("min", min), ("max", max)):
+                vals = [v for v in (m.get(f), h.get(f))
+                        if v is not None]
+                m[f] = pick(vals) if vals else None
             for p in ("p50", "p99"):
                 vals = [v for v in (m.get(p), h.get(p))
                         if v is not None]
                 m[p] = max(vals) if vals else None
+            mb, hb = m.get("buckets"), h.get("buckets")
+            if isinstance(mb, dict) and isinstance(hb, dict) and \
+                    set(mb) == set(hb):
+                m["buckets"] = {le: mb[le] + hb[le] for le in mb}
+            else:
+                m.pop("buckets", None)
     return out
 
 
@@ -694,9 +975,154 @@ def merge_counter_snapshots(snaps) -> dict:
     counters_delta outputs) into one — the fleet orchestrator's
     cross-worker aggregation: each worker persists its own per-process
     counter deltas, and the campaign-level telemetry block must report
-    the FLEET's total traffic, which no single registry ever saw."""
+    the FLEET's total traffic, which no single registry ever saw.
+    Tolerant like its histogram sibling: empty input, None members,
+    counter-less snapshots, and disjoint key sets all sum cleanly."""
     out: dict = {}
     for s in snaps:
         for k, v in ((s or {}).get("counters") or {}).items():
-            out[k] = out.get(k, 0) + v
+            if isinstance(v, (int, float)):
+                out[k] = out.get(k, 0) + v
     return out
+
+
+def merge_gauge_snapshots(snaps) -> dict:
+    """Sum the numeric ``gauges`` across workers — cluster totals for
+    additive gauges (pending ops, tenant counts: the only gauges the
+    registry records). Non-numeric values are skipped, not summed."""
+    out: dict = {}
+    for s in snaps:
+        for k, v in ((s or {}).get("gauges") or {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[k] = out.get(k, 0) + v
+    return out
+
+
+# ------------------------------------------------ OpenMetrics export
+
+def parse_key(key: str):
+    """Split a registry key back into (name, labels):
+    ``"scheduler.retries{family=wgl}"`` → ("scheduler.retries",
+    {"family": "wgl"}) — the inverse of ``_key``."""
+    if "{" not in key or not key.endswith("}"):
+        return key, {}
+    name, _, inner = key.partition("{")
+    labels = {}
+    for part in inner[:-1].split(","):
+        if "=" in part:
+            lk, _, lv = part.partition("=")
+            labels[lk] = lv
+    return name, labels
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if c.isalnum() or c == "_" else "_"
+                  for c in name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return f"jt_{out}"
+
+
+def _prom_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+
+    def esc(v):
+        return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+            .replace("\n", "\\n")
+
+    def lname(k):
+        return "".join(c if c.isalnum() or c == "_" else "_"
+                       for c in str(k)) or "_"
+
+    inner = ",".join(f'{lname(k)}="{esc(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _prom_num(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def openmetrics(snap: dict, *, labels: Optional[dict] = None) -> str:
+    """Render a registry snapshot (live ``snapshot()``, a series
+    frame's ``snap``, or a series-merged view) as Prometheus text
+    exposition (format 0.0.4 — what every standard scraper parses).
+
+    Metric names are sanitized under a ``jt_`` prefix with the
+    registry's ``{label=value}`` suffixes decoded into real label
+    sets; ``labels`` adds constant labels to every sample (the
+    per-worker exposition stamps ``worker=<host>-<pid>``). Counters
+    gain the conventional ``_total`` suffix; histograms expose their
+    cumulative ``le`` buckets plus ``_sum``/``_count`` (p50/p99/
+    min/max ride along as ``_p50``-style gauges — summaries a scraper
+    can alert on without bucket math). Served by ``web.py /metrics``
+    and printed offline by ``jepsen-tpu metrics``."""
+    extra = dict(labels or {})
+    lines: List[str] = []
+    seen_types: set = set()
+
+    def type_line(pname: str, kind: str) -> None:
+        if pname not in seen_types:
+            seen_types.add(pname)
+            lines.append(f"# TYPE {pname} {kind}")
+
+    for key, v in sorted((snap.get("counters") or {}).items()):
+        if not isinstance(v, (int, float)):
+            continue
+        name, lbl = parse_key(key)
+        pname = _prom_name(name) + "_total"
+        type_line(pname, "counter")
+        lines.append(f"{pname}{_prom_labels({**lbl, **extra})} "
+                     f"{_prom_num(v)}")
+    for key, v in sorted((snap.get("gauges") or {}).items()):
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        name, lbl = parse_key(key)
+        pname = _prom_name(name)
+        type_line(pname, "gauge")
+        lines.append(f"{pname}{_prom_labels({**lbl, **extra})} "
+                     f"{_prom_num(v)}")
+    for key, h in sorted((snap.get("histograms") or {}).items()):
+        if not isinstance(h, dict) or not h.get("count"):
+            continue
+        name, lbl = parse_key(key)
+        pname = _prom_name(name)
+        type_line(pname, "histogram")
+        base = {**lbl, **extra}
+        buckets = h.get("buckets")
+        if isinstance(buckets, dict):
+            for le, n in buckets.items():
+                lines.append(
+                    f"{pname}_bucket"
+                    f"{_prom_labels({**base, 'le': le})} "
+                    f"{_prom_num(n)}")
+        else:
+            # Summary-only member (merged across mismatched bounds):
+            # the +Inf bucket alone still makes it a valid histogram.
+            lines.append(f"{pname}_bucket"
+                         f"{_prom_labels({**base, 'le': '+Inf'})} "
+                         f"{_prom_num(h['count'])}")
+        lines.append(f"{pname}_sum{_prom_labels(base)} "
+                     f"{_prom_num(h.get('sum') or 0.0)}")
+        lines.append(f"{pname}_count{_prom_labels(base)} "
+                     f"{_prom_num(h['count'])}")
+        for stat in ("min", "max", "p50", "p99"):
+            sv = h.get(stat)
+            if sv is None:
+                continue
+            sname = f"{pname}_{stat}"
+            type_line(sname, "gauge")
+            lines.append(f"{sname}{_prom_labels(base)} "
+                         f"{_prom_num(sv)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# Sibling modules of the cluster observability plane, re-exported so
+# callers write ``telemetry.series`` / ``telemetry.alerts`` (the
+# doc/observability.md names). Imported last: both consume the names
+# defined above.
+from . import series, alerts  # noqa: E402,F401  (re-export)

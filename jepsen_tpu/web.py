@@ -8,7 +8,14 @@ the path-escape guard (273-278). On top of the reference: incomplete
 campaign's crash is visible without shell access — and ``/live``
 renders the current process's telemetry snapshot plus per-run phase/op
 progress straight off each in-flight run's WAL (the live-introspection
-seam the always-on checking service will poll)."""
+seam the always-on checking service will poll).
+
+Observability plane (doc/observability.md): ``/metrics`` serves the
+LIVE process registry in Prometheus text exposition; ``/metrics?
+merged=1`` serves the cluster-merged view folded from every worker's
+durable series ring file under ``store/telemetry/`` (the same text
+``jepsen-tpu metrics`` prints offline). ``/live`` and ``/service``
+surface the alert log's currently-firing SLO alerts as badges."""
 from __future__ import annotations
 
 import html
@@ -114,11 +121,81 @@ class Handler(BaseHTTPRequestHandler):
             return self.live()
         if path == "/service":
             return self.service()
+        if path == "/metrics":
+            return self.metrics(url.query)
         if path.startswith("/files/"):
             return self.files(path[len("/files/"):])
         if path.startswith("/zip/"):
             return self.zip(path[len("/zip/"):])
-        self._send("not found", code=404, ctype="text/plain")
+        return self.not_found(path)
+
+    def not_found(self, what: str = ""):
+        """A proper 404: real status, a body naming the path, and an
+        explicit Content-Type (+charset) — scripted probes and browsers
+        both get something parseable, not an empty fallthrough."""
+        self._send(f"not found: {what or self.path}\n", code=404,
+                   ctype="text/plain; charset=utf-8")
+
+    def metrics(self, query: str = ""):
+        """Prometheus text exposition (doc/observability.md). Default:
+        the LIVE process registry — meaningful when the server rides
+        inside a campaign/service process, and always cheap. With
+        ``?merged=1``: the cluster-merged view folded from every
+        worker's durable series ring file (counters summed, histogram
+        percentiles conservative-max) with this process's live
+        registry merged in — one scrape describes the fleet."""
+        from urllib.parse import parse_qs
+
+        from . import series
+        merged_q = parse_qs(query or "", keep_blank_values=True) \
+            .get("merged", ["0"])[-1]
+        if merged_q not in ("0", "false"):
+            # This process's own durable frame is EXCLUDED from the
+            # series fold — its live registry (fresher than any frame
+            # it wrote) is merged in below; counting both would double
+            # every one of its counters in the cluster scrape. Frames
+            # older than several recording cadences are dropped too: a
+            # dead worker's final pending-ops gauge must not inflate
+            # the live cluster scrape forever (offline analysis that
+            # wants dead workers uses `jepsen-tpu metrics`, which
+            # keeps everything).
+            snap = series.merged_latest(
+                self.store.base, exclude={series.worker_key()},
+                max_age_s=max(60.0, 12 * series.interval_s()))
+            live = telemetry.snapshot()
+            snap = {
+                "counters": telemetry.merge_counter_snapshots(
+                    [snap, live]),
+                "gauges": telemetry.merge_gauge_snapshots(
+                    [snap, live]),
+                "histograms": telemetry.merge_histogram_snapshots(
+                    [snap, live]),
+            }
+            snap = {k: v for k, v in snap.items() if v}
+        else:
+            snap = telemetry.snapshot()
+        self._send(telemetry.openmetrics(snap),
+                   ctype="text/plain; version=0.0.4; charset=utf-8")
+
+    def _alerts_html(self) -> str:
+        """Currently-firing SLO alerts (telemetry.alerts' durable log
+        under store/telemetry/) as a badge row — '' when quiet."""
+        from . import alerts
+        try:
+            firing = alerts.active_alerts(self.store.base)
+        except Exception:
+            firing = []
+        if not firing:
+            return ""
+        parts = []
+        for a in firing:
+            cls = ("badge-violation" if a.get("severity") == "page"
+                   else "badge-stalled")
+            txt = (f"{a.get('alert')}: {a.get('value')} "
+                   f"{a.get('unit', '')} > {a.get('threshold')}")
+            parts.append(f'<span class="badge {cls}">'
+                         f"{html.escape(txt)}</span>")
+        return ("<h2>alerts</h2><p>" + " ".join(parts) + "</p>")
 
     @staticmethod
     def _writer_live(header) -> bool:
@@ -290,8 +367,9 @@ class Handler(BaseHTTPRequestHandler):
                        "<p>no metrics recorded in this process</p>")
         body = ('<meta http-equiv="refresh" content="2">'
                 '<p><a href="/">index</a> · '
-                '<a href="/service">service</a></p>'
-                + runs_tbl + metrics_tbl)
+                '<a href="/service">service</a> · '
+                '<a href="/metrics">metrics</a></p>'
+                + self._alerts_html() + runs_tbl + metrics_tbl)
         self._page("Jepsen-TPU live", body)
 
     def service(self):
@@ -375,13 +453,15 @@ class Handler(BaseHTTPRequestHandler):
             "</table>")
         body = ('<meta http-equiv="refresh" content="2">'
                 '<p><a href="/">index</a> · <a href="/live">live</a>'
-                "</p>" + workers_tbl + tenants_tbl + meta)
+                ' · <a href="/metrics?merged=1">metrics</a>'
+                "</p>" + self._alerts_html()
+                + workers_tbl + tenants_tbl + meta)
         self._page("Jepsen-TPU service", body)
 
     def files(self, rel: str):
         p = self._resolve(rel.rstrip("/"))
         if p is None or not p.exists():
-            return self._send("not found", code=404, ctype="text/plain")
+            return self.not_found(rel)
         if p.is_dir():
             entries = []
             for child in sorted(p.iterdir()):
@@ -409,7 +489,7 @@ class Handler(BaseHTTPRequestHandler):
     def zip(self, rel: str):
         p = self._resolve(rel)
         if p is None or not p.is_dir():
-            return self._send("not found", code=404, ctype="text/plain")
+            return self.not_found(rel)
         buf = io.BytesIO()
         with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
             for f in sorted(p.rglob("*")):

@@ -96,3 +96,11 @@ def pytest_configure(config):
                    "export, snapshot determinism, no-op-when-off, and "
                    "the traced-overhead gate (deterministic; runs in "
                    "tier-1)")
+    config.addinivalue_line(
+        "markers", "obsplane: cluster observability plane — durable "
+                   "metrics series ring files, OpenMetrics exposition "
+                   "validity, cross-worker trace correlation/merge, "
+                   "SLO burn-rate alerts, the series-recording "
+                   "≤5%-overhead gate, and the bench --compare "
+                   "regression sentinel (deterministic; runs in "
+                   "tier-1)")

@@ -520,9 +520,17 @@ def test_worker_sigkill_takeover_zero_redispatch_parity(tmp_path):
     surface as check_errors), detects a violation that arrives only
     AFTER the takeover, records the takeover latency, and finalizes
     verdicts field-for-field identical to a single daemon over the
-    same WALs."""
+    same WALs.
+
+    Observability-plane acceptance (r13): each worker streams its
+    spans to its own JT_TRACE sink; after the run, the two sinks
+    merge into ONE Chrome trace in which the killed worker's tenant
+    spans and the survivor's takeover spans share a correlation id
+    (tenant key + WAL segment inode) across process lanes."""
     base = (tmp_path / "store").resolve()
     store = Store(base)
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
     N = 4
     dirs = {i: mkrun(base, f"t{i}", "r1", reg_ops(2),
                      pid=os.getpid(), seed=i)
@@ -536,7 +544,9 @@ def test_worker_sigkill_takeover_zero_redispatch_parity(tmp_path):
              "--poll", "0.05", "--interval", "4", "--model", "cas",
              "--lease-ttl", "2", "--claim-budget", "2",
              "--max-tenants", str(max_tenants)],
-            env=_worker_env(), stdout=subprocess.PIPE,
+            env=_worker_env(
+                JT_TRACE=str(trace_dir / f"{wid}.trace.jsonl")),
+            stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
 
     # A first, capacity 2: it claims exactly two tenants and holds
@@ -599,6 +609,39 @@ def test_worker_sigkill_takeover_zero_redispatch_parity(tmp_path):
     # The survivor-detected violation is durable.
     fv = json.loads((dirs[bad] / "first-violation.json").read_text())
     assert fv["op_index"] == 15
+
+    # --- one merged Chrome trace, correlation ids across workers ---
+    a_recs = telemetry.read_trace(trace_dir / "wA.trace.jsonl")
+    b_recs = telemetry.read_trace(trace_dir / "wB.trace.jsonl")
+    a_checks = {r.get("corr") for r in a_recs
+                if r.get("name") in ("online.check",
+                                     "online.finalize")}
+    b_takeovers = {r.get("corr") for r in b_recs
+                   if r.get("name") == "service.takeover"}
+    shared = (a_checks & b_takeovers) - {None}
+    # Every tenant A owned and lost appears on BOTH sides under the
+    # same id: A's check spans, B's takeover span.
+    assert len(shared) == len(a_mine), (a_checks, b_takeovers)
+    for i in a_mine:
+        assert any(c.startswith(f"t{i}/r1#") for c in shared), shared
+    merged = telemetry.merge_traces(
+        sorted(trace_dir.glob("*.trace.jsonl")))
+    lanes = [r for r in merged if r.get("ph") == "M"
+             and r.get("name") == "process_name"]
+    assert len(lanes) == 2            # one process lane per worker
+    assert len({r["pid"] for r in lanes}) == 2
+    # The shared ids grew cross-lane flow chains.
+    flow_ids = {r["name"] for r in merged
+                if r.get("ph") in ("s", "t", "f")}
+    for c in shared:
+        assert f"corr:{c}" in flow_ids
+    out_trace = tmp_path / "takeover-trace.json"
+    n_evs = telemetry.export_chrome(out_trace, merged)
+    doc = json.loads(out_trace.read_text())
+    assert n_evs == len(doc["traceEvents"]) > 0
+    # ...and the cluster gap report attributes device time per worker.
+    by_worker = telemetry.gaps(merged)["device_busy_by_worker"]
+    assert isinstance(by_worker, dict)
 
     # Field-for-field parity vs ONE daemon over the same WALs.
     solo_base = tmp_path / "solo"

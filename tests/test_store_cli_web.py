@@ -170,6 +170,40 @@ def test_web_ui(store):
         srv.shutdown()
 
 
+def test_web_404_body_and_content_types(store):
+    """Observability-plane satellite: unknown paths get a REAL 404 —
+    status, a body naming the path, and an explicit Content-Type — and
+    every text/HTML/exposition endpoint declares its Content-Type."""
+    run_stored(store, n_ops=10, concurrency=2)
+    srv = serve(host="127.0.0.1", port=0, store=store)
+    try:
+        port = srv.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}") as r:
+                return r.status, r.headers, r.read()
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/no/such/route")
+        assert e.value.code == 404
+        assert e.value.headers["Content-Type"] == \
+            "text/plain; charset=utf-8"
+        assert b"/no/such/route" in e.value.read()
+
+        _, h, _ = get("/")
+        assert h["Content-Type"] == "text/html; charset=utf-8"
+        _, h, _ = get("/live")
+        assert h["Content-Type"] == "text/html; charset=utf-8"
+        _, h, _ = get("/metrics")
+        assert h["Content-Type"].startswith("text/plain")
+        ts = store.tests()["atom-cas"][0]
+        _, h, _ = get(f"/files/atom-cas/{ts}/results.json")
+        assert "charset" in h["Content-Type"]
+    finally:
+        srv.shutdown()
+
+
 # ------------------------------------------- recheck family registry
 
 def _store_runs(tmp_path, monkeypatch, name, runs):
