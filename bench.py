@@ -1311,13 +1311,17 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
         OT = int(os.environ.get("JT_BENCH_ONLINE_TENANTS", "3"))
         OPAIRS = int(os.environ.get("JT_BENCH_ONLINE_OPS", "60"))
 
-        def _on_ops(n_pairs, start=0):
+        def _on_ops(n_pairs, start=0, mod=None):
+            # ``mod`` cycles the written values (bounded vocabulary —
+            # the incremental subsection's live-stream shape); None
+            # keeps the growing-value stream.
             ops, idx = [], start * 4
             for k in range(start, start + n_pairs):
-                for op in (_on_inv(0, "write", k + 1),
-                           _on_ok(0, "write", k + 1),
+                v = (k % mod) + 1 if mod else k + 1
+                for op in (_on_inv(0, "write", v),
+                           _on_ok(0, "write", v),
                            _on_inv(0, "read", None),
-                           _on_ok(0, "read", k + 1)):
+                           _on_ok(0, "read", v)):
                     op.index = idx
                     idx += 1
                     ops.append(op)
@@ -1421,6 +1425,107 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
                               for t in burst.tenants.values())
             burst.close()
 
+        # ---- incremental subsection (ISSUE 14): per-tick check cost
+        # vs a growing prefix under the resident device frontier
+        # (JT_ONLINE_INCREMENTAL=1, the default) against full-recheck
+        # mode (the =0 restore switch) as the baseline. The prefix
+        # grows JT_BENCH_ONLINE_INC_STAGES-fold over the run; the
+        # acceptance shape is the per-tick cost curve staying flat
+        # (within 2x) in incremental mode while interim AND final
+        # verdicts stay field-for-field identical between the modes.
+        # Values cycle mod 8 so the state space is bounded — the live
+        # production stream shape. Size up with the env knobs for the
+        # committed figure (100+ tenants on a real box).
+        IT = int(os.environ.get("JT_BENCH_ONLINE_INC_TENANTS", "3"))
+        ISTAGES = int(os.environ.get("JT_BENCH_ONLINE_INC_STAGES",
+                                     "10"))
+        IPAIRS = int(os.environ.get("JT_BENCH_ONLINE_INC_PAIRS", "8"))
+
+        from jepsen_tpu.history.codec import write_jsonl as _on_wj
+        from jepsen_tpu.history.core import index as _on_index
+
+        def _inc_ops(n_pairs, start=0):
+            return _on_ops(n_pairs, start=start, mod=8)
+
+        inc_modes = {}
+        inc_verdicts = {}
+        for inc_mode, inc_on in (("incremental", True),
+                                 ("full", False)):
+            with _on_tf.TemporaryDirectory() as td2:
+                ibase = Path(td2) / "store"
+                idirs = []
+                for i in range(IT):
+                    dd = ibase / f"inc-{i}" / "r1"
+                    dd.mkdir(parents=True)
+                    _on_write(dd / _ON_WAL,
+                              _on_head(i) + [_on_dumps(o)
+                                             for o in _inc_ops(IPAIRS)],
+                              mode="w")
+                    idirs.append(dd)
+                idaemon = OnlineDaemon(
+                    store=_OnStore(ibase),
+                    config=OnlineConfig(model=model, poll_s=0,
+                                        check_interval_ops=4,
+                                        crash_quiet_s=3600,
+                                        incremental=inc_on))
+                t0 = time.perf_counter()
+                idaemon.tick()
+                boot_s = time.perf_counter() - t0
+                tick_s = []
+                interim = []
+                for stage in range(1, ISTAGES):
+                    for dd in idirs:
+                        _on_write(dd / _ON_WAL,
+                                  [_on_dumps(o) for o in
+                                   _inc_ops(IPAIRS,
+                                            start=stage * IPAIRS)])
+                    t0 = time.perf_counter()
+                    idaemon.tick()
+                    tick_s.append(time.perf_counter() - t0)
+                    interim.append(tuple(
+                        t.valid_so_far for _, t in
+                        sorted(idaemon.tenants.items())))
+                full_h = _on_index([o.with_() for o in
+                                    _inc_ops(ISTAGES * IPAIRS)])
+                for dd in idirs:
+                    _on_wj(dd / "history.jsonl", full_h)
+                    _on_write(dd / _ON_WAL,
+                              [json.dumps({"phase": "analyzed",
+                                           "wal_ops": len(full_h)})])
+                for _ in range(10):
+                    idaemon.tick()
+                    if idaemon.idle():
+                        break
+                ittfv = sorted(t.t_first_verdict - t.t_admitted
+                               for t in idaemon.tenants.values()
+                               if t.t_first_verdict is not None)
+                inc_verdicts[inc_mode] = (interim, {
+                    f"{k[0]}/{k[1]}": json.loads(json.dumps(
+                        t.result, default=repr))
+                    for k, t in sorted(idaemon.tenants.items())})
+                st = idaemon.stats
+                inc_modes[inc_mode] = {
+                    "bootstrap_tick_s": round(boot_s, 4),
+                    "tick_cost_s": [round(x, 4) for x in tick_s],
+                    "tick_cost_first_s": round(tick_s[0], 4),
+                    "tick_cost_last_s": round(tick_s[-1], 4),
+                    "cost_ratio_last_vs_first": round(
+                        tick_s[-1] / max(tick_s[0], 1e-9), 3),
+                    "checks": st["checks"],
+                    "delta_ops": st["delta_ops"],
+                    "frontier_resumes": st["frontier_resumes"],
+                    "frontier_invalidations":
+                        st["frontier_invalidations"],
+                    "ttfv_p99_s": _pct_nearest(ittfv, 99),
+                    "verdicts_per_s": round(
+                        st["checks"] / max(sum(tick_s) + boot_s,
+                                           1e-9), 2),
+                    "valid_ok": all(
+                        (t.result or {}).get("valid") is True
+                        for t in idaemon.tenants.values()),
+                }
+                idaemon.close()
+
         _pct = _pct_nearest
 
         online_section = {
@@ -1443,6 +1548,18 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
                 "widened": bs["widened"],
                 "resumed": bs["resumed"],
                 "valid_ok": burst_valid,
+            },
+            "incremental": {
+                "tenants": IT,
+                "stages": ISTAGES,
+                "pairs_per_stage": IPAIRS,
+                "prefix_growth": ISTAGES,
+                "modes": inc_modes,
+                # Field-for-field: every interim verdict tuple AND
+                # every final result dict identical across the modes
+                # (the ISSUE 14 acceptance parity).
+                "verdicts_match":
+                    inc_verdicts["incremental"] == inc_verdicts["full"],
             },
         }
 

@@ -316,6 +316,24 @@ def classify_history(history) -> str:
     return "graph" if ("append" in fs or "insert" in fs) else "wgl"
 
 
+def online_tick_costs(w: int, prefix_events: int, delta_events: int, *,
+                      lane_ops_per_s: float, host_s_per_event: float,
+                      overhead_s: float = 0.0,
+                      incremental: bool = True) -> Dict[str, float]:
+    """THE delta-path pricing arithmetic, shared by
+    CostRouter.price_online_tick (router surface) and
+    service.tenant_price (placement over peer caps) so the two
+    surfaces cannot drift: the device term charges the delta when the
+    worker carries a resident frontier, the whole prefix otherwise;
+    the host oracle always pays the prefix."""
+    dev_ev = (max(int(delta_events), 1) if incremental
+              else max(int(prefix_events), 1))
+    dev = (dev_ev * float(1 << min(max(int(w), 0), 30))
+           / lane_ops_per_s + overhead_s)
+    host = max(int(prefix_events), 1) * host_s_per_event
+    return {"wgl-device": dev, "host-oracle": host}
+
+
 class CostRouter:
     """Prices each checkable unit per backend and picks the cheapest
     CAPABLE one. Units are (family, W-or-vertex-bucket, length); the
@@ -379,6 +397,28 @@ class CostRouter:
                     n_events * float(1 << min(int(w), 30)) / pr
                     + self._overhead_s() / max(int(rows), 1))
         return costs
+
+    def price_online_tick(self, w: int, prefix_events: int,
+                          delta_events: int, *,
+                          incremental: bool = True) -> Dict[str, float]:
+        """Per-tick cost of one ONLINE interim check (the daemon's
+        rolling prefix check): the resident-frontier delta path
+        (ops.schedule.ResidentFrontier, $JT_ONLINE_INCREMENTAL)
+        charges the device scan only for the events that arrived since
+        the last decided prefix — per-tick cost flat in prefix length
+        — while full-recheck mode re-pays the whole prefix every tick.
+        The host oracle has no carried state, so it always pays the
+        prefix. Carried dispatch rides the lax.scan resume kernel
+        exclusively (the Pallas megakernel's VMEM-resident frontier
+        never round-trips between launches — pallas_wgl
+        .pallas_supports_resume), so no pallas term appears here.
+        service.tenant_price prices placement through the same shared
+        arithmetic (online_tick_costs)."""
+        return online_tick_costs(
+            w, prefix_events, delta_events, incremental=incremental,
+            lane_ops_per_s=self.rates["lane_ops_per_s"],
+            host_s_per_event=self.rates["host_s_per_event"],
+            overhead_s=self._overhead_s())
 
     def price_graph(self, n_vertices: int, n_edges: int,
                     rows: int = 1) -> Dict[str, float]:

@@ -39,6 +39,7 @@ import logging
 import os
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import List, Optional, Tuple
@@ -330,6 +331,17 @@ def wal_progress(path) -> Optional[dict]:
                 "bytes": out.get("size", st.pos)}
 
 
+# estimate_peak_w memo: {path: ((inode, offset watermark), result)}.
+# Placement re-prices every candidate tenant on every discover() sweep
+# (and every peer does the same), so the same unchanged WAL was being
+# re-scanned once per worker per tick; the probe only reads the first
+# ``max_bytes``, so (inode, min(size, max_bytes)) IS the input's
+# identity — same watermark, same answer, for free. Bounded LRU.
+_PEAK_W_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+_PEAK_W_CACHE_MAX = 512
+_PEAK_W_LOCK = threading.Lock()
+
+
 def estimate_peak_w(path, *, max_bytes: int = 1 << 20
                     ) -> Optional[Tuple[int, int]]:
     """Cheap tenant-shape probe for the checking service's placement
@@ -339,7 +351,26 @@ def estimate_peak_w(path, *, max_bytes: int = 1 << 20
     matches the encoder's (and OnlineTenant._track_w's): invokes open
     a slot, ok/fail completions close it, ``:info`` pends forever.
     Returns (peak_w, n_ops) or None when the file has no durable
-    header (or isn't a WAL)."""
+    header (or isn't a WAL).
+
+    Memoized per (inode, offset watermark): repeated placement pricing
+    of an unchanged segment — every worker, every tick — costs one
+    stat, not one scan; growth or rotation changes the watermark and
+    re-probes."""
+    try:
+        fst = os.stat(path)
+        # mtime in the stamp closes the truncate-and-rewrite-in-place
+        # window: same inode, same size watermark, different content.
+        stamp = (fst.st_ino, min(fst.st_size, max_bytes), max_bytes,
+                 fst.st_mtime_ns)
+    except OSError:
+        return None
+    key = str(Path(path))
+    with _PEAK_W_LOCK:
+        hit = _PEAK_W_CACHE.pop(key, None)   # re-insert = LRU touch
+        if hit is not None and hit[0] == stamp:
+            _PEAK_W_CACHE[key] = hit
+            return hit[1]
     st, out = tail_wal(path, None, max_bytes=max_bytes)
     if st.header is None or out["bad_magic"] or out["missing"]:
         return None
@@ -352,7 +383,12 @@ def estimate_peak_w(path, *, max_bytes: int = 1 << 20
                 peak = len(open_)
         elif op.is_completion and op.type != INFO:
             open_.discard(op.process)
-    return peak, st.n_ops
+    result = (peak, st.n_ops)
+    with _PEAK_W_LOCK:
+        _PEAK_W_CACHE[key] = (stamp, result)
+        while len(_PEAK_W_CACHE) > _PEAK_W_CACHE_MAX:
+            _PEAK_W_CACHE.pop(next(iter(_PEAK_W_CACHE)))
+    return result
 
 
 def wal_header(path) -> Optional[dict]:

@@ -283,6 +283,17 @@ class OnlineConfig:
     # stream waits unboundedly behind a persistently overloaded
     # daemon (``deferred_starvation_rescues``).
     defer_max_s: Optional[float] = None
+    # -- incremental prefix checking ($JT_ONLINE_INCREMENTAL, default
+    # on; 0 = the restore switch, every interim check re-walks the
+    # full prefix — the pre-frontier behavior, bit-for-bit). When on,
+    # non-shed interim checks resume a per-tenant resident device
+    # frontier (ops.schedule.ResidentFrontier) so per-tick cost is
+    # O(new ops); any fault, rotation, or non-monotone vocabulary
+    # growth invalidates the carried frontier and that tick falls back
+    # to the full-prefix check. Finalization ALWAYS runs the exact
+    # full Store.recheck engine call — the parity contract is
+    # structurally untouched by this switch.
+    incremental: Optional[bool] = None
     # -- finalization
     crash_quiet_s: float = 1.0      # writer dead AND quiet this long
     min_device_batch: int = 64      # Store.recheck's value (parity)
@@ -292,6 +303,9 @@ class OnlineConfig:
         if self.model is None:
             from .models.core import cas_register
             self.model = cas_register()
+        if self.incremental is None:
+            self.incremental = os.environ.get(
+                "JT_ONLINE_INCREMENTAL", "1") != "0"
         if self.defer_max_s is None:
             try:
                 self.defer_max_s = max(
@@ -357,6 +371,132 @@ class OnlineCheckEngine:
             # degradation route).
             return self.host(cfg.model, history), "online-host"
 
+    def check_delta(self, tenant) -> Optional[Tuple[dict, str]]:
+        """Incremental interim check: resume the tenant's resident
+        device frontier over the ops that arrived since the last tick
+        — O(new ops) — rebuilding from op 0 on any invalidation
+        (vocabulary renumbering, window overflow, a poisoned carry).
+        Returns None when the incremental path cannot serve this
+        tenant (state space exploded, window beyond the single-device
+        mask axis, a deferred tenant still re-buffering): the caller
+        falls back to the full-prefix engine, verdicts unchanged.
+
+        Soundness guard: ANY exception mid-advance drops the carried
+        frontier before propagating — a half-updated carry never
+        survives into the next tick."""
+        from .ops.linearize import DATA_MAX_SLOTS
+        from .ops.schedule import FrontierInvalid, ResidentFrontier
+        from .ops.statespace import StateSpaceExplosion
+
+        d = tenant.daemon
+        if getattr(tenant, "_no_frontier", False) \
+                or tenant.peak_w > DATA_MAX_SLOTS:
+            return None
+        key = (tenant.key, tenant.state.ino)
+        frontiers = self.resident.frontiers
+        fr = frontiers.get(key)
+        if fr is None and tenant.frontier_ckpt is not None:
+            # Restore ONCE and adopt immediately — even when the
+            # re-tailing buffer hasn't caught up to the carry yet (the
+            # guard below just skips those ticks); re-restoring every
+            # lagging tick would re-pay the enumeration + bitset
+            # decompression for nothing.
+            fr = ResidentFrontier.restore(self.cfg.model,
+                                          tenant.frontier_ckpt)
+            tenant.frontier_ckpt = None
+            if fr is not None:
+                frontiers[key] = fr
+                tenant.stats["frontier_restored"] = \
+                    tenant.stats.get("frontier_restored", 0) + 1
+        resumed = fr is not None
+        if resumed and fr.pos > len(tenant.ops):
+            # A deferred/rebuffering tenant hasn't re-tailed past the
+            # carry's consumed prefix yet: not an invalidation, just
+            # not servable incrementally this tick (a multi-GB WAL
+            # re-tails over several polls; the adopted frontier waits).
+            return None
+        prov = "online-delta" if resumed else "online-rebuild"
+        try:
+            try:
+                if fr is None:
+                    fr = ResidentFrontier(self.cfg.model)
+                valid, bad = fr.advance(tenant.ops)
+            except FrontierInvalid:
+                frontiers.pop(key, None)
+                if not resumed:
+                    # A FRESH build already failed (window beyond the
+                    # device axis): rebuilding identically would fail
+                    # identically — the full-prefix engine owns this
+                    # tick, no second full-cost walk.
+                    return None
+                tenant._count_frontier(d, "frontier_invalidations")
+                prov = "online-rebuild"
+                try:
+                    fr = ResidentFrontier(self.cfg.model)
+                    valid, bad = fr.advance(tenant.ops)
+                except FrontierInvalid:
+                    return None
+        except StateSpaceExplosion:
+            tenant._no_frontier = True
+            frontiers.pop(key, None)
+            return None
+        except Exception:
+            frontiers.pop(key, None)
+            if resumed:
+                tenant._count_frontier(d, "frontier_invalidations")
+            raise
+        frontiers[key] = fr
+        if resumed:
+            tenant._count_frontier(d, "frontier_resumes")
+        if fr.last_delta_ops:
+            d._count("delta_ops", fr.last_delta_ops)
+            telemetry.REGISTRY.counter(
+                "online.delta_ops", tenant=tenant.name).inc(
+                fr.last_delta_ops)
+        tenant.stats["delta_checks"] = \
+            tenant.stats.get("delta_checks", 0) + 1
+        tenant.stats["delta_events_last"] = fr.last_events
+        # Stalled-frontier visibility: a single never-completing
+        # invocation pins the stable point, so the volatile tail — and
+        # with it per-tick cost — grows with the prefix again (sound,
+        # same cost class as the full path, but no longer O(new ops)).
+        # Soundness forbids freezing past an open invocation; what we
+        # CAN do is make the degradation loud instead of letting the
+        # `inc` badge claim flat cost that isn't. A LATCHED-invalid
+        # tenant is exempt: its ticks are O(1) served from the latch —
+        # the un-advancing pos is the short-circuit, not a stall.
+        tail = len(tenant.ops) - fr.pos
+        tenant.stats["delta_tail_last"] = tail
+        if fr.latched_bad is None and \
+                tail > max(1024, 8 * self.cfg.check_interval_ops):
+            if not tenant.stats.get("frontier_stalled"):
+                log.warning(
+                    "%s: open invocation at op %d pins the frontier's "
+                    "stable point; the %d-op volatile tail re-checks "
+                    "every tick (O(prefix) again) until it completes "
+                    "or the run finalizes", tenant.key, fr.pos, tail)
+            tenant.stats["frontier_stalled"] = \
+                tenant.stats.get("frontier_stalled", 0) + 1
+            telemetry.REGISTRY.counter(
+                "online.frontier_stalls", tenant=tenant.name).inc()
+        else:
+            tenant.stats["frontier_stalled"] = 0
+        # Checkpoint the carry whenever it advanced (or latched): a
+        # restart or a PR-11 takeover replays only the undecided
+        # suffix, with zero re-dispatched decided events.
+        if tenant.journal is not None and \
+                (fr.pos != tenant._frontier_ckpt_pos
+                 or (not valid and not tenant._frontier_ckpt_bad)):
+            try:
+                tenant.journal.record_frontier(fr.export())
+                tenant._frontier_ckpt_pos = fr.pos
+                tenant._frontier_ckpt_bad = not valid
+            except Exception:
+                log.debug("frontier checkpoint failed", exc_info=True)
+        if valid:
+            return {"valid": True}, prov
+        return {"valid": False, "op": {"index": bad}}, prov
+
 
 # --------------------------------------------------------------- tenant
 
@@ -392,6 +532,15 @@ class OnlineTenant:
         self.peak_w = 0
         self.journal: Optional[ChunkJournal] = None
         self._decided: Dict[int, tuple] = {}
+        # Incremental prefix checking (doc/online.md "The resident
+        # frontier"): the journal's latest frontier-checkpoint row,
+        # consumed once by the engine's first delta check; the
+        # explosion latch (a vocabulary past the packed table never
+        # shrinks); and the checkpoint watermark.
+        self.frontier_ckpt: Optional[dict] = None
+        self._no_frontier = False
+        self._frontier_ckpt_pos = -1
+        self._frontier_ckpt_bad = False
         # Restart rehydration, cheapest gate first: a durable final
         # verdict means ZERO work; a decided-prefix journal means zero
         # re-dispatch of decided prefixes; a deferred mark means the
@@ -486,6 +635,7 @@ class OnlineTenant:
              "ino": self.state.ino},
             resume=True)
         self._decided = self.journal.decided()
+        self.frontier_ckpt = self.journal.frontier()
         if self._decided:
             self.stats["resumed_prefixes"] = len(self._decided)
             self.daemon._count("resumed_prefixes",
@@ -506,6 +656,27 @@ class OnlineTenant:
         elif op.type in (OK, FAIL):
             self._open.discard(op.process)
 
+    def _count_frontier(self, d, key: str) -> None:
+        """One frontier lifecycle event: daemon stats + the per-tenant
+        labeled registry counter (the ISSUE-14 telemetry surface)."""
+        d._count(key)
+        telemetry.REGISTRY.counter(f"online.{key}",
+                                   tenant=self.name).inc()
+
+    def _drop_frontier(self, *, invalidated: bool) -> None:
+        """Void every carried frontier for this tenant (any
+        incarnation) — rotation and finalization both end the carry's
+        life; rotation counts as an invalidation."""
+        frontiers = self.daemon.engine.resident.frontiers
+        mine = [k for k in frontiers if k[0] == self.key]
+        for k in mine:
+            del frontiers[k]
+        if invalidated and mine:
+            self._count_frontier(self.daemon, "frontier_invalidations")
+        self.frontier_ckpt = None
+        self._frontier_ckpt_pos = -1
+        self._frontier_ckpt_bad = False
+
     def _reset_segment(self) -> None:
         """The path names different content now (rotation): everything
         derived from the old segment is void — including the durable
@@ -519,6 +690,7 @@ class OnlineTenant:
         self._open = set()
         self.peak_w = 0
         self._decided = {}
+        self._drop_frontier(invalidated=True)
         if self.journal is not None:
             self.journal.finish()       # old-content rows: delete
             self.journal = None
@@ -620,9 +792,21 @@ class OnlineTenant:
                 telemetry.span("online.check", tenant=self.key,
                                ops=k, shed=bool(shed)):
             d._fire("encode")
-            history = checkable_prefix(self.ops)
-            d._fire("dispatch")
-            r, prov = d.engine.check(history, shed=shed)
+            r = prov = None
+            if d.cfg.incremental and not shed:
+                # The O(new ops) path: resume the resident device
+                # frontier over the delta. The dispatch-stage fault
+                # fires BEFORE the carry is touched, so an injected
+                # fault costs a retried tick, never a poisoned carry.
+                d._fire("dispatch")
+                out = d.engine.check_delta(self)
+                if out is not None:
+                    r, prov = out
+            if r is None:
+                history = checkable_prefix(self.ops)
+                if not (d.cfg.incremental and not shed):
+                    d._fire("dispatch")
+                r, prov = d.engine.check(history, shed=shed)
             verdict = r.get("valid")
             bad = _bad_index(r)
             if verdict in (True, False):
@@ -770,6 +954,7 @@ class OnlineTenant:
         if self.journal is not None:
             self.journal.finish()
             self.journal = None
+        self._drop_frontier(invalidated=False)
         mark = self.run_dir / ONLINE_DEFERRED
         if mark.exists():
             mark.unlink()
@@ -808,6 +993,11 @@ class OnlineTenant:
         self.last_growth = time.monotonic()
 
     def close(self) -> None:
+        # The carried frontier goes with the tenant: a ServiceWorker
+        # releasing (or losing) a tenant must not pin its bitsets for
+        # the worker's lifetime — the journal checkpoint is the
+        # durable copy the next owner resumes from.
+        self._drop_frontier(invalidated=False)
         if self.journal is not None:
             self.journal.close()
             self.journal = None
@@ -823,6 +1013,10 @@ class OnlineTenant:
                 "checks": self.stats["checks"],
                 "host_checks": self.stats["host_checks"],
                 "resumed_prefixes": self.stats["resumed_prefixes"],
+                "incremental": bool(
+                    (self.key, self.state.ino)
+                    in self.daemon.engine.resident.frontiers),
+                "delta_checks": self.stats.get("delta_checks", 0),
                 "rotations": self.rotations}
 
 
@@ -852,6 +1046,8 @@ class OnlineDaemon:
                       "unknown_verdicts": 0, "first_violations": 0,
                       "finalized": 0, "resumed_prefixes": 0,
                       "ingested_ops": 0,
+                      "delta_ops": 0, "frontier_resumes": 0,
+                      "frontier_invalidations": 0,
                       "deferred_starvation_rescues": 0}
         self._t0 = time.monotonic()
         # Cluster observability plane: periodic registry frames into

@@ -590,3 +590,134 @@ def check_graph_host(g: DepGraph, provenance: str = "host") -> dict:
             return graph_result(g, LEVELS[li], refine_witness(g, li),
                                 provenance)
     return graph_result(g, None, None, provenance)
+
+
+# --------------------------------------------- incremental closure
+
+class IncrementalClosure:
+    """Transitive-closure bitset maintained incrementally as edges
+    arrive — the graph family's O(new edges) move (ROADMAP item 2's
+    second half): a live-monitored dependency graph must not re-close
+    the whole [V, V] relation from scratch each tick.
+
+    The closure lives as a packed uint32 bitset ``C`` ([V, V/32]; bit
+    c of word w on row r = r reaches w*32+c), one plane per cumulative
+    anomaly level (the LEVEL_TYPES masks, exactly the device kernel's
+    layout — pack_graph's word order). Adding edge u → v touches only
+    the AFFECTED rows: every vertex that reaches u (plus u itself)
+    gains v's whole reach (plus v) in one vectorized OR over the
+    existing closure — O(|pred(u)| * V/32) words, not a V^3 re-close.
+    An edge already implied by the closure is a no-op.
+
+    ``grow(n)`` widens the vertex space: within the padded bucket
+    (power-of-two columns, GRAPH_MIN_V floor) new vertices are free —
+    their bits were always zero — while crossing the bucket falls back
+    to ONE full re-closure at the wider shape (counted in ``stats``),
+    after which deltas are incremental again. The same invalidation
+    discipline as the WGL resident frontier.
+
+    ``anomaly()`` is the running verdict: the first cumulative level
+    whose closure holds a diagonal bit (levels only ever gain edges,
+    so the verdict is monotone — once cyclic at a level, forever
+    cyclic there). Parity: tests pin it against check_graph_host and
+    the from-scratch closure on every prefix of an edge stream."""
+
+    def __init__(self, n: int = 0):
+        self.n = 0
+        self.cols = 0                  # padded column bucket
+        self.edges: List[List[Tuple[int, int]]] = \
+            [[] for _ in range(N_LEVELS)]
+        self.stats = {"edges": 0, "implied": 0, "row_updates": 0,
+                      "recloses": 0}
+        self._C: Optional[np.ndarray] = None   # [L, V, V/32] uint32
+        if n:
+            self.grow(n)
+
+    # ------------------------------------------------------- plumbing
+    def _alloc(self, n: int) -> None:
+        # Rows index the full padded bucket so vectorized row updates
+        # never bounds-check; pad rows/cols are edgeless and can never
+        # join a cycle (the pack_graph invariant).
+        self.cols = max(GRAPH_MIN_V, _pow2(n))
+        self._C = np.zeros(
+            (N_LEVELS, self.cols, max(1, self.cols // 32)), np.uint32)
+
+    def grow(self, n: int) -> None:
+        """Widen the vertex space to ``n``. Free within the padded
+        bucket; crossing it re-closes once at the wider shape."""
+        if n <= self.n:
+            return
+        self.n = n
+        if self._C is None:
+            self._alloc(n)
+            return
+        if n <= self.cols:
+            return                      # pad columns were always zero
+        self._alloc(n)
+        self.stats["recloses"] += 1
+        for li in range(N_LEVELS):
+            for u, v in self.edges[li]:
+                self._apply(li, u, v)
+
+    def _apply(self, li: int, u: int, v: int) -> bool:
+        """Close levels >= li under the new edge u → v against the
+        existing closure. Returns False when the edge was already
+        implied at every affected level."""
+        C = self._C
+        touched = False
+        wv, bv = v // 32, np.uint32(1 << (v % 32))
+        for l in range(li, N_LEVELS):
+            if C[l, u, wv] & bv:
+                continue                # already implied at this level
+            # rows that reach u (plus u itself) gain v's reach plus v.
+            pred = (C[l, :, u // 32]
+                    & np.uint32(1 << (u % 32))).astype(bool)
+            pred[u] = True
+            reach = C[l, v].copy()
+            reach[wv] |= bv
+            C[l, pred] |= reach
+            self.stats["row_updates"] += int(pred.sum())
+            touched = True
+        return touched
+
+    # --------------------------------------------------------- updates
+    def add_edge(self, etype: str, u: int, v: int) -> None:
+        """One dependency edge of EDGE_TYPES kind ``etype`` (levels it
+        belongs to follow the cumulative LEVEL_TYPES masks)."""
+        hi = max(int(u), int(v)) + 1
+        if hi > self.n:
+            self.grow(hi)
+        li = next(i for i, types in enumerate(LEVEL_TYPES)
+                  if etype in types)
+        self.edges[li].append((int(u), int(v)))
+        self.stats["edges"] += 1
+        if not self._apply(li, int(u), int(v)):
+            self.stats["implied"] += 1
+
+    def add_edges(self, etype: str, pairs) -> None:
+        for u, v in pairs:
+            self.add_edge(etype, u, v)
+
+    # --------------------------------------------------------- verdict
+    def reaches(self, li: int, u: int, v: int) -> bool:
+        return bool(self._C is not None
+                    and self._C[li, u, v // 32]
+                    & np.uint32(1 << (v % 32)))
+
+    def cyclic_levels(self) -> List[bool]:
+        """Per cumulative level: does the closure hold a diagonal bit?
+        (The device kernel's ``cyc`` output, derived incrementally.)"""
+        if self._C is None:
+            return [False] * N_LEVELS
+        idx = np.arange(self.n)
+        return [bool((self._C[l, idx, idx // 32]
+                      >> (idx % 32).astype(np.uint32) & 1).any())
+                for l in range(N_LEVELS)]
+
+    def anomaly(self) -> Optional[str]:
+        """The running verdict: the FIRST cumulative level whose mask
+        closed into a cycle, or None. Monotone in the edge stream."""
+        for li, cyc in enumerate(self.cyclic_levels()):
+            if cyc:
+                return LEVELS[li]
+        return None

@@ -679,6 +679,133 @@ def run_event_chunked(batch: EncodedBatch, events_per_chunk: int,
     return valid, bad, frontier
 
 
+# ------------------------------------------------ carried-frontier seam
+#
+# The kernel contract's resume variant (make_kernel(resume=True)) is the
+# seam the ONLINE incremental path rides: the packed carry — configs-so-
+# far frontier F, the latched pre-failure frontier Fbad, and the running
+# (valid, bad) verdict — flows OUT of one dispatch and back INTO the
+# next, so a live tenant's rolling prefix check resumes from where the
+# last tick stopped instead of re-walking from op 0 (ROADMAP item 2 /
+# the decrease-and-conquer monitoring argument, arXiv 2410.04581).
+# run_event_chunked uses the same carry within one call; these helpers
+# let a caller hold it ACROSS calls — and across processes, via the
+# export/import pair (zlib+b64, the journal frontier-checkpoint row's
+# payload). The Pallas megakernel has no resume entry (its frontier
+# lives in VMEM for exactly one launch — ops.pallas_wgl), so carried
+# dispatch always rides the lax.scan kernel.
+
+# Event-axis chunk for carried dispatch; shapes pad to the power-of-two
+# ladder (floor CARRY_QUANTUM) so a daemon's varying tick sizes share a
+# handful of compiled shapes per (V, W) instead of one per length.
+CARRY_EVENT_CHUNK = 2048
+CARRY_QUANTUM = 64
+
+
+def frontier_carry_init(V: int, W: int) -> dict:
+    """A fresh single-row carry: the initial config (state 0, empty
+    mask) present, verdict valid, no bad event."""
+    NW, M = n_state_words(V), 1 << W
+    F = np.zeros((1, NW, M), np.uint32)
+    F[0, 0, 0] = 1
+    return {"valid": np.ones(1, bool),
+            "bad": np.full(1, INT32_MAX, np.int32),
+            "F": F,
+            "Fb": np.zeros((1, NW, M), np.uint32)}
+
+
+def run_carried_events(V: int, W: int, target: np.ndarray,
+                       ev_type: np.ndarray, ev_slot: np.ndarray,
+                       ev_slots: np.ndarray, idx0: int,
+                       carry: dict) -> dict:
+    """Advance a carried frontier over ``N`` new events (single row,
+    shared target) and return the new carry, host-materialized. Events
+    are dispatched in CARRY_EVENT_CHUNK steps padded to the power-of-
+    two ladder (EV_PAD steps are no-ops on the scan), so one tenant
+    compiles a bounded shape set however its tick sizes vary. ``bad``
+    in the carry is a GLOBAL event ordinal (``idx0`` continues the
+    tenant's event numbering across calls)."""
+    N = int(ev_type.shape[0])
+    kern = get_kernel(V, W, shared_target=True, resume=True)
+    out = (carry["valid"], carry["bad"], carry["F"], carry["Fb"])
+    tgt = np.ascontiguousarray(target)
+    for lo in range(0, N, CARRY_EVENT_CHUNK):
+        hi = min(lo + CARRY_EVENT_CHUNK, N)
+        n = hi - lo
+        C = min(CARRY_EVENT_CHUNK,
+                max(CARRY_QUANTUM, 1 << (n - 1).bit_length()))
+        et = np.zeros((1, C), np.int8)
+        es = np.zeros((1, C), np.int8)
+        ess = np.full((1, C, W), target.shape[0] - 1, np.int32)
+        et[0, :n] = ev_type[lo:hi]
+        es[0, :n] = ev_slot[lo:hi]
+        ess[0, :n] = ev_slots[lo:hi]
+        log_kernel_shapes(V, W, "data1carry", True, False, 1, C, W)
+        out = kern(et, es, ess, tgt, np.int32(idx0 + lo),
+                   out[2], out[3], out[0], out[1])
+    return {"valid": np.asarray(out[0]), "bad": np.asarray(out[1]),
+            "F": np.asarray(out[2]), "Fb": np.asarray(out[3])}
+
+
+def export_frontier(carry: dict) -> dict:
+    """Serialize a carry for the journal frontier-checkpoint row
+    (doc/online.md documents the format). The packed bitsets compress
+    hard (config sets are sparse), so the row stays journal-sized."""
+    import base64
+    import zlib
+
+    def pack(a):
+        return base64.b64encode(
+            zlib.compress(np.ascontiguousarray(a).tobytes())).decode()
+
+    return {"v": 1, "shape": list(carry["F"].shape),
+            "valid": bool(carry["valid"][0]),
+            "bad": int(carry["bad"][0]),
+            "F": pack(carry["F"]), "Fb": pack(carry["Fb"])}
+
+
+def import_frontier(d: dict, V: int, W: int) -> Optional[dict]:
+    """Deserialize an exported carry; None on any mismatch (a stale or
+    foreign checkpoint is a cache miss, never a failure mode)."""
+    import base64
+    import zlib
+    try:
+        if d.get("v") != 1:
+            return None
+        shape = tuple(d["shape"])
+        if shape != (1, n_state_words(V), 1 << W):
+            return None
+
+        def unpack(s):
+            a = np.frombuffer(zlib.decompress(base64.b64decode(s)),
+                              np.uint32)
+            return a.reshape(shape).copy()
+
+        return {"valid": np.array([bool(d["valid"])]),
+                "bad": np.array([int(d["bad"])], np.int32),
+                "F": unpack(d["F"]), "Fb": unpack(d["Fb"])}
+    except Exception:
+        return None
+
+
+def grow_frontier_states(carry: dict, old_words: int,
+                         new_words: int) -> dict:
+    """Widen a carry's state axis (appended vocabulary reached new
+    states past the current word pad): new states' bits start 0 in
+    every config, which is exactly right — no existing config holds
+    them. The mask axis (2^W) is untouched."""
+    if new_words == old_words:
+        return carry
+    assert new_words > old_words
+    out = dict(carry)
+    for k in ("F", "Fb"):
+        a = carry[k]
+        wide = np.zeros((a.shape[0], new_words, a.shape[2]), np.uint32)
+        wide[:, :old_words] = a
+        out[k] = wide
+    return out
+
+
 def fused_bad_rows(batch: EncodedBatch, valid, bad) -> np.ndarray:
     """Row positions (within ``batch``) whose first impossible
     completion landed on an EV_FUSED step. The device only knows such

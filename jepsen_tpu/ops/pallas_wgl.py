@@ -120,6 +120,19 @@ def pallas_supports(V: int, W: int) -> bool:
         1 <= int(V) <= PALLAS_MAX_STATES
 
 
+def pallas_supports_resume() -> bool:
+    """The kernel-contract resume seam (make_kernel(resume=True) —
+    the packed carry flowing OUT of one dispatch and back IN to the
+    next) has no Pallas twin: this kernel's frontier is VMEM-resident
+    for exactly one launch and never round-trips through HBM between
+    dispatches — that residency IS its launch economics. The online
+    incremental path (ops.schedule.ResidentFrontier) therefore always
+    carries its frontier through the lax.scan resume kernel; the
+    router prices the delta path accordingly (fleet.CostRouter
+    .price_online_tick)."""
+    return False
+
+
 # --------------------------------------------------------- kernel body
 
 def _kernel_body(V: int, W: int, WL: int, EB: int, shared: bool):

@@ -161,14 +161,26 @@ def tenant_price(est_w: int, est_ops: int, caps: dict) -> float:
     (un-chunked long dispatches penalized on workers without the
     event-chunked resume kernel), the host oracle is near-W-flat, and
     a W past the worker's admission bound rides the host there
-    regardless of price."""
+    regardless of price.
+
+    A worker advertising ``incremental`` (the resident-frontier delta
+    path, $JT_ONLINE_INCREMENTAL) charges its device interim checks
+    against the DELTA — ``delta_ops``, its check interval — not the
+    whole prefix: on such workers a long tenant's per-tick cost is
+    flat in prefix length (fleet.CostRouter.price_online_tick is the
+    same arithmetic), so long tenants steer toward frontier-capable
+    workers exactly as wide ones steer toward host-oracle-rich ones."""
+    from .fleet import online_tick_costs
     rates = caps.get("rates") or {}
-    lane = float(rates.get("lane_ops_per_s") or 1e8)
-    host_rate = float(rates.get("host_s_per_event") or 4e-4)
     ev = max(int(est_ops), 1)
-    host = ev * host_rate
-    dev = ev * float(1 << min(max(int(est_w), 0), 30)) / lane
-    if not caps.get("event_route") and ev >= int(
+    inc = bool(caps.get("incremental"))
+    delta = min(ev, max(int(caps.get("delta_ops") or 0), 1))
+    costs = online_tick_costs(
+        est_w, ev, delta, incremental=inc,
+        lane_ops_per_s=float(rates.get("lane_ops_per_s") or 1e8),
+        host_s_per_event=float(rates.get("host_s_per_event") or 4e-4))
+    dev, host = costs["wgl-device"], costs["host-oracle"]
+    if not inc and not caps.get("event_route") and ev >= int(
             caps.get("event_route_events") or 8192):
         # No resume kernel: a long prefix re-dispatches monolithically.
         dev *= 4.0
@@ -287,7 +299,12 @@ class ServiceWorker(OnlineDaemon):
                 "max_w": self.cfg.max_w,
                 "rates": rates,
                 "event_route": ev_route > 0,
-                "event_route_events": ev_route or 8192}
+                "event_route_events": ev_route or 8192,
+                # The resident-frontier delta path: peers price this
+                # worker's interim checks against the delta, not the
+                # prefix (tenant_price).
+                "incremental": bool(self.cfg.incremental),
+                "delta_ops": max(self.cfg.check_interval_ops, 1)}
 
     def _svc_count(self, key: str, n: int = 1) -> None:
         self.stats[key] = self.stats.get(key, 0) + n

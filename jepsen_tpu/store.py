@@ -816,6 +816,15 @@ class ChunkJournal:
     twice — the journal is also the enforcement point for the
     no-chunk-redispatched invariant. ``finish()`` deletes the file: a
     journal only outlives an interrupted run.
+
+    Besides chunk-verdict rows the journal accepts **frontier-
+    checkpoint rows** (``{"frontier": {...}}``, record_frontier): the
+    online daemon's carried WGL search state (ops.schedule
+    .ResidentFrontier.export), bound to the same key — writer
+    incarnation + segment inode — as every decided prefix. Latest row
+    wins on load (``frontier()``); a restarted daemon or a takeover
+    worker resumes the carry and re-dispatches only the undecided
+    suffix (doc/online.md documents the format).
     """
 
     def __init__(self, path, key: dict, resume: bool = False):
@@ -823,11 +832,13 @@ class ChunkJournal:
         self.key = dict(key)
         self.resume_hits = 0
         self._decided: Dict[int, tuple] = {}
+        self._frontier: Optional[dict] = None
+        self._stale_frontier_rows = 0
         self._good_end = 0     # byte offset past the last clean line
         if resume and self.path.exists():
             self._load()
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        if self._decided:
+        if self._decided or self._frontier is not None:
             # Drop the torn tail BEFORE appending: writing after a
             # partial line would weld two records into one unparseable
             # line, and a later resume would silently discard
@@ -861,6 +872,9 @@ class ChunkJournal:
                                 "starting fresh", self.path)
                             return
                         header_seen = True
+                    elif "frontier" in e:
+                        # Frontier-checkpoint row: latest wins.
+                        self._frontier = e["frontier"]
                     else:
                         for r, v, b, p in zip(e["rows"], e["valid"],
                                               e["bad"], e["prov"]):
@@ -905,6 +919,62 @@ class ChunkJournal:
                  "prov": prov}) + "\n")
             self._flush()
         telemetry.REGISTRY.counter("journal.rows").inc(len(rows))
+
+    def frontier(self) -> Optional[dict]:
+        """The latest frontier-checkpoint payload recovered on resume,
+        or None when no checkpoint row survived."""
+        return self._frontier
+
+    #: Superseded frontier rows tolerated before the journal compacts
+    #: in place: only the LATEST checkpoint is ever used, so a
+    #: long-lived tenant must not grow the file by one dead bitset row
+    #: per tick forever.
+    FRONTIER_COMPACT_EVERY = 64
+
+    def record_frontier(self, payload: dict) -> None:
+        """Append one frontier-checkpoint row (fsynced, like every
+        chunk verdict): the resumed carry is durable the moment the
+        call returns — a SIGKILL between ticks loses at most the ticks
+        since the last checkpoint, never a decided prefix. Every
+        FRONTIER_COMPACT_EVERY rows the journal rewrites itself
+        (atomic tmp+rename) down to the header, the decided rows, and
+        this one checkpoint — dead rows never accumulate."""
+        with telemetry.span("journal.frontier"):
+            self._frontier = payload
+            self._stale_frontier_rows += 1
+            if self._stale_frontier_rows >= self.FRONTIER_COMPACT_EVERY:
+                self._compact()
+            else:
+                self._f.write(json.dumps({"frontier": payload}) + "\n")
+                self._flush()
+        telemetry.REGISTRY.counter("journal.frontier_rows").inc()
+
+    def _compact(self) -> None:
+        """Rewrite the journal as header + one consolidated decided-
+        rows record + the latest frontier row, atomically (a kill
+        mid-compact leaves either the old file or the new one, never a
+        torn hybrid)."""
+        tmp = self.path.parent / (self.path.name + f".tmp{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.write(json.dumps(
+                {"journal": JOURNAL_MAGIC, "key": self.key}) + "\n")
+            if self._decided:
+                rows = sorted(self._decided)
+                f.write(json.dumps({
+                    "rows": rows,
+                    "valid": [self._decided[r][0] for r in rows],
+                    "bad": [self._decided[r][1] for r in rows],
+                    "prov": [self._decided[r][2] for r in rows],
+                }) + "\n")
+            if self._frontier is not None:
+                f.write(json.dumps({"frontier": self._frontier}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "a")
+        self._flush()
+        self._stale_frontier_rows = 0
 
     def close(self) -> None:
         try:

@@ -51,6 +51,7 @@ td, th { padding: .3em .8em; border: 1px solid #ccc; text-align: left; }
 .badge-violation { background: #b03030; color: #fff; }
 .badge-clean { background: #3a8f3a; color: #fff; }
 .badge-fleet { background: #5b4fa2; color: #fff; }
+.badge-inc { background: #2a7f74; color: #fff; }
 a { text-decoration: none; }
 pre { background: #f7f7f7; padding: 1em; overflow-x: auto; }
 """
@@ -271,12 +272,18 @@ class Handler(BaseHTTPRequestHandler):
         t = (reg.get("tenants") or {}).get(f"{name}/{ts}")
         if t is None:
             return "—"
+        # Incremental-status badge: this tenant's interim checks are
+        # riding a resident device frontier (O(new ops) per tick —
+        # doc/online.md "The resident frontier").
+        inc = (' <span class="badge badge-inc">inc</span>'
+               if t.get("incremental") else "")
         if t.get("valid_so_far") is True:
             return (f'<span class="badge badge-clean">✓ so far '
-                    f"({t.get('checked_ops', 0)} ops)</span>")
+                    f"({t.get('checked_ops', 0)} ops)</span>{inc}")
         if t.get("valid_so_far") is False:
-            return '<span class="badge badge-violation">invalid</span>'
-        return html.escape(str(t.get("status", "watched")))
+            return ('<span class="badge badge-violation">invalid'
+                    f"</span>{inc}")
+        return html.escape(str(t.get("status", "watched"))) + inc
 
     def index(self):
         incomplete = set(self.store.incomplete(include_salvaged=False))

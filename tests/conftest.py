@@ -97,6 +97,15 @@ def pytest_configure(config):
                    "the traced-overhead gate (deterministic; runs in "
                    "tier-1)")
     config.addinivalue_line(
+        "markers", "incremental: incremental prefix checking — the "
+                   "per-tenant resident device frontier (O(new ops) "
+                   "per online tick): every-prefix parity vs the full "
+                   "engine, the strictly-fewer-events structural "
+                   "guard, frontier-checkpoint restart/takeover with "
+                   "zero re-dispatched decided events, invalidation "
+                   "fallbacks, and the JT_ONLINE_INCREMENTAL=0 "
+                   "restore switch (deterministic; runs in tier-1)")
+    config.addinivalue_line(
         "markers", "obsplane: cluster observability plane — durable "
                    "metrics series ring files, OpenMetrics exposition "
                    "validity, cross-worker trace correlation/merge, "

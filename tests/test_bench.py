@@ -30,6 +30,14 @@ def test_bench_emits_contract_json():
                JT_BENCH_XLONG_B="6", JT_BENCH_XLONG_OPS="2000",
                JT_BENCH_SYNTH_B="64", JT_BENCH_TRACE_B="64",
                JT_BENCH_ONLINE_TENANTS="2", JT_BENCH_ONLINE_OPS="24",
+               # Incremental subsection at toy scale: 2 tenants whose
+               # prefixes grow 4x over 4 stages, both modes — the
+               # tier-1 guard is the section's shape and the
+               # cross-mode verdict parity, not the cost curve
+               # (wall-clock flatness needs real scale).
+               JT_BENCH_ONLINE_INC_TENANTS="2",
+               JT_BENCH_ONLINE_INC_STAGES="4",
+               JT_BENCH_ONLINE_INC_PAIRS="4",
                # Fleet sweep at toy scale: 1 vs 2 real worker
                # processes over 2 seed units (the tier-1 guard is the
                # section's shape + JT_BENCH_FLEET=0 skippability, not
@@ -178,6 +186,23 @@ def test_bench_emits_contract_json():
     assert b["checks"] > 0 and b["valid_ok"] is True
     assert b["shed"] + b["deferred"] + b["widened"] > 0
     assert 0 <= b["shed_fraction"] <= 1
+    # Incremental prefix checking (ISSUE 14 acceptance shape): both
+    # modes ran, the delta path actually resumed a carried frontier,
+    # the restore switch dispatched zero deltas, and interim + final
+    # verdicts are field-for-field identical across the modes.
+    inc = on["incremental"]
+    assert inc["tenants"] == 2 and inc["prefix_growth"] == 4
+    assert set(inc["modes"]) == {"incremental", "full"}
+    mi = inc["modes"]["incremental"]
+    assert mi["checks"] > 0 and mi["frontier_resumes"] > 0
+    assert mi["delta_ops"] > 0 and mi["valid_ok"] is True
+    assert mi["ttfv_p99_s"] is not None and mi["verdicts_per_s"] > 0
+    assert len(mi["tick_cost_s"]) == 3
+    assert mi["cost_ratio_last_vs_first"] > 0
+    mf = inc["modes"]["full"]
+    assert mf["delta_ops"] == 0 and mf["frontier_resumes"] == 0
+    assert mf["valid_ok"] is True
+    assert inc["verdicts_match"] is True
     assert d["xlong_history"]["synth_s"] >= 0
     # Service section (ISSUE 11 acceptance): tenants-per-SLO vs real
     # worker processes, plus the kill-a-worker takeover probe with

@@ -530,3 +530,72 @@ def test_empty_history_and_empty_batch():
     assert check_graphs_batch([]) == []
     r = CycleChecker("list-append").check({}, None, index([]))
     assert r["valid"] is True and r["vertices"] == 0
+
+
+# -------------------------------------- incremental transitive closure
+
+@pytest.mark.incremental
+def test_incremental_closure_parity_on_every_prefix():
+    """ISSUE 14's graph-family move: the edge-at-a-time closure agrees
+    with the from-scratch host oracle on EVERY prefix of random typed
+    edge streams — anomaly level, reachability, monotone verdicts."""
+    from jepsen_tpu.ops.graph import IncrementalClosure
+    rng = random.Random(5)
+    for trial in range(8):
+        n = rng.randint(2, 24)
+        inc = IncrementalClosure()
+        typed = {t: [] for t in EDGE_TYPES}
+        prev = None
+        order = list(graph_mod.LEVELS)
+        for _ in range(rng.randint(8, 60)):
+            t = rng.choice(EDGE_TYPES)
+            u, v = rng.randrange(n), rng.randrange(n)
+            inc.add_edge(t, u, v)
+            typed[t].append((u, v))
+            edges = {ty: np.array(sorted(set(ps)),
+                                  np.int64).reshape(-1, 2)
+                     if ps else np.zeros((0, 2), np.int64)
+                     for ty, ps in typed.items()}
+            g = DepGraph(n=inc.n, edges=edges, meta={})
+            want = check_graph_host(g)["anomaly"]
+            got = inc.anomaly()
+            assert got == want, (trial, got, want)
+            # Monotone: a cyclic level never un-cycles, and the
+            # first-cyclic level can only move earlier in the ladder.
+            if prev is not None:
+                assert got is not None
+                assert order.index(got) <= order.index(prev)
+            prev = got
+
+
+@pytest.mark.incremental
+def test_incremental_closure_implied_edges_are_free():
+    from jepsen_tpu.ops.graph import IncrementalClosure
+    inc = IncrementalClosure()
+    inc.add_edge("ww", 0, 1)
+    inc.add_edge("ww", 1, 2)
+    updates = inc.stats["row_updates"]
+    inc.add_edge("ww", 0, 2)           # already in the closure
+    assert inc.stats["row_updates"] == updates
+    assert inc.stats["implied"] == 1
+    assert inc.anomaly() is None
+    inc.add_edge("ww", 2, 0)           # closes the G0 cycle
+    assert inc.anomaly() == "G0"
+
+
+@pytest.mark.incremental
+def test_incremental_closure_bucket_growth_recloses_once():
+    """Within the padded vertex bucket growth is free; crossing it
+    pays exactly one full re-closure and stays incremental after."""
+    from jepsen_tpu.ops.graph import IncrementalClosure
+    inc = IncrementalClosure()
+    inc.add_edge("wr", 0, 5)           # bucket = 8
+    assert inc.cols == 8 and inc.stats["recloses"] == 0
+    inc.add_edge("wr", 5, 7)           # still inside the bucket
+    assert inc.stats["recloses"] == 0
+    inc.add_edge("wr", 7, 11)          # crosses into bucket 16
+    assert inc.cols == 16 and inc.stats["recloses"] == 1
+    assert inc.reaches(1, 0, 11)       # closure survived the re-close
+    inc.add_edge("rw", 11, 0)          # rw is G2-only
+    assert inc.anomaly() == "G2"
+    assert inc.stats["recloses"] == 1

@@ -1,0 +1,506 @@
+"""Incremental prefix checking (ISSUE 14): the per-tenant resident
+device frontier that makes the online daemon O(new ops) per tick.
+
+Tier-1 gates:
+  * every-prefix parity: the carried-frontier verdict (valid AND first
+    bad op) equals the full engine's on every prefix of concurrent
+    histories with dangling/failed/:info ops — including across an
+    export/restore round trip;
+  * the O(new ops) structural guard: a 3-tick growing-prefix tenant
+    dispatches strictly fewer events on ticks 2-3 than tick 1;
+  * restart: a SIGKILLed daemon's successor restores the journal
+    frontier checkpoint and dispatches only the undecided suffix
+    (journal double-decide refusal is the structural proof), final
+    verdict identical to the full engine;
+  * takeover (PR-11): a dead worker's tenant resumes on the survivor
+    from the same inode-bound checkpoint;
+  * the soundness guard: rotation and mid-dispatch faults invalidate
+    the carried frontier (counted) and fall back to the full-prefix
+    check — verdicts unchanged, also under the whole daemon
+    fault-schedule sweep;
+  * the JT_ONLINE_INCREMENTAL=0 restore switch: bit-for-bit the
+    pre-frontier daemon (zero delta checks, same verdicts).
+"""
+import json
+import os
+import random
+import time
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.history.codec import dumps_op, write_jsonl
+from jepsen_tpu.history.core import index
+from jepsen_tpu.history.ops import (FAIL, INFO, INVOKE, OK, Op,
+                                    invoke_op, ok_op)
+from jepsen_tpu.history.wal import WAL_FILE, WAL_MAGIC
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.online import (DaemonFaultInjector, OnlineConfig,
+                               OnlineDaemon, checkable_prefix,
+                               daemon_fault_schedules)
+from jepsen_tpu.ops.linearize import check_batch_columnar
+from jepsen_tpu.ops.schedule import FrontierInvalid, ResidentFrontier
+from jepsen_tpu.store import Store, atomic_write_json
+
+pytestmark = [pytest.mark.online, pytest.mark.incremental]
+
+DEAD_PID = 2 ** 22 + 12345
+
+
+# ------------------------------------------------------------- builders
+
+def cyc_ops(n_pairs, start_index=0, start_pair=0, mod=3,
+            corrupt_read=None):
+    """Bounded-vocabulary register pairs: write (k % mod) + 1 / read it
+    back — the vocabulary (and state space) stops growing after the
+    first ``mod`` pairs, the live-stream shape the delta path keeps
+    flat."""
+    ops, idx = [], start_index
+    for k in range(start_pair, start_pair + n_pairs):
+        v = (k % mod) + 1
+        rv = 999 if corrupt_read == k else v
+        for op in (invoke_op(0, "write", v), ok_op(0, "write", v),
+                   invoke_op(0, "read", None), ok_op(0, "read", rv)):
+            op.index = idx
+            idx += 1
+            ops.append(op)
+    return ops
+
+
+def wal_lines(name, ops, pid=DEAD_PID, seed=0, analyzed=False):
+    lines = [json.dumps({"wal": WAL_MAGIC, "test": {"name": name},
+                         "seed": seed, "pid": pid, "phase": "setup"}),
+             json.dumps({"phase": "run", "wal_ops": 0})]
+    lines += [dumps_op(o) for o in ops]
+    if analyzed:
+        lines.append(json.dumps({"phase": "analyzed",
+                                 "wal_ops": len(ops)}))
+    return lines
+
+
+def mkrun(base, name, ts, ops, **kw):
+    d = Path(base) / name / ts
+    d.mkdir(parents=True, exist_ok=True)
+    (d / WAL_FILE).write_text(
+        "\n".join(wal_lines(name, ops, **kw)) + "\n")
+    return d
+
+
+def append_wal(d, ops, analyzed=False, n_total=None):
+    lines = [dumps_op(o) for o in ops]
+    if analyzed:
+        lines.append(json.dumps(
+            {"phase": "analyzed",
+             "wal_ops": n_total if n_total is not None else len(ops)}))
+    with open(Path(d) / WAL_FILE, "a") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def cfg(**kw):
+    kw.setdefault("model", cas_register())
+    kw.setdefault("poll_s", 0)
+    kw.setdefault("check_interval_ops", 4)
+    kw.setdefault("crash_quiet_s", 3600)
+    return OnlineConfig(**kw)
+
+
+def synth_concurrent(seed, n=90, procs=4, vals=4, p_fail=0.1,
+                     p_info=0.05):
+    """Concurrent register stream with failed pairs, :info ops, and
+    dangling invocations — the frontier walk's full case analysis."""
+    rng = random.Random(seed)
+    ops, open_ = [], {}
+    while len(ops) < n:
+        if open_ and (len(open_) >= procs or rng.random() < 0.5):
+            pr = rng.choice(sorted(open_))
+            f, v = open_.pop(pr)
+            r = rng.random()
+            if r < p_fail:
+                ops.append(Op(process=pr, type=FAIL, f=f, value=v))
+            elif r < p_fail + p_info:
+                ops.append(Op(process=pr, type=INFO, f=f, value=v))
+            else:
+                val = v if f == "write" else rng.randint(1, vals)
+                ops.append(Op(process=pr, type=OK, f=f, value=val))
+        else:
+            pr = rng.choice([p for p in range(procs)
+                             if p not in open_])
+            f, v = (("write", rng.randint(1, vals))
+                    if rng.random() < 0.5 else ("read", None))
+            open_[pr] = (f, v)
+            ops.append(Op(process=pr, type=INVOKE, f=f, value=v))
+    for i, o in enumerate(ops):
+        o.index = i
+    return ops
+
+
+def full_verdict(model, ops):
+    r = check_batch_columnar(model, [checkable_prefix(ops)],
+                             details="invalid")[0]
+    if r["valid"]:
+        return True, None
+    op = r["op"]
+    return False, (op.get("index") if isinstance(op, dict)
+                   else op.index)
+
+
+# ----------------------------------------------------- frontier parity
+
+def test_every_prefix_parity_with_full_engine():
+    """The acceptance invariant at unit scale: the carried frontier's
+    (valid, first-bad-op) equals the full engine's on EVERY prefix —
+    concurrency, dangling invocations, failed pairs, :info ops,
+    growing vocabulary — with invalidation falling back to an exact
+    rebuild."""
+    model = cas_register()
+    for seed in range(3):
+        ops = synth_concurrent(seed)
+        fr = ResidentFrontier(model)
+        for k in range(1, len(ops) + 1, 11):
+            try:
+                got = fr.advance(ops[:k])
+            except FrontierInvalid:
+                fr = ResidentFrontier(model)
+                got = fr.advance(ops[:k])
+            assert got == full_verdict(model, ops[:k]), (seed, k)
+
+
+def test_export_restore_round_trip_continues_exactly():
+    model = cas_register()
+    ops = synth_concurrent(11, n=80)
+    fr = ResidentFrontier(model)
+    fr.advance(ops[:40])
+    payload = json.loads(json.dumps(fr.export()))   # disk round trip
+    fr2 = ResidentFrontier.restore(model, payload)
+    assert fr2 is not None
+    assert fr2.pos == fr.pos and fr2.n_events == fr.n_events
+    assert fr2.advance(ops) == fr.advance(ops)
+    assert fr.advance(ops) == full_verdict(model, ops)
+
+
+def test_restore_refuses_mismatched_checkpoint():
+    model = cas_register()
+    ops = cyc_ops(6)
+    fr = ResidentFrontier(model)
+    fr.advance(ops)
+    bad = fr.export()
+    bad["table"] = bad["table"] + [0]       # window width mismatch
+    assert ResidentFrontier.restore(model, bad) is None
+    assert ResidentFrontier.restore(model, {"v": 99}) is None
+
+
+# ------------------------------------------- O(new ops) structural guard
+
+def test_three_tick_growing_prefix_dispatches_fewer_events(tmp_path):
+    """THE tier-1 guard for the O(new ops) property: ticks 2-3 of a
+    growing-prefix tenant dispatch strictly fewer events than tick 1
+    (which pays the full bootstrap) — no wall-clock, pure structure."""
+    base = tmp_path / "store"
+    d = mkrun(base, "reg", "r1", cyc_ops(10), pid=os.getpid())
+    daemon = OnlineDaemon(store=Store(base), config=cfg())
+    daemon.tick()
+    t = daemon.tenants[("reg", "r1")]
+    ev1 = t.stats["delta_events_last"]
+    assert t.stats["delta_checks"] == 1 and ev1 > 0
+    per_tick = []
+    for stage in range(2):
+        append_wal(d, cyc_ops(2, start_index=40 + 8 * stage,
+                              start_pair=10 + 2 * stage))
+        daemon.tick()
+        per_tick.append(t.stats["delta_events_last"])
+    assert t.stats["delta_checks"] == 3
+    assert all(ev < ev1 for ev in per_tick), (ev1, per_tick)
+    assert daemon.stats["frontier_resumes"] >= 2
+    assert daemon.stats["delta_ops"] >= 16
+    # Per-tenant labeled counters (the ISSUE telemetry surface).
+    assert (telemetry.REGISTRY.get("online.delta_ops{tenant=reg}")
+            or 0) > 0
+    assert t.summary()["incremental"] is True
+    daemon.close()
+
+
+def test_restore_switch_disables_delta_path(tmp_path):
+    """JT_ONLINE_INCREMENTAL=0 (here: config False) is the restore
+    switch: zero delta checks, zero frontiers, same verdicts."""
+    base = tmp_path / "store"
+    d = mkrun(base, "reg", "r1", cyc_ops(4), pid=os.getpid())
+    daemon = OnlineDaemon(store=Store(base),
+                          config=cfg(incremental=False))
+    daemon.tick()
+    append_wal(d, cyc_ops(2, start_index=16, start_pair=4))
+    daemon.tick()
+    t = daemon.tenants[("reg", "r1")]
+    assert t.stats["checks"] == 2 and t.valid_so_far is True
+    assert t.stats.get("delta_checks", 0) == 0
+    assert not daemon.engine.resident.frontiers
+    assert daemon.stats["delta_ops"] == 0
+    daemon.close()
+
+
+def test_first_violation_parity_through_delta_path(tmp_path):
+    """The delta path flags the same first bad op, at the same interim
+    prefix, as the full engine would."""
+    base = tmp_path / "store"
+    ops = cyc_ops(5, corrupt_read=3)        # invalid at pair 3's read
+    mkrun(base, "reg", "r1", ops, pid=os.getpid())
+    daemon = OnlineDaemon(store=Store(base), config=cfg())
+    daemon.tick()
+    t = daemon.tenants[("reg", "r1")]
+    assert t.valid_so_far is False
+    want = full_verdict(cas_register(), ops)
+    fv = json.loads((Path(base) / "reg" / "r1"
+                     / "first-violation.json").read_text())
+    assert (False, fv["op_index"]) == want
+    assert fv["mode"] in ("online-delta", "online-rebuild")
+    daemon.close()
+
+
+# ----------------------------------------------- restart + takeover
+
+def test_daemon_sigkill_restart_resumes_checkpoint(tmp_path):
+    """A killed daemon's successor restores the frontier checkpoint
+    from the journal and dispatches ONLY the undecided suffix; the
+    decided prefixes never re-dispatch (ChunkJournal.record would
+    raise — structural), and the final verdict is the exact full
+    engine's."""
+    base = tmp_path / "store"
+    d = mkrun(base, "reg", "r1", cyc_ops(8), pid=os.getpid())
+    d1 = OnlineDaemon(store=Store(base), config=cfg())
+    d1.tick()
+    append_wal(d, cyc_ops(2, start_index=32, start_pair=8))
+    d1.tick()
+    t1 = d1.tenants[("reg", "r1")]
+    assert t1.stats["delta_checks"] == 2
+    # SIGKILL: no close(), no finalize — every journal row (verdicts
+    # AND frontier checkpoints) was fsynced at record time.
+    del d1, t1
+
+    d2 = OnlineDaemon(store=Store(base), config=cfg())
+    d2.tick()                         # same content: zero work
+    t = d2.tenants[("reg", "r1")]
+    assert t.stats["resumed_prefixes"] == 2
+    assert t.stats["checks"] == 0 and d2.stats["check_errors"] == 0
+    append_wal(d, cyc_ops(2, start_index=40, start_pair=10))
+    d2.tick()                         # only the undecided suffix
+    assert t.stats["checks"] == 1
+    assert t.stats.get("frontier_restored") == 1
+    assert t.stats["delta_events_last"] < 20   # suffix, not 48 ops
+    assert t.valid_so_far is True
+    full = index([o.with_() for o in cyc_ops(12)])
+    write_jsonl(d / "history.jsonl", full)
+    append_wal(d, [], analyzed=True, n_total=48)
+    d2.tick()
+    assert t.status == "done" and t.result["valid"] is True
+    d2.close()
+
+
+def test_worker_takeover_resumes_frontier_checkpoint(tmp_path):
+    """PR-11: the frontier checkpoint rides takeover — the survivor
+    resumes the dead worker's carry from the shared inode-bound
+    journal and dispatches only the suffix."""
+    from jepsen_tpu.service import ServiceWorker
+    base = tmp_path / "store"
+    store = Store(base)
+    d = mkrun(base, "t0", "r1", cyc_ops(8), pid=os.getpid())
+    A = ServiceWorker(store=store, config=cfg(), worker_id="wA",
+                      lease_ttl=60.0, stagger_s=0)
+    A.tick()
+    tA = A.tenants[("t0", "r1")]
+    assert tA.stats["delta_checks"] == 1
+    # A dies holding the lease: age it past the TTL.
+    lp = store.service_tenant_lease_path("t0", "r1")
+    rec = json.loads(lp.read_text())
+    rec["hb"] = time.time() - 999
+    atomic_write_json(lp, rec)
+    del A, tA                          # SIGKILL: nothing closed
+
+    B = ServiceWorker(store=store, config=cfg(), worker_id="wB",
+                      lease_ttl=60.0, stagger_s=0, claim_budget=8)
+    B.tick()
+    assert B.stats["takeovers"] == 1
+    t = B.tenants[("t0", "r1")]
+    assert t.stats["resumed_prefixes"] >= 1
+    assert t.stats["checks"] == 0      # zero re-dispatched prefixes
+    append_wal(d, cyc_ops(2, start_index=32, start_pair=8))
+    B.tick()
+    assert t.stats["checks"] == 1
+    assert t.stats.get("frontier_restored") == 1
+    assert t.stats["delta_events_last"] < 20
+    assert t.valid_so_far is True and B.stats["check_errors"] == 0
+    B.close()
+
+
+# ------------------------------------------------- invalidation guard
+
+def test_rotation_invalidates_frontier(tmp_path):
+    base = tmp_path / "store"
+    d = mkrun(base, "reg", "r1", cyc_ops(4), pid=os.getpid(), seed=1)
+    daemon = OnlineDaemon(store=Store(base), config=cfg())
+    daemon.tick()
+    assert daemon.engine.resident.frontiers
+    fresh = tmp_path / "w.new"
+    fresh.write_text("\n".join(
+        wal_lines("reg", cyc_ops(3), pid=os.getpid(), seed=2)) + "\n")
+    os.replace(fresh, d / WAL_FILE)
+    daemon.tick()
+    t = daemon.tenants[("reg", "r1")]
+    assert t.rotations == 1
+    assert daemon.stats["frontier_invalidations"] >= 1
+    assert t.valid_so_far is True and t.checked_ops == 12
+    daemon.close()
+
+
+def test_mid_dispatch_fault_invalidates_and_falls_back(tmp_path,
+                                                       monkeypatch):
+    """Any fault inside a delta advance drops the carried frontier
+    (never a poisoned carry) and the next tick's full-prefix rebuild
+    decides the same verdict."""
+    base = tmp_path / "store"
+    d = mkrun(base, "reg", "r1", cyc_ops(4), pid=os.getpid())
+    daemon = OnlineDaemon(store=Store(base), config=cfg())
+    daemon.tick()
+    assert daemon.engine.resident.frontiers
+    import jepsen_tpu.ops.linearize as lin
+    real = lin.run_carried_events
+    boom = {"n": 0}
+
+    def flaky(*a, **kw):
+        boom["n"] += 1
+        raise RuntimeError("injected device fault")
+
+    monkeypatch.setattr(lin, "run_carried_events", flaky)
+    append_wal(d, cyc_ops(2, start_index=16, start_pair=4))
+    daemon.tick()                       # fault: tick absorbed
+    assert boom["n"] == 1
+    assert daemon.stats["check_errors"] == 1
+    assert daemon.stats["frontier_invalidations"] == 1
+    assert not daemon.engine.resident.frontiers
+    monkeypatch.setattr(lin, "run_carried_events", real)
+    daemon.tick()                       # full rebuild, same verdict
+    t = daemon.tenants[("reg", "r1")]
+    assert t.checked_ops == 24 and t.valid_so_far is True
+    daemon.close()
+
+
+def test_window_growth_rebuilds_wider(tmp_path):
+    """A concurrency burst past the carried mask axis rebuilds the
+    frontier at a wider W — verdict parity retained."""
+    model = cas_register()
+    ops = cyc_ops(4)                    # W=1 stream...
+    burst = []
+    for p in range(1, 5):               # ...then 4 concurrent writers
+        op = invoke_op(p, "write", 1)
+        burst.append(op)
+    for p in range(1, 5):
+        burst.append(ok_op(p, "write", 1))
+    allops = index([o.with_() for o in ops + burst])
+    fr = ResidentFrontier(model)
+    assert fr.advance(allops[:16]) == (True, None)
+    with pytest.raises(FrontierInvalid):
+        fr.advance(allops)              # window outgrew the mask axis
+    fr2 = ResidentFrontier(model)
+    assert fr2.advance(allops) == full_verdict(model, allops)
+    assert fr2.W > 2
+
+
+def test_parity_under_daemon_fault_schedule_sweep(tmp_path):
+    """The daemon fault-schedule matrix over an incremental tenant:
+    every schedule engages, costs at most retried ticks, and the final
+    verdict equals the fault-free daemon's."""
+    model = cas_register()
+    ops = cyc_ops(6, corrupt_read=4)
+    want = None
+    for label, plan in [("none", None)] + daemon_fault_schedules():
+        base = tmp_path / label.replace("@", "_")
+        d = mkrun(base, "reg", "r1", ops, pid=DEAD_PID)
+        write_jsonl(d / "history.jsonl",
+                    index([o.with_() for o in ops]))
+        append_wal(d, [], analyzed=True, n_total=len(ops))
+        inj = DaemonFaultInjector(plan) if plan is not None else None
+        daemon = OnlineDaemon(store=Store(base),
+                              config=cfg(crash_quiet_s=0), faults=inj)
+        for _ in range(4):
+            daemon.tick()
+            if daemon.idle() and daemon.tenants:
+                break
+        t = daemon.tenants[("reg", "r1")]
+        assert t.status == "done", label
+        if inj is not None:
+            assert inj.log, f"{label}: schedule never engaged"
+        if want is None:
+            want = t.result
+            assert want["valid"] is False
+        else:
+            assert t.result == want, label
+        daemon.close()
+
+
+# --------------------------------------------------- delta-path pricing
+
+def test_router_and_placement_price_the_delta_path():
+    """fleet.CostRouter.price_online_tick and service.tenant_price
+    share the delta arithmetic: incremental device cost tracks the
+    delta and stays flat as the prefix grows; full-recheck and host
+    costs grow with the prefix; caps without the ``incremental`` key
+    price exactly as before."""
+    from jepsen_tpu.fleet import CostRouter
+    from jepsen_tpu.service import tenant_price
+    r = CostRouter(rates={"lane_ops_per_s": 1e8,
+                          "host_s_per_event": 4e-4})
+    short = r.price_online_tick(4, 1_000, 64)
+    long_ = r.price_online_tick(4, 100_000, 64)
+    assert long_["wgl-device"] == short["wgl-device"]   # flat in prefix
+    assert long_["host-oracle"] > short["host-oracle"]
+    full = r.price_online_tick(4, 100_000, 64, incremental=False)
+    assert full["wgl-device"] > long_["wgl-device"]
+    r.price_online_tick(-1, 10, 1)                      # clamps, no raise
+    caps = {"rates": {"lane_ops_per_s": 1e8,
+                      "host_s_per_event": 4e-4},
+            "max_w": 14, "event_route": True}
+    base = tenant_price(4, 100_000, caps)
+    inc = tenant_price(4, 100_000,
+                       {**caps, "incremental": True, "delta_ops": 64})
+    assert inc < base                      # long tenants price cheaper
+    assert tenant_price(4, 100_000, dict(caps)) == base  # unchanged
+
+
+# ------------------------------------------------------ journal format
+
+def test_journal_frontier_compaction_bounds_the_file(tmp_path):
+    """Dead (superseded) frontier rows compact away: the file holds
+    the header, the decided rows, and the LATEST checkpoint — never
+    one stale bitset row per tick forever."""
+    from jepsen_tpu.store import ChunkJournal
+    p = tmp_path / "j.jsonl"
+    j = ChunkJournal(p, {"k": 1})
+    j.record([3], [False], [7], ["online"])
+    for i in range(3 * ChunkJournal.FRONTIER_COMPACT_EVERY):
+        j.record_frontier({"v": 1, "pos": i})
+    j.close()
+    lines = p.read_text().splitlines()
+    assert len(lines) <= ChunkJournal.FRONTIER_COMPACT_EVERY + 2
+    j2 = ChunkJournal(p, {"k": 1}, resume=True)
+    assert j2.frontier()["pos"] == 3 * j.FRONTIER_COMPACT_EVERY - 1
+    assert j2.decided() == {3: (False, 7, "online")}
+    j2.finish()
+
+
+def test_journal_frontier_rows_survive_and_latest_wins(tmp_path):
+    from jepsen_tpu.store import ChunkJournal
+    p = tmp_path / "j.jsonl"
+    j = ChunkJournal(p, {"k": 1})
+    j.record([0], [True], [None], ["online"])
+    j.record_frontier({"v": 1, "pos": 4})
+    j.record_frontier({"v": 1, "pos": 9})
+    j.close()
+    j2 = ChunkJournal(p, {"k": 1}, resume=True)
+    assert j2.frontier() == {"v": 1, "pos": 9}
+    assert j2.decided() == {0: (True, None, "online")}
+    with pytest.raises(ValueError):
+        j2.record([0], [True], [None], ["online"])   # double decide
+    j2.close()
+    # Key mismatch discards checkpoints with the rows.
+    j3 = ChunkJournal(p, {"k": 2}, resume=True)
+    assert j3.frontier() is None and j3.decided() == {}
+    j3.finish()
