@@ -263,17 +263,17 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
         # the generate-where-you-check path. Compile warms outside the
         # clock like every other section.
         synthesize(headline_spec, "device")
-        t0 = time.time()
+        t0 = time.monotonic()
         cols_raw, synth_meta = synthesize(headline_spec, "device")
-        t_synth = time.time() - t0
+        t_synth = time.monotonic() - t0
     else:
         # The legacy lockstep generator — byte-identical to r06.
-        t0 = time.time()
+        t0 = time.monotonic()
         cols_raw = synth_cas_columnar(B, seed=1, n_procs=5,
                                       n_ops=n_ops, n_values=5,
                                       corrupt=0.1, p_info=0.01,
                                       n_keys=n_keys)
-        t_synth = time.time() - t0
+        t_synth = time.monotonic() - t0
 
     from jepsen_tpu.ops.partition import (partition_columnar,
                                           pending_w_hist,
@@ -282,9 +282,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
     # metadata (pending_w_hist consults cols.meta; the post hist comes
     # straight off SynthMeta) — no full-batch line-grid re-scan.
     pre_w_hist = pending_w_hist(cols_raw)
-    t0 = time.time()
+    t0 = time.monotonic()
     pb = partition_columnar(cols_raw)
-    t_partition = time.time() - t0
+    t_partition = time.monotonic() - t0
     cols = pb.cols if pb is not None else cols_raw
     post_w_hist = (synth_meta.sub_w_hist()
                    if synth_meta is not None
@@ -335,9 +335,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
             buckets = buckets + wide
         return buckets, failures
 
-    t0 = time.time()
+    t0 = time.monotonic()
     buckets, failures = encode(cols)
-    t_encode = time.time() - t0
+    t_encode = time.monotonic() - t0
 
     try:
         from jepsen_tpu.native import check_batch_native, lib as _native_lib
@@ -423,9 +423,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
     # sched_stats["compiled_shapes"] is the headline compile count.
     sched_stats = {}
     aot_pre = dict(AOT_STATS)
-    t0 = time.time()
+    t0 = time.monotonic()
     pairs, cpu_tail_rs, refined = run_all(stats_out=sched_stats)
-    t_compile = time.time() - t0
+    t_compile = time.monotonic() - t0
     kernel_compiles = sched_stats.get("compiled_shapes")
     w_classes = sched_stats.get("classes")
     fusion_ratio = sched_stats.get("fusion_ratio")
@@ -440,9 +440,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
     import statistics
     times = []
     for _ in range(repeats):
-        t0 = time.time()
+        t0 = time.monotonic()
         pairs, cpu_tail_rs, refined = run_all()
-        times.append(time.time() - t0)
+        times.append(time.monotonic() - t0)
     t_dev = statistics.median(times)
 
     n_checked = sum(b.batch for b in dev_buckets) + len(cpu_rows)
@@ -497,9 +497,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
     run_streamed()        # warmup: streamed-only shapes compile here
     streamed_times, streamed_stats = [], {}
     for _ in range(max(2, repeats)):
-        t0 = time.time()
+        t0 = time.monotonic()
         n_streamed, streamed_stats = run_streamed()
-        streamed_times.append(time.time() - t0)
+        streamed_times.append(time.monotonic() - t0)
     t_streamed = statistics.median(streamed_times)
     # Per original history, like the headline (the streamed loop rides
     # the pre-strained sub batch; partition time is included so the
@@ -540,9 +540,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
     # published bandwidth figure.
     dts = []
     for _ in range(repeats):
-        t0 = time.time()
+        t0 = time.monotonic()
         list(BucketScheduler().run(dev_buckets))
-        dts.append(time.time() - t0)
+        dts.append(time.monotonic() - t0)
     t_dev_only = statistics.median(dts)
 
     # Measured VPU op count: one instrumented pass over the dispatched
@@ -653,9 +653,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
     parity_valid = parity_bad_index = parity_configs = None
     n_config_rows = 0
     if check_batch_native is not None and full_parity:
-        t0 = time.time()
+        t0 = time.monotonic()
         nrs = check_batch_native(model, conv_hists)
-        native_rate = round(S / (time.time() - t0), 2)
+        native_rate = round(S / (time.monotonic() - t0), 2)
         dev_rows = [r for r in range(S) if r not in skip]
         parity_valid = all(
             (nrs[r]["valid"] is True) == bool(dev_valid[r])
@@ -736,9 +736,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
     run_converted()                              # warm compiles
     conv_times = []
     for _ in range(max(2, repeats)):             # median-of-n vs the
-        t0 = time.time()                         # tunnel's jitter
+        t0 = time.monotonic()                         # tunnel's jitter
         cvalid = run_converted()
-        conv_times.append(time.time() - t0)
+        conv_times.append(time.monotonic() - t0)
     t_conv = statistics.median(conv_times)
     converted_rate = C / t_conv
     # Compare against the main run's verdicts where both were on-device.
@@ -769,9 +769,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
             store.recheck("bench-recheck", model)    # warm compiles
             store_times = []
             for _ in range(max(2, repeats)):         # median vs jitter
-                t0 = time.time()
+                t0 = time.monotonic()
                 rr = store.recheck("bench-recheck", model)
-                store_times.append(time.time() - t0)
+                store_times.append(time.monotonic() - t0)
             t_store = statistics.median(store_times)
             store_rate = round(SB / t_store, 2)
             want = [bool(dev_valid[i]) for i in range(SB)
@@ -805,9 +805,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
     FB = int(os.environ.get("JT_BENCH_FOLD_B", "2000"))
     fold_hists = [synth_tq(s) for s in range(FB)]
     check_total_queues_batch(fold_hists)         # warm (same shapes)
-    t0 = time.time()
+    t0 = time.monotonic()
     fold_rs = check_total_queues_batch(fold_hists)
-    fold_rate = FB / (time.time() - t0)
+    fold_rate = FB / (time.monotonic() - t0)
     fold_invalid = sum(1 for r in fold_rs if r["valid"] is not True)
 
     # Graph-checker extra: the second device checker family — batched
@@ -833,16 +833,16 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
         la_hists = [synth_la_history(s, n_ops=30,
                                      corrupt=1.0 if s % 7 == 0 else 0.0)
                     for s in range(GB)]
-        t0 = time.time()
+        t0 = time.monotonic()
         la_graphs = [extract_graph(h, "list-append") for h in la_hists]
-        t_extract = time.time() - t0
+        t_extract = time.monotonic() - t0
         check_graphs_batch(la_graphs)            # warm the compiles
         gtimes, gstats, grs = [], {}, []
         for _ in range(max(2, repeats)):
             gstats = {}
-            t0 = time.time()
+            t0 = time.monotonic()
             grs = check_graphs_batch(la_graphs, stats_out=gstats)
-            gtimes.append(time.time() - t0)
+            gtimes.append(time.monotonic() - t0)
         t_graph = statistics.median(gtimes)
         graph_section = {
             "graphs_per_s": round(GB / t_graph, 2),
@@ -896,9 +896,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
             t["active_histories"] = set()
             t["barrier"] = None
             t["wal"] = wal
-            t0 = time.time()
+            t0 = time.monotonic()
             _runtime.run_case(t)
-            return time.time() - t0
+            return time.monotonic() - t0
 
         _loop_time(seed=0)                            # warm the path
         t_off = statistics.median(
@@ -929,9 +929,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
             _loop_time(seed=999, wal=wal)
             wal.close()
             name, ts = st.incomplete()[0]
-            t0 = time.time()
+            t0 = time.monotonic()
             sv = st.salvage(name, ts)
-            t_salvage = time.time() - t0
+            t_salvage = time.monotonic() - t0
         durability_section = {
             "wal_ops": WOPS,
             "flush_ms": float(os.environ.get("JT_WAL_FLUSH_MS", "50")),
@@ -969,19 +969,19 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
         # is where the partition pays twice — per-sub scan LENGTH
         # drops n_keys-fold (the sequential axis the long probe is
         # bound by) on top of the W collapse.
-        t0 = time.time()
+        t0 = time.monotonic()
         c_raw = synth_cas_columnar(n_hist, seed=seed, n_procs=5,
                                    n_ops=n_ops, n_values=5,
                                    corrupt=0.1, p_info=0.0,
                                    n_keys=n_keys)
-        t_probe_synth = time.time() - t0
-        t0 = time.time()
+        t_probe_synth = time.monotonic() - t0
+        t0 = time.monotonic()
         p = partition_columnar(c_raw)
-        t_part = time.time() - t0
+        t_part = time.monotonic() - t0
         c = p.cols if p is not None else c_raw
-        t0 = time.time()
+        t0 = time.monotonic()
         bkts, fails = encode(c)
-        t_enc = time.time() - t0
+        t_enc = time.monotonic() - t0
         dev, over, fail = route(bkts, fails)
         cpu = over + fail
         if keep_dev is not None:
@@ -992,9 +992,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
         sch_stats = {}
         for _ in range(max(2, repeats)):
             sch = BucketScheduler(**so)
-            t0 = time.time()
+            t0 = time.monotonic()
             outs_p = [o for _, o in sch.run(dev)]
-            ts.append(time.time() - t0)
+            ts.append(time.monotonic() - t0)
             sch_stats = sch.stats
         t = statistics.median(ts)
         n = sum(b.batch for b in dev)
@@ -1086,10 +1086,10 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
                 run_event_chunked(b, echunk)
             ts = []
             for _ in range(max(2, repeats)):
-                t0 = time.time()
+                t0 = time.monotonic()
                 for b in dev:
                     run_event_chunked(b, echunk)
-                ts.append(time.time() - t0)
+                ts.append(time.monotonic() - t0)
             ev = sum(b.batch * b.ev_opidx.shape[-1] for b in dev)
             t = statistics.median(ts)
             xlong_stats["event_chunked"] = {
@@ -1115,11 +1115,11 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
         if synth_mode == "host" and SDB == B:
             t_host_synth = t_synth
         else:
-            t0 = time.time()
+            t0 = time.monotonic()
             synth_cas_columnar(SDB, seed=1, n_procs=5, n_ops=n_ops,
                                n_values=5, corrupt=0.1, p_info=0.01,
                                n_keys=n_keys)
-            t_host_synth = time.time() - t0
+            t_host_synth = time.monotonic() - t0
         # key_meta=False is the generator exactly as the check source
         # consumes it (the per-key histograms are the headline device
         # mode's extra), and it lets the rate, streamed, and fuzz
@@ -1128,9 +1128,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
         synthesize(sd_spec, "device", key_meta=False)     # compile
         sd_times = []
         for _ in range(max(2, repeats)):
-            t0 = time.time()
+            t0 = time.monotonic()
             synthesize(sd_spec, "device", key_meta=False)
-            sd_times.append(time.time() - t0)
+            sd_times.append(time.monotonic() - t0)
         t_dev_synth = statistics.median(sd_times)
 
         # Streamed synth source: the scheduler pulls generated groups
@@ -1153,9 +1153,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
             return n, sch.stats
 
         run_synth_streamed()                     # warm the shapes
-        t0 = time.time()
+        t0 = time.monotonic()
         n_sd, sd_stats = run_synth_streamed()
-        t_sd_e2e = time.time() - t0
+        t_sd_e2e = time.monotonic() - t0
 
         fuzz_section = None
         if os.environ.get("JT_BENCH_FUZZ", "1") != "0":
@@ -1163,10 +1163,10 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
             fz_spec = _dc_replace(sd_spec, n=min(SDB, 256))
             fuzz_campaign(fz_spec, rounds=1, neighborhood=2,
                           max_witnesses=4, name=None)   # warm
-            t0 = time.time()
+            t0 = time.monotonic()
             fz = fuzz_campaign(fz_spec, rounds=1, neighborhood=2,
                                max_witnesses=4, name=None)
-            t_fz = time.time() - t0
+            t_fz = time.monotonic() - t0
             fuzz_section = {
                 "histories": fz["checked"],
                 "neighborhoods": fz["neighborhoods"],
@@ -1233,17 +1233,17 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
         _tel.configure(False)
         off_ts = []
         for _ in range(max(2, repeats)):
-            t0 = time.time()
+            t0 = time.monotonic()
             tel_run()
-            off_ts.append(time.time() - t0)
+            off_ts.append(time.monotonic() - t0)
         t_tr_off = statistics.median(off_ts)
         _tel.configure(True)
         on_ts = []
         for _ in range(max(2, repeats)):
             _tel.reset()
-            t0 = time.time()
+            t0 = time.monotonic()
             tel_run()
-            on_ts.append(time.time() - t0)
+            on_ts.append(time.monotonic() - t0)
         t_tr_on = statistics.median(on_ts)
         gap = _tel.gaps()                     # the last traced pass
         # One journaled traced pass: the ChunkJournal sink adds the
@@ -1366,13 +1366,13 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
             writers = [_on_thr.Thread(target=_writer, args=(p, i),
                                       daemon=True)
                        for i, p in enumerate(paths)]
-            t0 = time.time()
+            t0 = time.monotonic()
             for w in writers:
                 w.start()
             while any(w.is_alive() for w in writers):
                 daemon.tick()
                 time.sleep(0.005)
-            t_writing = time.time() - t0
+            t_writing = time.monotonic() - t0
             checks_while_writing = daemon.stats["checks"]
             for _ in range(50):
                 daemon.tick()
@@ -1601,13 +1601,13 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
         troot = _fl_tf.mkdtemp(prefix="jt-bench-fleet-")
         try:
             for w in FW:
-                t0 = time.time()
+                t0 = time.monotonic()
                 fl_out = fleet_campaign(
                     name=f"bench-fleet-w{w}", kind="synth",
                     seeds=range(FSEEDS), spec=fl_spec, workers=w,
                     store_root=_FlStore(os.path.join(troot,
                                                      f"w{w}")))
-                e2e = time.time() - t0
+                e2e = time.monotonic() - t0
                 if t_base is None:
                     t_base = e2e
                 points.append({
@@ -1738,13 +1738,13 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
                 st = _SvStore(Path(td) / "store")
                 for i in range(SVT):
                     _sv_mkrun(st.base, i, pid=-1)   # dead writers
-                t0 = time.time()
+                t0 = time.monotonic()
                 serve_store(store=st, workers=max(w, 1),
                             until_idle=True, lease_ttl=SV_TTL,
                             poll_s=0.05,
                             worker_args=_sv_base_args
                             + ["--max-tenants", str(SVT)])
-                e2e = time.time() - t0
+                e2e = time.monotonic() - t0
                 ttfvs, ok = [], 0
                 for i in range(SVT):
                     v = st.online_verdict(f"svc-{i}", "r1") or {}
@@ -1793,14 +1793,14 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
                 + ["--max-tenants", str(half), "--until-idle"])
             pB = None
             try:
-                deadline = time.time() + 120
-                while time.time() < deadline and \
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline and \
                         _owned("kill-a") < half:
                     time.sleep(0.05)
                 pB = _spawn_service_worker(
                     st, "kill-b", _sv_base_args
                     + ["--max-tenants", str(SVT), "--until-idle"])
-                while time.time() < deadline and \
+                while time.monotonic() < deadline and \
                         _owned("kill-b") < SVT - half:
                     time.sleep(0.05)
                 orphans = []
@@ -1813,12 +1813,12 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
                         continue        # never claimed: not an orphan
                     if rec.get("worker") == "kill-a":
                         orphans.append(i)
-                t_kill = time.time()
+                t_kill = time.monotonic()
                 pA.kill()
                 pA.wait()
                 lat = {}
-                deadline = time.time() + 90
-                while time.time() < deadline and \
+                deadline = time.monotonic() + 90
+                while time.monotonic() < deadline and \
                         len(lat) < len(orphans):
                     for i in orphans:
                         if i in lat:
@@ -1830,7 +1830,7 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
                         except Exception:
                             continue
                         if int(rec.get("gen") or 0) >= 1:
-                            lat[i] = round(time.time() - t_kill, 4)
+                            lat[i] = round(time.monotonic() - t_kill, 4)
                     time.sleep(0.02)
                 # Finalize everything so the survivor drains and
                 # exits (analyzed stamp → stored-history path).
@@ -1951,6 +1951,32 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
             "headline_pallas_dispatches":
                 sched_stats.get("pallas_dispatches", 0) or 0,
         }
+
+    # ---- Static verification plane (ISSUE 15): run the full lint —
+    # device-plane jaxpr tracing over every registered kernel family
+    # plus the host-plane ast passes — and report rules run, findings,
+    # and lint wall-clock. A finding here on a clean tree is itself a
+    # regression (tier-1 runs `jepsen-tpu lint --strict` too; the
+    # bench section is the measured cost + the observability hook).
+    # JT_BENCH_ANALYSIS=0 skips.
+    analysis_section = None
+    if os.environ.get("JT_BENCH_ANALYSIS", "1") != "0":
+        from jepsen_tpu.analysis import run_lint
+        _lint = run_lint(root=Path(__file__).resolve().parent)
+        analysis_section = {
+            "rules_run": _lint.rules_run,
+            "families": _lint.families,
+            "files_scanned": _lint.files_scanned,
+            "findings": len(_lint.findings),
+            "suppressed": _lint.suppressed
+            if isinstance(_lint.suppressed, int)
+            else len(_lint.suppressed),
+            "by_rule": {},
+            "wall_s": round(_lint.wall_s, 3),
+        }
+        for f in _lint.findings:
+            analysis_section["by_rule"][f.rule] = \
+                analysis_section["by_rule"].get(f.rule, 0) + 1
 
     out = {
         "metric": "linearizability_check_throughput_1kop_cas_e2e",
@@ -2078,6 +2104,7 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
         "online": online_section,
         "fleet": fleet_section,
         "service": service_section,
+        "analysis": analysis_section,
     }
     rc = 0
     if compare is not None:

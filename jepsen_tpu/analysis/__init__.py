@@ -1,0 +1,173 @@
+"""Static verification plane — ``jepsen-tpu lint`` (doc/analysis.md).
+
+Two planes verify, before anything dispatches, the conventions the
+rest of the framework only enforces by testing runtime behavior:
+
+* **Device plane** (``analysis.jaxpr_lint``): every registered kernel
+  family traces through ``jax.make_jaxpr``/``jit(...).trace`` WITHOUT
+  executing, and the eqn walk rejects host-callback primitives,
+  dtype widening past each family's columnar contract, missing buffer
+  donation on the scheduler's donated operands, non-power-of-two
+  dispatch shapes (the AOT cache-key contract), unexpected primitives
+  inside the closure fixpoint, and Pallas configs whose static VMEM
+  footprint exceeds the budget.
+
+* **Host plane** (``analysis.ast_lint``): stdlib-``ast`` passes over
+  the repo's own source enforce durable-write discipline under store
+  namespaces, locked mutation of thread-shared scheduler stats and
+  registry counters, the central JT_* knob registry
+  (``analysis.knobs`` — doc/knobs.md is generated from it),
+  import-graph host purity of the numpy twins, and monotonic-clock
+  duration math.
+
+Findings carry file:line + rule id, honor the committed suppression
+baseline (``analysis/baseline.json`` — empty: the dogfood fixes
+landed with the lint), and count into the telemetry registry as
+``analysis.findings{rule=...}``. Every rule has a seeded-defect kill
+test in tests/test_analysis.py (the lobotomize idiom).
+"""
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+#: Rule ids, one per hazard class. Device plane:
+D_HOST = "JTL-D-HOST"      # host callback/transfer primitive in kernel
+D_DTYPE = "JTL-D-DTYPE"    # dtype widening past the family contract
+D_DONATE = "JTL-D-DONATE"  # missing donation on donated-contract args
+D_SHAPE = "JTL-D-SHAPE"    # non-pow2 / non-quantum dispatch shape
+D_PRIM = "JTL-D-PRIM"      # unexpected primitive in the closure
+D_VMEM = "JTL-D-VMEM"      # Pallas VMEM footprint over budget
+#: Host plane:
+H_DWRITE = "JTL-H-DWRITE"  # raw non-durable write under a store ns
+H_LOCK = "JTL-H-LOCK"      # unlocked shared-stats / registry mutation
+H_KNOB = "JTL-H-KNOB"      # undeclared JT_* knob reference
+H_KNOB_STALE = "JTL-H-KNOB-STALE"  # declared knob nothing reads
+H_PURITY = "JTL-H-PURITY"  # host-pure module reaches jax statically
+H_CLOCK = "JTL-H-CLOCK"    # wall-clock duration arithmetic
+
+DEVICE_RULES = (D_HOST, D_DTYPE, D_DONATE, D_SHAPE, D_PRIM, D_VMEM)
+HOST_RULES = (H_DWRITE, H_LOCK, H_KNOB, H_KNOB_STALE, H_PURITY,
+              H_CLOCK)
+ALL_RULES = DEVICE_RULES + HOST_RULES
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    file: str          # repo-relative path ("<device>" for traced
+                       # families with no single source line)
+    line: int
+    message: str
+    context: str = ""  # stable anchor for baseline matching
+                       # (function qualname, knob or family name)
+
+    def key(self) -> dict:
+        """The baseline-matching identity: rule + file + context.
+        Line numbers drift with unrelated edits, so they are shown,
+        never matched."""
+        return {"rule": self.rule, "file": self.file,
+                "context": self.context}
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.file,
+                "line": self.line, "context": self.context,
+                "message": self.message}
+
+
+@dataclass
+class LintReport:
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    rules_run: List[str] = field(default_factory=list)
+    families: List[str] = field(default_factory=list)
+    files_scanned: int = 0
+    wall_s: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": len(self.suppressed),
+            "rules_run": list(self.rules_run),
+            "families": list(self.families),
+            "files_scanned": self.files_scanned,
+            "wall_s": round(self.wall_s, 4),
+        }
+
+
+def baseline_path(root) -> Path:
+    return Path(root) / "jepsen_tpu" / "analysis" / "baseline.json"
+
+
+def load_baseline(path) -> List[dict]:
+    """The committed suppression baseline: a list of finding keys
+    ({rule, file, context}) tolerated without failing --strict. An
+    unreadable baseline is an empty one (never a crash — the lint must
+    run on a half-checked-out tree), and unknown keys are ignored."""
+    try:
+        d = json.loads(Path(path).read_text())
+        return [e for e in d.get("suppress", [])
+                if isinstance(e, dict) and "rule" in e]
+    except Exception:
+        return []
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Sequence[dict]):
+    """Split findings into (unsuppressed, suppressed) against baseline
+    keys. Matching is by rule + file + context — line-number drift
+    never un-suppresses an entry."""
+    keys = [{k: e.get(k) for k in ("rule", "file", "context")}
+            for e in baseline]
+    live, quiet = [], []
+    for f in findings:
+        (quiet if f.key() in keys else live).append(f)
+    return live, quiet
+
+
+def repo_root() -> Path:
+    """The tree the lint runs over: the repo containing this package
+    (source checkouts), falling back to the package's parent."""
+    here = Path(__file__).resolve()
+    return here.parent.parent.parent
+
+
+def run_lint(root=None, *, planes: str = "all",
+             baseline: Optional[str] = None) -> LintReport:
+    """Run the static verification plane and return the report.
+
+    ``planes``: "host" (ast passes only — no jax import), "device"
+    (jaxpr tracing only), or "all". Findings count into the telemetry
+    registry as ``analysis.findings{rule=...}`` whether suppressed or
+    not (the baseline is a reporting gate, not an observability one).
+    """
+    from .. import telemetry
+
+    root = Path(root) if root is not None else repo_root()
+    t0 = time.monotonic()
+    rep = LintReport()
+    findings: List[Finding] = []
+    if planes in ("all", "host"):
+        from . import ast_lint
+        host = ast_lint.lint_tree(root)
+        findings.extend(host.findings)
+        rep.files_scanned = host.files_scanned
+        rep.rules_run.extend(HOST_RULES)
+    if planes in ("all", "device"):
+        from . import jaxpr_lint
+        dev = jaxpr_lint.lint_device()
+        findings.extend(dev.findings)
+        rep.families = list(dev.families)
+        rep.rules_run.extend(DEVICE_RULES)
+    base = load_baseline(baseline if baseline is not None
+                         else baseline_path(root))
+    rep.findings, rep.suppressed = apply_baseline(findings, base)
+    for f in findings:
+        telemetry.REGISTRY.counter("analysis.findings",
+                                   rule=f.rule).inc()
+    telemetry.REGISTRY.counter("analysis.lint_runs").inc()
+    rep.wall_s = time.monotonic() - t0
+    return rep

@@ -1,0 +1,542 @@
+"""Host-plane static analysis: stdlib-``ast`` passes over the repo's
+own source (no jax import — this plane runs anywhere, instantly).
+
+Rules (ids in analysis.__init__; every one has a seeded-defect kill
+test in tests/test_analysis.py):
+
+* ``JTL-H-DWRITE`` — durable-write discipline. Inside the modules
+  that own store-namespace artifacts (DURABLE_MODULES), every raw
+  ``open(..., "w"/"a")`` / ``os.fdopen`` / ``Path.write_text`` must
+  sit in a function that also makes the write durable: an
+  ``os.fsync``/``os.replace``/``os.rename`` in the same body, or a
+  call into one of the durable-write primitives (``_flush``,
+  ``sync``, ``atomic_write_json``). A crash must never leave a torn
+  artifact a resume path would trust blindly.
+
+* ``JTL-H-LOCK`` — locked-mutation discipline. Scheduler classes
+  (``*Scheduler`` in ops/schedule.py) mutate their thread-shared
+  ``stats`` counters only through ``_inc``/``_stat_inc`` (the locked
+  registry-mirroring increment); private attributes of the telemetry
+  ``REGISTRY`` are touched only inside telemetry.py itself.
+
+* ``JTL-H-KNOB`` / ``JTL-H-KNOB-STALE`` — the central knob registry.
+  Every ``JT_*`` string literal in code (docstrings excluded) must be
+  declared in analysis.knobs; every declared knob must be referenced
+  somewhere — undeclared reads are typos-in-waiting, unreferenced
+  declarations are rot.
+
+* ``JTL-H-PURITY`` — static host-twin purity. The numpy twins
+  (synth_device's host path, graph extraction, workloads.synth) must
+  be import-safe without jax: their MODULE-LEVEL import closure
+  (within the package) never reaches jax, and in-module jax imports
+  appear only inside the declared device-entry functions. This is the
+  static form of the old runtime subprocess gates
+  (tests/test_synth_device.py, tests/test_graphs.py keep one
+  subprocess smoke each as belt-and-suspenders).
+
+* ``JTL-H-CLOCK`` — monotonic-clock discipline. A duration computed
+  by subtracting two in-process ``time.time()`` reads is wrong under
+  clock steps (this framework SHIPS a clock nemesis); such math must
+  use ``time.monotonic()``. Cross-process comparisons against stored
+  wall stamps (lease heartbeats, file mtimes) are wall-clock by
+  design and do not match this rule.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import (Finding, H_CLOCK, H_DWRITE, H_KNOB, H_KNOB_STALE,
+               H_LOCK, H_PURITY)
+from .knobs import KNOBS
+
+#: Modules owning durable store-namespace artifacts (repo-relative).
+DURABLE_MODULES = frozenset({
+    "jepsen_tpu/store.py",
+    "jepsen_tpu/history/wal.py",
+    "jepsen_tpu/history/codec.py",
+    "jepsen_tpu/fleet.py",
+    "jepsen_tpu/service.py",
+    "jepsen_tpu/online.py",
+    "jepsen_tpu/series.py",
+    "jepsen_tpu/alerts.py",
+})
+
+#: Calls that make a raw write durable when present in the same
+#: function body (or ARE the durable primitive being defined).
+DURABLE_SINKS = frozenset({"fsync", "replace", "rename", "_flush",
+                           "sync", "atomic_write_json", "_compact"})
+
+#: Write-opening modes (binary/text variants reduce to these chars).
+_WRITE_MODES = ("w", "a", "x", "+")
+
+#: The locked-increment entry points (JTL-H-LOCK).
+LOCKED_INC_FUNCS = frozenset({"_inc", "_stat_inc"})
+SCHEDULER_MODULE = "jepsen_tpu/ops/schedule.py"
+TELEMETRY_MODULE = "jepsen_tpu/telemetry.py"
+
+#: Host-pure roots -> functions allowed to lazily import jax
+#: (the device entries). Everything else in these modules, and the
+#: whole module-level import closure, must be jax-free.
+HOST_PURE_ROOTS: Dict[str, frozenset] = {
+    "jepsen_tpu.ops.synth_device": frozenset(
+        {"_cas_scan", "_walk_scan", "_jitted", "synth_wide_device"}),
+    "jepsen_tpu.ops.graph": frozenset({"graph_kernel"}),
+    "jepsen_tpu.workloads.synth": frozenset(),
+}
+
+_KNOB_RE = re.compile(r"JT_[A-Z0-9_]+\Z")
+
+
+@dataclass
+class HostReport:
+    findings: List[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    #: {knob name: first (file, line) reference} — the completeness
+    #: surface tests compare against a live grep.
+    knob_refs: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+
+
+def iter_source_files(root) -> List[Path]:
+    """The lint's scan set: the package tree (minus the linter
+    itself — its literals are meta, not knob reads) plus bench.py."""
+    root = Path(root)
+    out = []
+    pkg = root / "jepsen_tpu"
+    for p in sorted(pkg.rglob("*.py")):
+        if "analysis" in p.relative_to(pkg).parts:
+            continue
+        out.append(p)
+    bench = root / "bench.py"
+    if bench.exists():
+        out.append(bench)
+    return out
+
+
+def module_name(root, path) -> str:
+    rel = Path(path).relative_to(root)
+    parts = list(rel.with_suffix("").parts)
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _docstring_nodes(tree) -> Set[int]:
+    """id()s of docstring Constant nodes (module/class/function first
+    statements) — excluded from the knob literal scan."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef,
+                             ast.FunctionDef, ast.AsyncFunctionDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant) and \
+                    isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out
+
+
+def _terminal_name(func) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _mode_of(call: ast.Call, argpos: int = 1) -> Optional[str]:
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    if len(call.args) > argpos:
+        a = call.args[argpos]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value
+    return None
+
+
+def _is_wall_clock_call(node) -> bool:
+    """A direct ``time.time()`` / ``_time.time()`` call."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in ("time", "_time"))
+
+
+class _FunctionFrame:
+    def __init__(self, name: str):
+        self.name = name
+        #: (line, description, mode-or-None) per raw write.
+        self.writes: List[Tuple[int, str, Optional[str]]] = []
+        self.has_sink = name in DURABLE_SINKS
+        # A log handle handed to a child process (worker stdout) is
+        # diagnostics, not a durable store artifact — this process
+        # can't fsync-discipline the child's writes. The exemption is
+        # NARROW: only append-mode opens in a Popen-calling function;
+        # a "w"-mode state file written beside the spawn still flags.
+        self.has_popen = False
+        self.wall_names: Set[str] = set()
+
+
+class _FileVisitor(ast.NodeVisitor):
+    def __init__(self, rel: str, module: str, tree,
+                 report: HostReport):
+        self.rel = rel
+        self.module = module
+        self.report = report
+        self.durable = rel in DURABLE_MODULES
+        self.class_stack: List[str] = []
+        self.func_stack: List[_FunctionFrame] = []
+        # Module-level code is a write scope too: a raw import-time
+        # write in a durable module must not slip past the rule just
+        # because no function encloses it.
+        self.module_frame = _FunctionFrame("<module>")
+        self._docstrings = _docstring_nodes(tree)
+        self.pure_allow = HOST_PURE_ROOTS.get(module)
+
+    def finish(self) -> None:
+        """Close the module-level write scope (call after visit)."""
+        self._finish_frame(self.module_frame, "<module>")
+
+    # ------------------------------------------------------ plumbing
+    def _find(self, rule: str, line: int, msg: str,
+              context: str) -> None:
+        self.report.findings.append(
+            Finding(rule=rule, file=self.rel, line=line, message=msg,
+                    context=context))
+
+    def _qualname(self) -> str:
+        parts = self.class_stack + [f.name for f in self.func_stack]
+        return ".".join(parts) if parts else "<module>"
+
+    # ------------------------------------------------- function scope
+    def _visit_func(self, node) -> None:
+        frame = _FunctionFrame(node.name)
+        self.func_stack.append(frame)
+        # Pre-pass: wall-clock-assigned names in THIS function body
+        # (assignment may lexically follow a use; two passes keep the
+        # clock rule order-independent).
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and \
+                    _is_wall_clock_call(sub.value):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        frame.wall_names.add(t.id)
+        qual = self._qualname()
+        self.generic_visit(node)
+        self.func_stack.pop()
+        self._finish_frame(frame, qual)
+
+    def _finish_frame(self, frame: _FunctionFrame, qual: str) -> None:
+        if not (frame.writes and self.durable) or frame.has_sink:
+            return
+        for line, desc, mode in frame.writes:
+            if frame.has_popen and mode and "a" in mode:
+                continue       # the subprocess-log-handle exemption
+            self._find(
+                H_DWRITE, line,
+                f"raw {desc} in durable module without "
+                f"fsync/atomic-rename in {qual} — route through "
+                f"atomic_write_json or a group-commit sync",
+                qual)
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node) -> None:
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    # --------------------------------------------------------- calls
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _terminal_name(node.func)
+        frame = self.func_stack[-1] if self.func_stack \
+            else self.module_frame
+        if name in DURABLE_SINKS:
+            frame.has_sink = True
+        if name == "Popen":
+            frame.has_popen = True
+        if self.durable:
+            if name in ("open", "fdopen"):
+                mode = _mode_of(node)
+                if mode and any(c in mode for c in _WRITE_MODES):
+                    frame.writes.append(
+                        (node.lineno, f"{name}(mode={mode!r})",
+                         mode))
+            elif name in ("write_text", "write_bytes"):
+                frame.writes.append(
+                    (node.lineno, f".{name}()", None))
+        self.generic_visit(node)
+
+    # ------------------------------------------------- locked mutation
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if (self.rel == SCHEDULER_MODULE
+                and isinstance(node.target, ast.Subscript)
+                and isinstance(node.target.value, ast.Attribute)
+                and node.target.value.attr == "stats"
+                and any(c.endswith("Scheduler")
+                        for c in self.class_stack)
+                and not any(f.name in LOCKED_INC_FUNCS
+                            for f in self.func_stack)):
+            self._find(
+                H_LOCK, node.lineno,
+                "scheduler stats mutated outside _inc — the stats "
+                "dict is shared across concurrent fused groups; "
+                "unlocked increments lose counts", self._qualname())
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (node.attr.startswith("_")
+                and self.rel != TELEMETRY_MODULE
+                and ((isinstance(node.value, ast.Name)
+                      and node.value.id == "REGISTRY")
+                     or (isinstance(node.value, ast.Attribute)
+                         and node.value.attr == "REGISTRY"))):
+            self._find(
+                H_LOCK, node.lineno,
+                f"telemetry REGISTRY internal {node.attr!r} touched "
+                f"outside telemetry.py — counters mutate only "
+                f"through Registry methods", self._qualname())
+        self.generic_visit(node)
+
+    # -------------------------------------------------- knob literals
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if isinstance(node.value, str) and id(node) not in \
+                self._docstrings and _KNOB_RE.fullmatch(node.value):
+            self.report.knob_refs.setdefault(
+                node.value, (self.rel, node.lineno))
+
+    # ------------------------------------------------ clock discipline
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, ast.Sub) and self.func_stack:
+            frame = self.func_stack[-1]
+
+            def wall(x):
+                return _is_wall_clock_call(x) or (
+                    isinstance(x, ast.Name)
+                    and x.id in frame.wall_names)
+
+            # Both operands in-process wall reads = duration math on
+            # a steppable clock. One wall operand against stored
+            # state (heartbeats, mtimes) is cross-process by design.
+            if wall(node.left) and wall(node.right):
+                self._find(
+                    H_CLOCK, node.lineno,
+                    "duration computed from two time.time() reads — "
+                    "use time.monotonic(); wall clocks step (this "
+                    "framework ships a clock nemesis)",
+                    self._qualname())
+        self.generic_visit(node)
+
+    # ---------------------------------------------------- jax imports
+    def _jax_import(self, node, names) -> None:
+        if self.pure_allow is None:
+            return
+        jaxy = [n for n in names
+                if n == "jax" or n.startswith("jax.")]
+        if not jaxy:
+            return
+        in_allowed = any(f.name in self.pure_allow
+                         for f in self.func_stack)
+        if not self.func_stack:
+            self._find(
+                H_PURITY, node.lineno,
+                f"module-level jax import in host-pure module "
+                f"{self.module} — the numpy twin must import "
+                f"without jax", self.module)
+        elif not in_allowed:
+            self._find(
+                H_PURITY, node.lineno,
+                f"jax imported inside {self._qualname()} which is "
+                f"not a declared device entry of {self.module} "
+                f"(allowed: {sorted(self.pure_allow)})",
+                self._qualname())
+
+    def visit_Import(self, node: ast.Import) -> None:
+        self._jax_import(node, [a.name for a in node.names])
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.level == 0 and node.module:
+            self._jax_import(node, [node.module])
+        self.generic_visit(node)
+
+
+# ------------------------------------------------- import-graph purity
+
+def _module_level_imports(tree, module: str) -> Set[str]:
+    """Absolute module names imported at MODULE level (relative
+    imports resolved against ``module``). Imports inside functions are
+    lazy by definition and excluded — that is the whole point of the
+    static proof."""
+    out: Set[str] = set()
+    pkg_parts = module.split(".")
+
+    def handle(node) -> None:
+        if isinstance(node, ast.Import):
+            out.update(a.name for a in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                if node.module:
+                    out.add(node.module)
+                    # ``from pkg import sub`` may bind submodules.
+                    out.update(f"{node.module}.{a.name}"
+                               for a in node.names)
+            else:
+                base = pkg_parts[:-node.level]
+                prefix = ".".join(base)
+                if node.module:
+                    target = f"{prefix}.{node.module}" if prefix \
+                        else node.module
+                else:
+                    target = prefix
+                if target:
+                    out.add(target)
+                    out.update(f"{target}.{a.name}"
+                               for a in node.names)
+
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            handle(stmt)
+        elif isinstance(stmt, (ast.If, ast.Try)):
+            # Guarded module-level imports (TYPE_CHECKING, compat
+            # shims) still count: the conservative direction for a
+            # purity proof.
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.Import, ast.ImportFrom)):
+                    handle(sub)
+    return out
+
+
+def import_closure(graph: Dict[str, Set[str]], root: str
+                   ) -> Dict[str, Optional[str]]:
+    """BFS the package-internal module-level import graph from
+    ``root``; returns {module: parent} for every reached module
+    (parent None for the root) — the chain evidence for findings."""
+    seen: Dict[str, Optional[str]] = {root: None}
+    queue = [root]
+    while queue:
+        cur = queue.pop()
+        for dep in sorted(graph.get(cur, ())):
+            if dep.startswith("jepsen_tpu") and dep in graph \
+                    and dep not in seen:
+                seen[dep] = cur
+                queue.append(dep)
+    return seen
+
+
+def _chain(parents: Dict[str, Optional[str]], mod: str) -> str:
+    parts = [mod]
+    while parents.get(parts[-1]) is not None:
+        parts.append(parents[parts[-1]])
+    return " <- ".join(parts)
+
+
+def check_import_purity(graph: Dict[str, Set[str]],
+                        roots=None,
+                        files: Optional[Dict[str, str]] = None
+                        ) -> List[Finding]:
+    """The import-graph proof: no host-pure root's module-level
+    closure reaches jax. ``graph``: {module: module-level imports}
+    (package modules resolved absolute); ``files``: {module: repo-
+    relative path} so findings name the REAL file (a package's
+    ``__init__.py``, not a guessed ``pkg.py``). Separated from
+    lint_tree so tests can feed a synthetic graph (the seeded-defect
+    kill)."""
+    out: List[Finding] = []
+    roots = HOST_PURE_ROOTS if roots is None else roots
+    files = files or {}
+    for root in sorted(roots):
+        parents = import_closure(graph, root)
+        for mod in sorted(parents):
+            jaxy = sorted(d for d in graph.get(mod, ())
+                          if d == "jax" or d.startswith("jax."))
+            if jaxy:
+                out.append(Finding(
+                    rule=H_PURITY,
+                    file=files.get(mod,
+                                   mod.replace(".", "/") + ".py"),
+                    line=1,
+                    message=(
+                        f"host-pure root {root} reaches jax "
+                        f"statically: {jaxy[0]} imported at module "
+                        f"level via {_chain(parents, mod)}"),
+                    context=f"{root}->{mod}"))
+    return out
+
+
+# ------------------------------------------------------------- driver
+
+def check_knobs(knob_refs: Dict[str, Tuple[str, int]],
+                declared=None, stale: bool = True) -> List[Finding]:
+    """Registry both ways: every referenced JT_* literal declared,
+    every declared knob referenced. Split out for the kill tests.
+    ``stale=False`` skips the declared-but-unreferenced direction —
+    it only means anything when the linted tree is the one that
+    contains the registry (lint_tree gates it on that)."""
+    declared = KNOBS if declared is None else declared
+    out: List[Finding] = []
+    for name in sorted(set(knob_refs) - set(declared)):
+        f, line = knob_refs[name]
+        out.append(Finding(
+            rule=H_KNOB, file=f, line=line,
+            message=(f"undeclared knob {name} — declare it in "
+                     f"analysis/knobs.py (default/type/doc) or fix "
+                     f"the typo"), context=name))
+    for name in sorted(set(declared) - set(knob_refs)
+                       if stale else ()):
+        out.append(Finding(
+            rule=H_KNOB_STALE, file="jepsen_tpu/analysis/knobs.py",
+            line=1,
+            message=(f"knob {name} is declared but nothing in the "
+                     f"tree reads it — remove the entry or restore "
+                     f"the read"), context=name))
+    return out
+
+
+def lint_file(path, rel: str, module: str,
+              report: HostReport) -> Optional[Set[str]]:
+    """Lint one file into ``report``; returns its module-level import
+    set (for the purity graph), or None on a syntax error (which is
+    itself a finding — the lint must never silently skip a file)."""
+    try:
+        tree = ast.parse(Path(path).read_text(), filename=str(path))
+    except SyntaxError as e:
+        report.findings.append(Finding(
+            rule=H_PURITY, file=rel, line=e.lineno or 1,
+            message=f"unparseable source: {e.msg}", context=rel))
+        return None
+    visitor = _FileVisitor(rel, module, tree, report)
+    visitor.visit(tree)
+    visitor.finish()
+    return _module_level_imports(tree, module)
+
+
+def lint_tree(root) -> HostReport:
+    """Run every host-plane pass over the tree rooted at ``root``."""
+    root = Path(root)
+    report = HostReport()
+    graph: Dict[str, Set[str]] = {}
+    files: Dict[str, str] = {}
+    for path in iter_source_files(root):
+        rel = path.relative_to(root).as_posix()
+        module = module_name(root, path)
+        imports = lint_file(path, rel, module, report)
+        if imports is not None:
+            graph[module] = imports
+            files[module] = rel
+        report.files_scanned += 1
+    report.findings.extend(check_import_purity(graph, files=files))
+    # The stale direction compares the registry against ITS OWN tree;
+    # linting a foreign/partial tree (no registry file) skips it.
+    has_registry = (root / "jepsen_tpu" / "analysis"
+                    / "knobs.py").exists()
+    report.findings.extend(check_knobs(report.knob_refs,
+                                       stale=has_registry))
+    return report

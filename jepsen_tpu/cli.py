@@ -1172,10 +1172,66 @@ def metrics_cmd() -> dict:
     return {"metrics": {"add_opts": add_opts, "run": run}}
 
 
+def lint_cmd() -> dict:
+    """``lint``: the static verification plane (jepsen_tpu.analysis,
+    doc/analysis.md). Device plane: every registered kernel family is
+    traced through jax.make_jaxpr WITHOUT executing and its jaxpr
+    walked for host callbacks, dtype widening, missing donation,
+    cache-fragmenting shapes, unexpected primitives, and Pallas VMEM
+    overruns. Host plane: stdlib-ast passes enforce durable-write and
+    locked-mutation discipline, the central JT_* knob registry
+    (doc/knobs.md is generated from it), static host-twin purity, and
+    monotonic-clock duration math. Findings honor the committed
+    suppression baseline (analysis/baseline.json); ``--strict`` exits
+    1 on any unsuppressed finding — the tier-1 gate. Prints one JSON
+    line (findings, rules, families, wall_s)."""
+    def add_opts(p):
+        p.add_argument("--strict", action="store_true", default=False,
+                       help="Exit 1 on any unsuppressed finding "
+                            "(the tier-1 / CI mode)")
+        p.add_argument("--plane", default="all",
+                       choices=["all", "host", "device"],
+                       help="host = ast passes only (no jax import); "
+                            "device = jaxpr tracing only")
+        p.add_argument("--root", default=None,
+                       help="Tree to lint (default: the repo "
+                            "containing the installed package)")
+        p.add_argument("--baseline", default=None,
+                       help="Suppression baseline path (default "
+                            "jepsen_tpu/analysis/baseline.json under "
+                            "the root)")
+        p.add_argument("--write-knobs-doc", default=None,
+                       metavar="PATH", dest="write_knobs_doc",
+                       help="Regenerate the knob-registry doc "
+                            "(doc/knobs.md) at PATH and exit")
+
+    def run(opts):
+        import json as _json
+
+        from .analysis import run_lint
+        from .analysis.knobs import generate_knobs_md
+
+        if opts.write_knobs_doc:
+            text = generate_knobs_md()
+            with open(opts.write_knobs_doc, "w") as f:
+                f.write(text)
+            print(f"wrote {opts.write_knobs_doc} "
+                  f"({len(text.splitlines())} lines)")
+            return 0
+        rep = run_lint(root=opts.root, planes=opts.plane,
+                       baseline=opts.baseline)
+        print(_json.dumps({"strict": opts.strict, **rep.to_dict()},
+                          default=str))
+        return 1 if (opts.strict and rep.findings) else 0
+
+    return {"lint": {"add_opts": add_opts, "run": run}}
+
+
 def main(argv: Optional[Sequence[str]] = None) -> None:
     run_cli({**suite_cmd(), **serve_cmd(), **recheck_cmd(),
              **salvage_cmd(), **fuzz_cmd(), **fleet_cmd(),
-             **trace_cmd(), **metrics_cmd(), **watch_cmd()}, argv)
+             **trace_cmd(), **metrics_cmd(), **watch_cmd(),
+             **lint_cmd()}, argv)
 
 
 if __name__ == "__main__":

@@ -10,6 +10,7 @@ as sequences.
 from __future__ import annotations
 
 import json
+import os
 from typing import Iterable, List
 
 from .ops import Op
@@ -71,8 +72,15 @@ def _revive(d):
 def write_jsonl(path, history: Iterable[Op], chunk: int = 8192) -> None:
     """Write ops as JSON lines, buffered in chunks (the reference writes
     long histories in parallel chunks, util.clj:149-170; here buffered
-    sequential IO achieves the same effect for multi-million-op logs)."""
-    with open(path, "w") as f:
+    sequential IO achieves the same effect for multi-million-op logs).
+
+    Durable (JTL-H-DWRITE): history.jsonl is what salvage, recheck,
+    and the machine-form loader trust — fsynced tmp + atomic rename,
+    so a crash mid-write leaves the old file or the new one, never a
+    torn hybrid a tolerant reader would silently truncate."""
+    path_s = str(path)
+    tmp = f"{path_s}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         buf: List[str] = []
         for op in history:
             buf.append(dumps_op(op))
@@ -81,6 +89,9 @@ def write_jsonl(path, history: Iterable[Op], chunk: int = 8192) -> None:
                 buf.clear()
         if buf:
             f.write("\n".join(buf) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path_s)
 
 
 class CorruptHistoryLine(ValueError):
@@ -115,7 +126,14 @@ def read_jsonl(path, tolerant: bool = False) -> List[Op]:
 
 
 def write_txt(path, history: Iterable[Op]) -> None:
-    """Human-readable tab-separated log (the reference's history.txt)."""
-    with open(path, "w") as f:
+    """Human-readable tab-separated log (the reference's history.txt).
+    Same tmp + fsync + atomic-rename discipline as write_jsonl: the
+    two forms of one history must never diverge by a torn write."""
+    path_s = str(path)
+    tmp = f"{path_s}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         for op in history:
             f.write(str(op) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path_s)

@@ -112,12 +112,64 @@ def pallas_available() -> bool:
     return True
 
 
-def pallas_supports(V: int, W: int) -> bool:
+def vmem_budget_bytes() -> int:
+    """$JT_PALLAS_VMEM_BYTES: the VMEM budget the static footprint
+    model rejects against (default 16 MiB — one TPU core's VMEM)."""
+    try:
+        return max(1 << 16, int(os.environ.get("JT_PALLAS_VMEM_BYTES",
+                                               str(16 << 20))))
+    except ValueError:
+        return 16 << 20
+
+
+#: Closure working-set multiplier on the resident frontier tile: the
+#: fixpoint body holds the tile plus the spawned-half/select
+#: temporaries per packed word (a conservative static bound, not a
+#: measurement — the model must reject before launch, so it errs big).
+VMEM_SCRATCH_FACTOR = 3
+
+
+def vmem_plan(V: int, W: int, *, K1: int = 256,
+              eb: Optional[int] = None,
+              budget: Optional[int] = None) -> Dict[str, object]:
+    """Static VMEM/SMEM footprint of one Pallas program instance —
+    the reject-before-launch model (analysis.jaxpr_lint rule
+    JTL-D-VMEM prices every supported (V, W) against it, and
+    ``pallas_supports`` consults it so an OOM config is never even
+    routed). Components: the VMEM-resident frontier output tile
+    [words(V), 2^W] uint32 with its closure scratch, the packed
+    transition rows [words(V), K1, V], and the double-buffered SMEM
+    event block. ``K1`` bounds the kind vocabulary (the rows table);
+    callers with a real vocabulary pass theirs."""
+    NW, M = n_state_words(V), 1 << int(W)
+    EB = event_block() if eb is None else int(eb)
+    budget = vmem_budget_bytes() if budget is None else int(budget)
+    frontier = NW * M * 4
+    rows = NW * int(K1) * V * 4
+    vmem = frontier * (1 + VMEM_SCRATCH_FACTOR) + rows
+    smem = 2 * EB * (2 + int(W)) * 4
+    return {"frontier_bytes": frontier, "rows_bytes": rows,
+            "scratch_bytes": frontier * VMEM_SCRATCH_FACTOR,
+            "vmem_bytes": vmem, "smem_bytes": smem,
+            "budget_bytes": budget, "fits": vmem <= budget}
+
+
+def pallas_supports(V: int, W: int,
+                    k1: Optional[int] = None) -> bool:
     """Capability gate: the shapes this kernel hosts. Wider windows
     belong to the scan/wide/frontier routes; the router only ever
-    PRICES pallas for shapes this admits."""
-    return 1 <= int(W) <= pallas_max_w() and \
-        1 <= int(V) <= PALLAS_MAX_STATES
+    PRICES pallas for shapes this admits. A config whose static VMEM
+    footprint (vmem_plan) exceeds the budget is rejected HERE —
+    before routing, pricing, or launch. ``k1`` is the real kind-
+    vocabulary bound (rows table [NW, K1, V]); callers that have the
+    encoded target in hand (the scheduler's route gate, the kernel
+    builder) MUST pass it — the default prices vmem_plan's nominal
+    bound, which a rich vocabulary can exceed many times over."""
+    if not (1 <= int(W) <= pallas_max_w()
+            and 1 <= int(V) <= PALLAS_MAX_STATES):
+        return False
+    kw = {} if k1 is None else {"K1": int(k1)}
+    return bool(vmem_plan(V, W, **kw)["fits"])
 
 
 def pallas_supports_resume() -> bool:
@@ -323,6 +375,17 @@ def make_pallas_kernel(V: int, W: int, *, shared_target: bool = False,
         ev_slots = ev_slots.astype(jnp.int32)
         B, N = ev_type.shape
         K1 = target.shape[-2]
+        # The launch gate with the REAL rows table: the build-time
+        # pallas_supports assert prices the nominal K1 bound, but the
+        # actual kind vocabulary arrives here, per shape — an
+        # over-budget config must fail loudly at trace time, never
+        # reach the pallas_call.
+        plan = vmem_plan(V, W, K1=int(K1))
+        if not plan["fits"]:
+            raise ValueError(
+                f"pallas config V={V} W={W} K1={int(K1)} needs "
+                f"{plan['vmem_bytes']} B VMEM (> budget "
+                f"{plan['budget_bytes']}) — rejected before launch")
         Np = ((N + EB - 1) // EB) * EB
         if Np != N:
             # EV_PAD steps are no-ops; slot tables pad to the

@@ -1450,7 +1450,8 @@ class BucketScheduler:
         from .pallas_wgl import (pallas_available, pallas_supports,
                                  router_prefers_pallas)
         if not (pallas_available()
-                and pallas_supports(batch.V, batch.W)):
+                and pallas_supports(batch.V, batch.W,
+                                    k1=batch.target.shape[1])):
             return False
         if self.wgl_backend == "pallas":
             return True

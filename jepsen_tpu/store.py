@@ -151,9 +151,13 @@ class StoreHandle:
 
     # ---------------------------------------------------------- files
     def write_json(self, parts, obj) -> None:
+        # Durable-write discipline (JTL-H-DWRITE): results.json /
+        # test.json / salvage.json are resume-path inputs — a torn
+        # half-written artifact must be impossible, so they land via
+        # the fsynced tmp + atomic-rename primitive.
         parts = [parts] if isinstance(parts, str) else list(parts)
-        with open(self.path(*parts), "w") as f:
-            json.dump(obj, f, indent=2, default=_scrub)
+        atomic_write_json(self.path(*parts), obj, indent=2,
+                          default=_scrub)
 
     def read_json(self, *parts):
         with open(self.path(*parts)) as f:
@@ -1153,17 +1157,18 @@ def columnar_digest(cols) -> str:
     return h.hexdigest()[:16]
 
 
-def atomic_write_json(path, obj) -> None:
+def atomic_write_json(path, obj, **dump_kwargs) -> None:
     """Durable small-JSON write: fsynced temp file + atomic rename, so
     a crash mid-write never leaves a torn artifact — the summary-file
     primitive the synth/fuzz campaigns persist per-unit progress
     through (their resume paths trust these files blindly). The temp
     name carries the pid (the _aot_store discipline): two concurrent
-    writers of one path must not interleave into a shared tmp."""
+    writers of one path must not interleave into a shared tmp.
+    ``dump_kwargs`` forward to json.dump (indent, default)."""
     path = Path(path)
     tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
     with open(tmp, "w") as f:
-        json.dump(obj, f)
+        json.dump(obj, f, **dump_kwargs)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)

@@ -106,6 +106,16 @@ def pytest_configure(config):
                    "fallbacks, and the JT_ONLINE_INCREMENTAL=0 "
                    "restore switch (deterministic; runs in tier-1)")
     config.addinivalue_line(
+        "markers", "analysis: static verification plane — per-rule "
+                   "seeded-defect kill tests for the jaxpr "
+                   "dispatch-plan lint and the host-discipline AST "
+                   "passes, baseline suppression semantics, "
+                   "kernel-family coverage, the Pallas VMEM "
+                   "rejection model, knob-registry completeness "
+                   "against a live grep, and the clean-tree "
+                   "`jepsen-tpu lint --strict` gate (deterministic; "
+                   "runs in tier-1)")
+    config.addinivalue_line(
         "markers", "obsplane: cluster observability plane — durable "
                    "metrics series ring files, OpenMetrics exposition "
                    "validity, cross-worker trace correlation/merge, "

@@ -262,3 +262,16 @@ def test_bench_emits_contract_json():
         assert bc["probe"]["parity"] is True
     assert "crossover_w" in bc
     assert bc["headline_pallas_dispatches"] >= 0
+    # Static verification plane (ISSUE 15 acceptance shape): the full
+    # lint ran inside bench — every rule, every registered kernel
+    # family — found nothing on a clean tree, and reported its
+    # wall-clock.
+    an = d["analysis"]
+    assert len(an["rules_run"]) == 12
+    assert len(an["families"]) == 10
+    assert "wgl-scan" in an["families"] and \
+        "pallas-wgl" in an["families"]
+    assert an["files_scanned"] > 80
+    assert an["findings"] == 0 and an["by_rule"] == {}
+    assert an["suppressed"] == 0        # the committed baseline is empty
+    assert an["wall_s"] > 0

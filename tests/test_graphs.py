@@ -472,11 +472,33 @@ def test_kill_and_resume_redispatches_zero_decided_graphs(tmp_path):
 # ----------------------------------------------- host-purity (no jit)
 
 @pytest.mark.fast
-def test_extraction_and_oracle_are_pure_host_side():
+def test_extraction_and_oracle_are_statically_pure_host_side():
     """Edge extraction, bitset packing, the DFS oracle, and witness
-    refinement must run without jax even importable — they are the
-    embarrassingly-parallel host preprocessing by contract; only the
-    closure kernel itself touches the device."""
+    refinement are host preprocessing by contract; only the closure
+    kernel touches the device. The static import-graph pass
+    (analysis.ast_lint JTL-H-PURITY) proves it structurally: graph's
+    module-level import closure never reaches jax, and the one lazy
+    jax import lives inside the declared device entry
+    (graph_kernel). One runtime subprocess smoke remains below as
+    belt-and-suspenders."""
+    from pathlib import Path
+
+    from jepsen_tpu.analysis import H_PURITY
+    from jepsen_tpu.analysis.ast_lint import (HOST_PURE_ROOTS,
+                                              lint_tree)
+
+    root = Path(__file__).resolve().parent.parent
+    rep = lint_tree(root)
+    purity = [f for f in rep.findings if f.rule == H_PURITY]
+    assert purity == [], [f.to_dict() for f in purity]
+    assert "jepsen_tpu.ops.graph" in HOST_PURE_ROOTS
+    assert "jepsen_tpu.workloads.synth" in HOST_PURE_ROOTS
+
+
+@pytest.mark.fast
+def test_extraction_subprocess_smoke():
+    """Belt-and-suspenders runtime smoke (one per family): extraction
+    + the DFS oracle run end to end with jax imports hard-blocked."""
     import subprocess
     import sys
     from pathlib import Path
@@ -490,16 +512,11 @@ class _Block:
         return None
 
 sys.meta_path.insert(0, _Block())
-from jepsen_tpu.ops.graph import (check_graph_host, encode_graphs,
-                                  extract_graph)
+from jepsen_tpu.ops.graph import check_graph_host, extract_graph
 from jepsen_tpu.workloads.synth import synth_la_history
 
-graphs = [extract_graph(synth_la_history(s, corrupt=1.0 if s % 2 else 0.0))
-          for s in range(8)]
-rs = [check_graph_host(g) for g in graphs]
-assert any(r["valid"] for r in rs) and any(not r["valid"] for r in rs)
-assert all(r["cycle"] for r in rs if not r["valid"])
-assert encode_graphs(graphs)
+g = extract_graph(synth_la_history(1, corrupt=1.0))
+assert not check_graph_host(g)["valid"]
 assert "jax" not in sys.modules
 print("HOST-PURE")
 """

@@ -91,19 +91,39 @@ def test_chunked_generation_is_bit_identical():
     assert (np.concatenate([a.kind, b.kind]) == full.kind).all()
 
 
-def test_numpy_twin_is_host_pure():
-    """The numpy backend must run without jax anywhere in the process
-    — the subprocess host-purity gate (PR-2/PR-4 discipline): the
-    twin is the parity oracle AND the no-accelerator fallback."""
+def test_numpy_twin_is_statically_host_pure():
+    """The static import-graph proof (analysis.ast_lint JTL-H-PURITY,
+    doc/analysis.md): synth_device's MODULE-LEVEL import closure never
+    reaches jax, and in-module jax imports sit only inside the
+    declared device entries — so the numpy twin is import-safe
+    without jax BY CONSTRUCTION, not just on the one path a runtime
+    gate happens to execute. This replaced the broad subprocess gate;
+    test_numpy_twin_subprocess_smoke keeps one runtime check as
+    belt-and-suspenders."""
+    from pathlib import Path
+
+    from jepsen_tpu.analysis import H_PURITY
+    from jepsen_tpu.analysis.ast_lint import lint_tree
+
+    root = Path(__file__).resolve().parent.parent
+    rep = lint_tree(root)
+    purity = [f for f in rep.findings if f.rule == H_PURITY]
+    assert purity == [], [f.to_dict() for f in purity]
+    # The proof covered this family: the root is in the declared set.
+    from jepsen_tpu.analysis.ast_lint import HOST_PURE_ROOTS
+    assert "jepsen_tpu.ops.synth_device" in HOST_PURE_ROOTS
+
+
+def test_numpy_twin_subprocess_smoke():
+    """Belt-and-suspenders runtime smoke (one per family): the cas
+    twin actually generates under numpy with jax never imported."""
     code = (
         "import sys\n"
         "from jepsen_tpu.ops.synth_device import SynthSpec, "
-        "synth_cas_device, synth_la_device\n"
+        "synth_cas_device\n"
         "spec = SynthSpec(family='cas', n=8, seed=1, n_procs=3, "
         "n_ops=10, n_values=2, corrupt=0.5, p_info=0.2)\n"
         "synth_cas_device(spec, backend='numpy')\n"
-        "synth_la_device(SynthSpec(family='la', n=4, seed=1, "
-        "n_ops=8), backend='numpy')\n"
         "assert not any(m == 'jax' or m.startswith('jax.') "
         "for m in sys.modules), 'jax imported on the host path'\n"
         "print('PURE')\n")
