@@ -222,8 +222,12 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
     rate_probe = None
     if os.environ.get("JT_BENCH_PROBE", "1") != "0":
         from jepsen_tpu import fleet as _fleet
+        from jepsen_tpu.ops.dc_monitor import probe_rates as _dc_probe
         from jepsen_tpu.ops.pallas_wgl import probe_rates as _probe_rates
         rate_probe = _probe_rates()
+        _dcp = _dc_probe()
+        rate_probe["dc_events_per_s"] = _dcp.get("dc_events_per_s", 0.0)
+        rate_probe["dc_parity"] = _dcp.get("parity")
         _fleet.set_measured_rates(rate_probe)
     import numpy as np
     from jepsen_tpu.checkers.linearizable import wgl_check
@@ -1906,10 +1910,12 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
     # JT_BENCH_COMPARE_WS / _B / _EVENTS size it.
     backend_compare = None
     if os.environ.get("JT_BENCH_BACKEND_COMPARE", "1") != "0":
+        from jepsen_tpu.ops import dc_monitor as _dc
         from jepsen_tpu.ops import pallas_wgl as _pw
         from jepsen_tpu.ops.linearize import get_kernel as _bc_getk
         ws = [int(w) for w in os.environ.get(
-            "JT_BENCH_COMPARE_WS", "4,6,8,10").split(",") if w.strip()]
+            "JT_BENCH_COMPARE_WS",
+            "4,6,8,10,11,12").split(",") if w.strip()]
         CBB = int(os.environ.get("JT_BENCH_COMPARE_B", "256"))
         CBE = int(os.environ.get("JT_BENCH_COMPARE_EVENTS", "256"))
         points = []
@@ -1921,7 +1927,9 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
             point = {"W": w, "rows": CBB, "events": CBE,
                      "xla_hist_per_s": round(CBB / max(t_x, 1e-9), 2),
                      "pallas_hist_per_s": None,
-                     "pallas_speedup": None, "winner": "xla"}
+                     "pallas_speedup": None,
+                     "dc_hist_per_s": None,
+                     "dc_speedup": None, "winner": "xla"}
             if _pw.pallas_available() and _pw.pallas_supports(8, w):
                 try:
                     pk = _pw.get_pallas_kernel(8, w, shared_target=True)
@@ -1937,8 +1945,34 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
                     # no error field would read as "scan won" on the
                     # TPU box this table exists to measure.
                     point["pallas_error"] = repr(e)[:200]
+            if _dc.dc_available():
+                # The peel loop on the same (rows, events) shape at
+                # this W: flat in W by construction, so its column is
+                # the 2^W tail's counter-curve made measurable.
+                try:
+                    d_inv, d_cl, d_act = _dc.make_probe_plan(
+                        rows=CBB, events=CBE, w=w)
+                    _dc.dc_decide(d_inv, d_cl, d_act)   # compile
+                    t_d = None
+                    for _ in range(max(1, repeats)):
+                        _t0 = time.perf_counter()
+                        _dc.dc_decide(d_inv, d_cl, d_act)
+                        _dt = time.perf_counter() - _t0
+                        t_d = _dt if t_d is None else min(t_d, _dt)
+                    best_dev = min(v for v in (
+                        t_x, None if point["pallas_hist_per_s"] is None
+                        else CBB / point["pallas_hist_per_s"])
+                        if v is not None)
+                    point["dc_hist_per_s"] = round(
+                        CBB / max(t_d, 1e-9), 2)
+                    point["dc_speedup"] = round(best_dev / t_d, 3)
+                    if t_d < best_dev:
+                        point["winner"] = "dc"
+                except Exception as e:
+                    point["dc_error"] = repr(e)[:200]
             points.append(point)
         wins = [p["W"] for p in points if p["winner"] == "pallas"]
+        dc_wins = [p["W"] for p in points if p["winner"] == "dc"]
         backend_compare = {
             "mode": _pw.pallas_mode(),
             "backend_forced": bench_backend or "auto",
@@ -1947,9 +1981,15 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
             # the scan (None = the scan won everywhere, e.g. every
             # interpret-mode host).
             "crossover_w": max(wins) if wins else None,
+            # Smallest W at which the peel loop beats every frontier
+            # backend — past it the 2^W curve never catches back up
+            # (None = dc never won, e.g. disabled).
+            "dc_crossover_w": min(dc_wins) if dc_wins else None,
             "probe": rate_probe,
             "headline_pallas_dispatches":
                 sched_stats.get("pallas_dispatches", 0) or 0,
+            "headline_dc_dispatches":
+                sched_stats.get("dc_dispatches", 0) or 0,
         }
 
     # ---- Static verification plane (ISSUE 15): run the full lint —

@@ -66,6 +66,13 @@ FAMILY_PRIMS: Dict[str, frozenset] = {
     "synth": _COMMON | {"argmax", "cumsum", "device_put", "div",
                         "rem", "reduce_max", "sign"},
     "pallas": _COMMON | {"pallas_call", "program_id", "get", "swap"},
+    # The decrease-and-conquer peel loop is segment folds + gathers on
+    # the VPU: scatter-min/max by cluster id, argmin for the two-minima
+    # outside bound, reduce_min for the second minimum. A dot_general
+    # in a peel fold is a finding — there is no contraction anywhere
+    # in the algorithm.
+    "dc": _COMMON | {"argmin", "reduce_min", "scatter-min",
+                     "scatter-max"},
 }
 FAMILY_DTYPES: Dict[str, frozenset] = {
     "wgl": frozenset({"bool", "int8", "int32", "uint32"}),
@@ -73,6 +80,7 @@ FAMILY_DTYPES: Dict[str, frozenset] = {
     "fold": frozenset({"bool", "int32"}),
     "synth": frozenset({"bool", "int8", "int16", "int32", "uint32"}),
     "pallas": frozenset({"bool", "int8", "int32", "uint32"}),
+    "dc": frozenset({"bool", "int32"}),
 }
 
 
@@ -349,6 +357,13 @@ def probe_specs() -> Dict[str, dict]:
             jnp, kk, width=6, n_values=3, invalid=True))
         return fn, (_sd((B,), np.uint32),)
 
+    def dc_peel():
+        from ..ops.dc_monitor import get_dc_kernel
+        E = 64
+        return (get_dc_kernel(E),
+                (_sd((B, E), np.int32), _sd((B, E), np.int32),
+                 _sd((B, E), bool)))
+
     def pallas_wgl():
         from ..ops.pallas_wgl import event_block, make_pallas_kernel
         EB = event_block()
@@ -370,6 +385,7 @@ def probe_specs() -> Dict[str, dict]:
         "synth-la": {"build": synth_la, "kind": "synth"},
         "synth-wide": {"build": synth_wide, "kind": "synth"},
         "pallas-wgl": {"build": pallas_wgl, "kind": "pallas"},
+        "dc-peel": {"build": dc_peel, "kind": "dc"},
     }
 
 

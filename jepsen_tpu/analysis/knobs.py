@@ -92,7 +92,7 @@ _k("JT_DISPATCH_COST_LANE_OPS_PER_S", "1e8", "float",
    "ops/schedule.py",
    "Lane-op rate the dispatch-cost model and router price WGL with.")
 _k("JT_WGL_BACKEND", "auto", "str", "ops/schedule.py",
-   "WGL backend force: auto | scan | pallas.")
+   "WGL backend force: auto | xla | pallas | dc.")
 _k("JT_SHARD_MIN_ROWS", None, "int", "parallel/mesh.py",
    "Rows-per-device floor below which the dataN route falls back to "
    "the single-device kernel (default MIN_ROWS_PER_DEVICE).")
@@ -116,6 +116,27 @@ _k("JT_PALLAS_VMEM_BYTES", str(16 << 20), "int", "ops/pallas_wgl.py",
 _k("JT_PALLAS_LANE_OPS_PER_S", "0.0", "float", "fleet.py",
    "Router rate override for the Pallas backend (0 = unpriced until "
    "probed).")
+
+# ----------------------------------------- decrease-and-conquer (dc)
+_k("JT_ROUTER_DC", "1", "flag", "ops/dc_monitor.py",
+   "Decrease-and-conquer peel backend master switch (0 removes it "
+   "from pricing, routing and forced dispatch — pre-r17 routing "
+   "bit-for-bit).")
+_k("JT_DC_MAX_ROUNDS", "0", "int", "ops/dc_monitor.py",
+   "Peel-round cap per dispatch (0 = the sound structural bound, one "
+   "round per value cluster; lower turns slow rows into scan "
+   "residue).")
+_k("JT_DC_RESIDUE_MAX_FRAC", "0.5", "float", "ops/dc_monitor.py",
+   "Auto-routing gate: the peel pre-filter engages only when at most "
+   "this fraction of a bucket's rows would fall through to the scan "
+   "anyway.")
+_k("JT_DC_EVENTS_PER_S", "0.0", "float", "fleet.py",
+   "Router rate override for the peel backend (0 = unpriced until "
+   "probed).")
+_k("JT_ONLINE_DC", "0", "flag", "ops/dc_monitor.py",
+   "Online daemon: serve interim ticks from the incremental peel "
+   "carry before the resident frontier (1 enables; default off keeps "
+   "the daemon bit-identical).")
 
 # ----------------------------------------------------- store/runtime
 _k("JT_WAL_FLUSH_MS", "50", "float", "history/wal.py",

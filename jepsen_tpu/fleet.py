@@ -134,7 +134,8 @@ _RATE_ENV = (("lane_ops_per_s", "JT_DISPATCH_COST_LANE_OPS_PER_S"),
              ("host_s_per_event", "JT_HOST_S_PER_EVENT"),
              ("macs_per_s", "JT_GRAPH_MACS_PER_S"),
              ("graph_host_s_per_edge", "JT_GRAPH_HOST_S_PER_EDGE"),
-             ("pallas_lane_ops_per_s", "JT_PALLAS_LANE_OPS_PER_S"))
+             ("pallas_lane_ops_per_s", "JT_PALLAS_LANE_OPS_PER_S"),
+             ("dc_events_per_s", "JT_DC_EVENTS_PER_S"))
 
 
 def set_measured_rates(rates: Optional[Dict[str, float]]) -> None:
@@ -158,7 +159,10 @@ def router_rates() -> Dict[str, float]:
     (ops/linearize.py's wide-tail comment: ~0.4 s per ~1k-event row);
     ``macs_per_s`` prices the MXU closure; ``graph_host_s_per_edge``
     the host DFS; ``pallas_lane_ops_per_s`` the Pallas WGL megakernel
-    (0 = unprobed/unavailable, which prices it out of every route).
+    (0 = unprobed/unavailable, which prices it out of every route);
+    ``dc_events_per_s`` the decrease-and-conquer peel loop's W-flat
+    near-linear event rate (same 0 = priced-out convention, so an
+    unprobed process routes bit-identically to the pre-dc tree).
     Precedence: defaults < probe-measured overlay (set_measured_rates
     / probe_and_persist / persisted store rates) < explicit env pins —
     a deployment that measures its own crossover pins it, exactly like
@@ -171,6 +175,7 @@ def router_rates() -> Dict[str, float]:
         "macs_per_s": 1e12,
         "graph_host_s_per_edge": 2e-6,
         "pallas_lane_ops_per_s": 0.0,
+        "dc_events_per_s": 0.0,
     }
     out.update(_MEASURED_RATES)
     for key, env in _RATE_ENV:
@@ -236,8 +241,9 @@ def load_persisted_rates(store_dir,
 def probe_and_persist(store_dir=None, *, force: bool = False
                       ) -> Dict[str, float]:
     """The startup rate probe: measure the WGL device backends
-    (lax.scan and Pallas, ops.pallas_wgl.probe_rates) plus the host
-    oracle's per-event cost on one tiny workload, install the result
+    (lax.scan and Pallas, ops.pallas_wgl.probe_rates; the
+    decrease-and-conquer peel loop, ops.dc_monitor.probe_rates) plus
+    the host oracle's per-event cost on one tiny workload, install the result
     as the process-wide overlay (set_measured_rates), and persist it
     under this host's key when a store dir is given. Memoized per
     process — the probe pays two tiny kernel compiles once."""
@@ -248,6 +254,12 @@ def probe_and_persist(store_dir=None, *, force: bool = False
         rates = {"lane_ops_per_s": out.get("lane_ops_per_s") or 0.0,
                  "pallas_lane_ops_per_s":
                      out.get("pallas_lane_ops_per_s") or 0.0}
+        try:
+            from .ops.dc_monitor import probe_rates as dc_probe
+            rates["dc_events_per_s"] = (
+                dc_probe().get("dc_events_per_s") or 0.0)
+        except Exception:
+            rates["dc_events_per_s"] = 0.0
         try:
             from .checkers.linearizable import wgl_check
             from .workloads.synth import synth_cas_history
@@ -374,7 +386,7 @@ class CostRouter:
 
     # ---------------------------------------------------------- pricing
     def price_wgl(self, w: int, n_events: int,
-                  rows: int = 1) -> Dict[str, float]:
+                  rows: int = 1, *, dc: bool = False) -> Dict[str, float]:
         """Per-unit cost of a linearizable unit at post-partition
         window ``w`` and ``n_events`` history lines: the device scan
         pays 2^w frontier lanes per event plus its amortized dispatch
@@ -383,7 +395,12 @@ class CostRouter:
         CAPABLE (narrow window, kernel available) and PROBED (a
         measured rate exists — startup probe, persisted store entry,
         or env pin); absent either, the cost dict is bit-identical to
-        the pre-pallas router."""
+        the pre-pallas router. The decrease-and-conquer peel loop
+        (``wgl-dc``) prices under the same contract — capable
+        (``dc=True``: the caller sniffed a register-class unit,
+        ops.dc_monitor.dc_capable_history), available
+        ($JT_ROUTER_DC), probed (``dc_events_per_s``) — and is the
+        only device term FLAT in W: events/rate, no 2^w factor."""
         dev = (n_events * float(1 << min(int(w), 30))
                / self.rates["lane_ops_per_s"]
                + self._overhead_s() / max(int(rows), 1))
@@ -396,6 +413,14 @@ class CostRouter:
                 costs["wgl-pallas"] = (
                     n_events * float(1 << min(int(w), 30)) / pr
                     + self._overhead_s() / max(int(rows), 1))
+        if dc:
+            dr = float(self.rates.get("dc_events_per_s") or 0.0)
+            if dr > 0:
+                from .ops.dc_monitor import dc_available
+                if dc_available():
+                    costs["wgl-dc"] = (
+                        n_events / dr
+                        + self._overhead_s() / max(int(rows), 1))
         return costs
 
     def price_online_tick(self, w: int, prefix_events: int,
@@ -438,11 +463,18 @@ class CostRouter:
         self.est_cost_s[backend] = (self.est_cost_s.get(backend, 0.0)
                                     + costs[backend])
 
-    def choose_wgl(self, w: int, n_events: int,
-                   rows: int = 1) -> Tuple[str, Dict[str, float]]:
-        costs = self.price_wgl(w, n_events, rows)
-        backend = ("host-oracle" if w > self.max_device_w
-                   else min(costs, key=costs.get))
+    def choose_wgl(self, w: int, n_events: int, rows: int = 1, *,
+                   dc: bool = False) -> Tuple[str, Dict[str, float]]:
+        costs = self.price_wgl(w, n_events, rows, dc=dc)
+        if w > self.max_device_w:
+            # Past the frontier-sharded mask axis no 2^w backend is
+            # capable — but the peel loop carries no frontier at all,
+            # so it stays eligible at ANY width.
+            elig = {k: v for k, v in costs.items()
+                    if k in ("host-oracle", "wgl-dc")}
+            backend = min(elig, key=elig.get)
+        else:
+            backend = min(costs, key=costs.get)
         self._record(backend, costs)
         return backend, costs
 
@@ -481,9 +513,13 @@ class CostRouter:
         backends' prices and the winner — the crossover made visible."""
         out = []
         for w in ws:
-            costs = self.price_wgl(w, events)
-            backend = ("host-oracle" if w > self.max_device_w
-                       else min(costs, key=costs.get))
+            costs = self.price_wgl(w, events, dc=True)
+            if w > self.max_device_w:
+                elig = {k: v for k, v in costs.items()
+                        if k in ("host-oracle", "wgl-dc")}
+                backend = min(elig, key=elig.get)
+            else:
+                backend = min(costs, key=costs.get)
             out.append({"W": w, "events": events, "backend": backend,
                         **{k: round(v, 6) for k, v in costs.items()}})
         return out
@@ -519,21 +555,24 @@ def route_check(model, histories: Sequence, *, router: Optional[
             edges = sum(int(e.shape[0]) for e in g.edges.values())
             backend, _ = router.choose_graph(g.n, edges)
         else:
-            backend, _ = router.choose_wgl(estimate_w(h), len(h))
+            from .ops.dc_monitor import dc_capable_history
+            backend, _ = router.choose_wgl(estimate_w(h), len(h),
+                                           dc=dc_capable_history(h))
         plan.append((i, backend))
     groups: Dict[str, List[int]] = {}
     for i, backend in plan:
         groups.setdefault(backend, []).append(i)
     results: List[Optional[dict]] = [None] * n
 
-    # Both WGL device groups ride the same fused columnar pipeline
+    # The WGL device groups ride the same fused columnar pipeline
     # with the scheduler's per-chunk backend PINNED to the router's
     # group decision (the router already decided the crossover;
     # letting the scheduler re-price per chunk — or pick up a stray
     # JT_WGL_BACKEND force — would let dispatches disagree with the
     # plan and with the ``backend`` tag on the results).
     for group, forced in (("wgl-device", "xla"),
-                          ("wgl-pallas", "pallas")):
+                          ("wgl-pallas", "pallas"),
+                          ("wgl-dc", "dc")):
         if not groups.get(group):
             continue
         from .ops.linearize import check_batch_columnar

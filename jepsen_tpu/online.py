@@ -339,6 +339,11 @@ class OnlineCheckEngine:
         self.kinds: Optional[list] = None
         self.resident = ResidentState()
         self.host = cfg.host_engine or wgl_check
+        # $JT_ONLINE_DC: per-tenant decrease-and-conquer carries
+        # (ops.dc_monitor.IncrementalDC), keyed like the resident
+        # frontiers. Certify-only fast path — a tick it cannot serve
+        # falls through to the frontier with verdicts unchanged.
+        self._dc_inc: Dict[Tuple, object] = {}
 
     def check(self, history: List[Op], *, shed: bool = False,
               final: bool = False) -> Tuple[dict, str]:
@@ -389,6 +394,35 @@ class OnlineCheckEngine:
         from .ops.statespace import StateSpaceExplosion
 
         d = tenant.daemon
+        # $JT_ONLINE_DC: the decrease-and-conquer incremental monitor
+        # sits BEFORE the frontier's width guard — its carry is flat
+        # in W, so it serves the wide tenants (peak_w beyond the
+        # device mask axis) the frontier must decline. Certify-only:
+        # a tick it cannot serve (residue, non-register ops, a read of
+        # a pending write) falls through with verdicts unchanged, and
+        # the same soundness guard applies — any mid-advance fault
+        # drops the carried peel state before propagating.
+        from .ops.dc_monitor import online_dc_enabled
+        if online_dc_enabled():
+            from .ops.dc_monitor import IncrementalDC
+            dkey = (tenant.key, tenant.state.ino)
+            inc = self._dc_inc.get(dkey)
+            if inc is None:
+                inc = self._dc_inc.setdefault(dkey, IncrementalDC())
+            try:
+                served = inc.advance(tenant.ops)
+            except Exception:
+                self._dc_inc.pop(dkey, None)
+                raise
+            if served:
+                if inc.last_delta_ops:
+                    d._count("delta_ops", inc.last_delta_ops)
+                    telemetry.REGISTRY.counter(
+                        "online.dc_delta_ops", tenant=tenant.name).inc(
+                        inc.last_delta_ops)
+                tenant.stats["dc_delta_checks"] = \
+                    tenant.stats.get("dc_delta_checks", 0) + 1
+                return {"valid": True}, "online-dc"
         if getattr(tenant, "_no_frontier", False) \
                 or tenant.peak_w > DATA_MAX_SLOTS:
             return None

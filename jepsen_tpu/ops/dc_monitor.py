@@ -1,0 +1,691 @@
+"""Decrease-and-conquer peel-loop monitor — the fifth router backend.
+
+Every WGL-family backend (scan, fused megakernel, event-chunked
+resume, Pallas) pays ``events * 2^W``: the packed frontier enumerates
+the pending-window powerset. "Efficient Decrease-and-Conquer
+Linearizability Monitoring" (arXiv 2410.04581) shows the register
+class never needs the powerset: repeatedly *peel* an extremal value
+cluster — a write and the reads that observed it — whose members can
+all legally linearize before everything still alive, and the history
+is valid iff peeling runs to exhaustion. Cost is near-linear in
+events and FLAT in W, which is exactly the unkeyed wide-window tail
+(W=11+) where the frontier backends fall off a cliff.
+
+The implementation is a *certifying pre-filter*, never a replacement:
+
+  * ``dc_plan(batch)`` derives, on the host and from the
+    ``EncodedBatch`` alone, each op's invocation time (first event
+    whose slot snapshot contains it — the encoder snapshots the
+    pending table at every completion), its response time (its own
+    completion event index), and its value cluster (the event index
+    of the write whose target state the read requires). Capability is
+    decided from the row's transition TABLE, not from op names: a
+    "write" is a kind valid from every state with one target, a
+    "read" a kind that is the identity on exactly one state. Rows
+    with fused events, pinned (info/crashed) ops in the close
+    snapshot, duplicate write values, unmatched reads, cas-like
+    kinds, or a statically impossible read-before-its-write are NOT
+    capable and simply ride the existing WGL pipeline.
+  * the device kernel is a batched, vmapped ``lax.while_loop``: each
+    round is one scatter-min fold (earliest alive response per
+    cluster), one scatter-max fold (latest alive invocation per
+    cluster), a two-minima outside-response bound, and one gather to
+    kill every peelable cluster at once. Peeling all peelable
+    clusters per round is equivalent to peeling them one at a time
+    (removing a peeled cluster only *raises* the others' outside
+    bound), so rounds are bounded by the cluster count and typically
+    O(1) on real histories.
+  * the peel loop only ever *certifies validity* ("every op peeled").
+    Stuck or incapable rows — the residue — fall through to the
+    frontier scan inside the scheduler's one ``_ship`` sequence, so
+    invalid verdicts, witnesses and bad-op indices keep exact parity
+    with every other backend for free.
+
+Soundness of a peel (cluster-first-block argument): let Z be value
+v's cluster, I = max invocation time over Z, and t_out = the earliest
+response among alive ops outside Z. If the write's invocation
+precedes each member read's response (static) and I <= t_out, every
+member can take a linearization point just after I — inside its own
+interval, before every remaining op's response — and any valid
+linearization of the remainder re-places above I (all its responses
+are >= t_out >= I). Conversely a valid history always has a peelable
+cluster: the cluster holding the first-linearized write. So "peeled
+to exhaustion" == valid, and "stuck" == invalid *for capable rows* —
+but stuck rows are conservatively left to the scan anyway, because
+the scan also owns the counterexample decode.
+
+``JT_ROUTER_DC=0`` removes the backend from pricing, routing and
+dispatch entirely; with no probed/pinned ``dc_events_per_s`` rate the
+router never selects it, so default routing is bit-identical to the
+pre-DC tree (the Pallas precedent).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .encode import EV_CLOSE, EV_FUSED, EV_OK, EncodedBatch
+from .folds import _cached_kernel, _pow2
+
+log = logging.getLogger("jepsen.dc")
+
+_BIG = np.int32(1 << 30)
+
+
+# ------------------------------------------------------------- gates
+
+def dc_available() -> bool:
+    """$JT_ROUTER_DC=0 removes the decrease-and-conquer backend from
+    pricing, auto-routing AND forced dispatch — the full-disable
+    switch, mirroring $JT_ROUTER_PALLAS."""
+    return os.environ.get("JT_ROUTER_DC", "1") != "0"
+
+
+def dc_max_rounds() -> int:
+    """$JT_DC_MAX_ROUNDS caps peel rounds per dispatch (0 = the sound
+    structural bound, one round per value cluster). A lower cap turns
+    slow-converging rows into residue for the scan instead of
+    spinning the while_loop."""
+    try:
+        return max(0, int(os.environ.get("JT_DC_MAX_ROUNDS", "0")))
+    except ValueError:
+        return 0
+
+
+def dc_residue_max_frac() -> float:
+    """$JT_DC_RESIDUE_MAX_FRAC: in auto routing, the peel pre-filter
+    only engages when at most this fraction of a bucket's rows would
+    fall through to the scan anyway (capability measured on the real
+    plan) — a mostly-incapable bucket must not pay dc + scan."""
+    try:
+        return min(1.0, max(0.0, float(
+            os.environ.get("JT_DC_RESIDUE_MAX_FRAC", "0.5"))))
+    except ValueError:
+        return 0.5
+
+
+def online_dc_enabled() -> bool:
+    """$JT_ONLINE_DC=1 wires the incremental peel monitor into the
+    online daemon's delta tick (default off: the daemon's default
+    behavior stays bit-identical)."""
+    return os.environ.get("JT_ONLINE_DC", "0") != "0"
+
+
+# ------------------------------------------------- history-level sniff
+
+def dc_capable_history(history) -> bool:
+    """Cheap Op-list sniff the router prices from (the real decision
+    replays on the encoded plan): every client op completes ok, ops
+    are plain read/write, written values are distinct, and every
+    observed read value was written. Conservative — False only means
+    the router won't price the dc backend for this unit."""
+    writes: set = set()
+    reads: List[object] = []
+    open_inv: Dict[object, str] = {}
+    for op in history:
+        if not getattr(op, "is_client", True):
+            continue
+        if op.type == "invoke":
+            if op.f not in ("read", "write"):
+                return False
+            open_inv[op.process] = op.f
+        elif op.type == "ok":
+            open_inv.pop(op.process, None)
+            if op.f == "write":
+                if op.value in writes:
+                    return False
+                writes.add(op.value)
+            elif op.f == "read":
+                if op.value is not None:
+                    reads.append(op.value)
+            else:
+                return False
+        else:                      # fail/info: pending-forever class
+            return False
+    if open_inv:
+        return False
+    return all(v in writes for v in reads)
+
+
+# ---------------------------------------------------- space capability
+
+def _space_roles(space) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray]]:
+    """Classify one StateSpace's kinds from the transition TABLE:
+    returns (is_write[K], is_read[K], state_of[K]) where a write is a
+    constant map valid from every state (state_of = its target) and a
+    read is the identity on exactly one state (state_of = it), or
+    None when any non-identity kind fits neither role (cas-like) —
+    the whole vocabulary is then incapable. Identity kinds have both
+    flags False and constrain nothing (the stacked encoder drops
+    them; the columnar walk keeps them — the plan masks them out)."""
+    tgt = np.asarray(space.target)
+    K, S = tgt.shape
+    is_w = np.zeros(K, bool)
+    is_r = np.zeros(K, bool)
+    st = np.full(K, -1, np.int32)
+    ident = space.identity_kinds
+    states = np.arange(S)
+    for k in range(K):
+        row = tgt[k]
+        if k in ident:
+            continue
+        if (row >= 0).all() and len(np.unique(row)) == 1:
+            is_w[k] = True
+            st[k] = int(row[0])
+        else:
+            ok = row == states
+            if int(ok.sum()) == 1 and (row[~ok] < 0).all():
+                is_r[k] = True
+                st[k] = int(states[ok][0])
+            else:
+                return None
+    return is_w, is_r, st
+
+
+# ----------------------------------------------------------- the plan
+
+@dataclass
+class DCPlan:
+    """Host-derived peel-loop inputs for one encoded bucket. Ops are
+    indexed by their completion event (the encoder emits exactly one
+    event per ok completion), so ``resp(op) == its event index``."""
+
+    inv: np.ndarray        # int32 [B, E] first-appearance event index
+    cluster: np.ndarray    # int32 [B, E] event index of the value's write
+    active: np.ndarray     # bool  [B, E] capable-row op events
+    capable: np.ndarray    # bool  [B]
+
+    @property
+    def capable_frac(self) -> float:
+        b = len(self.capable)
+        return float(self.capable.sum()) / b if b else 0.0
+
+
+def dc_plan(batch: EncodedBatch) -> Optional[DCPlan]:
+    """Derive the peel plan from the encoded arrays alone — no caller
+    plumbing: invocation times come from a per-slot first-seen walk
+    over the snapshots (reset at each completion of the slot; the
+    snapshot at a completion still CONTAINS the completing op), value
+    clusters from the transition-table roles. Returns None when no
+    row is capable (or the batch carries no spaces)."""
+    if not batch.spaces or len(batch.spaces) != batch.batch:
+        return None
+    B, E = batch.ev_type.shape
+    K = batch.target.shape[1] - 1              # empty-slot sentinel
+    etype = np.asarray(batch.ev_type)
+    eslot = np.asarray(batch.ev_slot).astype(np.int64)
+    slots = np.asarray(batch.ev_slots)
+
+    capable = ~(etype == EV_FUSED).any(axis=1)
+    is_ok = etype == EV_OK
+    # The close snapshot is the end-of-history pending table: pinned
+    # info/crashed ops stay optional-to-linearize forever, a case the
+    # peel loop does not model.
+    close = etype == EV_CLOSE
+    has_close = close.any(axis=1)
+    capable &= has_close
+    ci = np.argmax(close, axis=1)
+    capable &= (slots[np.arange(B), ci] == K).all(axis=1)
+
+    # Completing op's kind per event: the snapshot row at its slot.
+    kind = np.take_along_axis(slots, eslot[:, :, None],
+                              axis=2)[:, :, 0].astype(np.int64)
+    kind = np.where(is_ok, kind, K)
+
+    # Per-slot first-seen walk -> invocation event index per op.
+    inv = np.zeros((B, E), np.int32)
+    occ = np.full((B, batch.ev_slots.shape[2]), -1, np.int32)
+    comp = is_ok | (etype == EV_FUSED)
+    for e in range(E):
+        snap = slots[:, e, :]
+        newly = (snap != K) & (occ < 0)
+        occ[newly] = e
+        r = np.flatnonzero(comp[:, e])
+        if r.size:
+            s = eslot[r, e]
+            inv[r, e] = occ[r, s]
+            occ[r, s] = -1
+
+    active = np.zeros((B, E), bool)
+    cluster = np.full((B, E), -1, np.int32)
+    rows = np.arange(B)
+
+    # Group rows by their StateSpace: role tables are per-vocabulary.
+    by_space: Dict[int, List[int]] = {}
+    spaces: Dict[int, object] = {}
+    for b in np.flatnonzero(capable):
+        sp = batch.spaces[b]
+        by_space.setdefault(id(sp), []).append(int(b))
+        spaces[id(sp)] = sp
+    for sid, rws in by_space.items():
+        sp = spaces[sid]
+        roles = _space_roles(sp)
+        r = np.asarray(rws)
+        if roles is None:
+            capable[r] = False
+            continue
+        is_w, is_r, st = roles
+        nk = len(is_w)
+        k = kind[r]                      # [b, E], sentinel K when pad
+        known = k < nk
+        # Fused-composed or foreign kind ids under a merged table.
+        capable[r[((k != K) & ~known).any(axis=1)]] = False
+        k = np.where(known, k, 0)
+        w_ev = known & is_w[k] & is_ok[r]
+        r_ev = known & is_r[k] & is_ok[r]
+        act = w_ev | r_ev                # identity kinds drop out
+        val = np.where(act, st[k], -1)   # register state == value id
+        S = sp.n_states
+        # One write per target state per row; duplicates -> incapable.
+        wcount = np.zeros((len(r), S), np.int64)
+        bw, ew = np.nonzero(w_ev)
+        np.add.at(wcount, (bw, val[bw, ew]), 1)
+        capable[r[(wcount > 1).any(axis=1)]] = False
+        wpos = np.full((len(r), S), -1, np.int32)
+        wpos[bw, val[bw, ew]] = ew
+        cl = np.where(act, wpos[np.arange(len(r))[:, None],
+                                np.clip(val, 0, S - 1)], -1)
+        # A read of a never-written (e.g. initial) state: incapable —
+        # the virtual initial write has no interval to peel against.
+        capable[r[(act & (cl < 0)).any(axis=1)]] = False
+        # Static order: a read's write must be invoked before the
+        # read responds, else the history cannot be valid — leave the
+        # verdict (and the witness) to the scan.
+        inv_w = inv[r[:, None], np.clip(cl, 0, E - 1)]
+        bad = act & (cl >= 0) & (inv_w > np.arange(E)[None, :])
+        capable[r[bad.any(axis=1)]] = False
+        active[r] = act
+        cluster[r] = cl
+
+    active &= capable[:, None]
+    if not capable.any():
+        return None
+    return DCPlan(inv=inv, cluster=np.where(active, cluster, 0),
+                  active=active, capable=capable)
+
+
+_PLAN_MISS = object()
+
+
+def dc_plan_for(batch: EncodedBatch) -> Optional[DCPlan]:
+    """Per-batch memo of ``dc_plan`` (stashed on the batch object —
+    chunks of one bucket share one plan)."""
+    p = getattr(batch, "_dc_plan", _PLAN_MISS)
+    if p is _PLAN_MISS:
+        p = dc_plan(batch)
+        try:
+            batch._dc_plan = p
+        except Exception:                          # pragma: no cover
+            pass
+    return p
+
+
+# ------------------------------------------------------ the host twin
+
+def dc_host_decide(inv: np.ndarray, cluster: np.ndarray,
+                   active: np.ndarray,
+                   max_rounds: int = 0) -> np.ndarray:
+    """Pure-numpy parity oracle for the device peel loop: identical
+    round structure (segment folds + two minima + batch peel), no
+    jax. Returns decided-valid [B] bool."""
+    B, E = active.shape
+    resp = np.arange(E, dtype=np.int32)
+    cap = max_rounds or E + 1
+    decided = np.zeros(B, bool)
+    for b in range(B):
+        alive = active[b].copy()
+        rounds = 0
+        while alive.any() and rounds < cap:
+            rounds += 1
+            cl = cluster[b]
+            m_resp = np.full(E, _BIG, np.int32)
+            np.minimum.at(m_resp, cl[alive], resp[alive])
+            m_inv = np.full(E, -1, np.int32)
+            np.maximum.at(m_inv, cl[alive], inv[b][alive])
+            has = m_resp < _BIG
+            a1 = int(np.argmin(m_resp))
+            g1 = m_resp[a1]
+            m2 = m_resp.copy()
+            m2[a1] = _BIG
+            g2 = m2.min()
+            t_out = np.where(np.arange(E) == a1, g2, g1)
+            peel = has & (m_inv <= t_out)
+            new_alive = alive & ~peel[cl]
+            if (new_alive == alive).all():
+                break
+            alive = new_alive
+        decided[b] = not alive.any()
+    return decided
+
+
+# -------------------------------------------------- the device kernel
+
+_DC_KERNELS: Dict = {}
+
+
+def get_dc_kernel(E: int, max_rounds: int = 0):
+    """The batched vmapped peel loop for an E-event bucket (cached per
+    (E, round cap)): per while_loop round one scatter-min / one
+    scatter-max segment fold by cluster id, the two-minima outside
+    bound, and one gather killing every peelable cluster. VPU-only by
+    construction — no dot_general ever appears in the trace (pinned
+    by the jaxpr lint's ``dc`` family allowlist)."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cap = max_rounds or E + 1
+
+        def one(inv, cluster, active):
+            resp = jnp.arange(E, dtype=jnp.int32)
+            big = jnp.int32(1 << 30)
+            idx = jnp.arange(E)
+
+            def body(state):
+                alive, _, rounds = state
+                cl = jnp.where(alive, cluster, 0)
+                m_resp = jnp.full((E,), big, jnp.int32).at[cl].min(
+                    jnp.where(alive, resp, big))
+                m_inv = jnp.full((E,), -1, jnp.int32).at[cl].max(
+                    jnp.where(alive, inv, -1))
+                has = m_resp < big
+                a1 = jnp.argmin(m_resp)
+                g1 = m_resp[a1]
+                g2 = jnp.min(m_resp.at[a1].set(big))
+                t_out = jnp.where(idx == a1, g2, g1)
+                peel = has & (m_inv <= t_out)
+                new_alive = alive & ~peel[cluster]
+                prog = jnp.any(new_alive != alive)
+                return new_alive, prog, rounds + 1
+
+            def cond(state):
+                alive, prog, rounds = state
+                return prog & jnp.any(alive) & (rounds < cap)
+
+            alive, _, rounds = lax.while_loop(
+                cond, body, (active, jnp.bool_(True), jnp.int32(0)))
+            return ~jnp.any(alive), rounds
+
+        return jax.jit(jax.vmap(one))
+    return _cached_kernel(_DC_KERNELS, (int(E), int(max_rounds)), build)
+
+
+def dc_decide(inv: np.ndarray, cluster: np.ndarray,
+              active: np.ndarray) -> np.ndarray:
+    """Run the device peel loop over plan rows (padded to pow2 shapes
+    so the jit cache stays bounded). Returns decided-valid [B] bool —
+    True ONLY for rows every op of which was peeled."""
+    B, E = active.shape
+    Bp, Ep = _pow2(max(B, 1)), _pow2(max(E, 1))
+    pinv = np.zeros((Bp, Ep), np.int32)
+    pcl = np.zeros((Bp, Ep), np.int32)
+    pact = np.zeros((Bp, Ep), bool)
+    pinv[:B, :E] = inv
+    pcl[:B, :E] = np.clip(cluster, 0, Ep - 1)
+    pact[:B, :E] = active
+    kern = get_dc_kernel(Ep, dc_max_rounds())
+    decided, _ = kern(pinv, pcl, pact)
+    return np.asarray(decided)[:B]
+
+
+def dc_prefilter_chunk(batch: EncodedBatch, lo: int,
+                       hi: int) -> Optional[np.ndarray]:
+    """The scheduler's per-chunk entry: peel rows [lo, hi) of a
+    bucket. Returns decided-valid [hi-lo] bool (False = residue, the
+    scan decides), or None when the chunk has no capable row (the
+    dispatch proceeds exactly as before)."""
+    plan = dc_plan_for(batch)
+    if plan is None or not plan.capable[lo:hi].any():
+        return None
+    decided = dc_decide(plan.inv[lo:hi], plan.cluster[lo:hi],
+                        plan.active[lo:hi])
+    return decided & plan.capable[lo:hi]
+
+
+# --------------------------------------------------------- rate probe
+
+def make_probe_plan(rows: int = 64, events: int = 128,
+                    w: int = 12) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray]:
+    """A deterministic dc-capable synthetic plan (inv/cluster/active)
+    shaped like the unkeyed wide-window workload: W-overlapped
+    write+read pairs, every cluster peelable — the rate probe and the
+    bench backend_compare column both time the kernel on it."""
+    E = events - (events % 2)
+    inv = np.maximum(0, np.arange(E, dtype=np.int32) - int(w) + 1)
+    cluster = (np.arange(E, dtype=np.int32) // 2) * 2
+    active = np.ones(E, bool)
+    return (np.broadcast_to(inv, (rows, E)).copy(),
+            np.broadcast_to(cluster, (rows, E)).copy(),
+            np.broadcast_to(active, (rows, E)).copy())
+
+
+def probe_rates(rows: int = 64, events: int = 128,
+                repeats: int = 3) -> Dict[str, object]:
+    """Measure the peel kernel's event rate (events/s across the
+    batch) on the synthetic wide-window plan — the router's
+    ``dc_events_per_s`` basis; never hardcoded. Includes a host-twin
+    parity bit on the probe itself."""
+    out: Dict[str, object] = {"dc_events_per_s": 0.0, "probe_s": 0.0,
+                              "parity": None}
+    if not dc_available():
+        return out
+    t0 = time.monotonic()
+    try:
+        inv, cl, act = make_probe_plan(rows=rows, events=events)
+        dev = dc_decide(inv, cl, act)          # compile outside clock
+        best = None
+        for _ in range(max(1, repeats)):
+            t1 = time.perf_counter()
+            dev = dc_decide(inv, cl, act)
+            dt = time.perf_counter() - t1
+            best = dt if best is None else min(best, dt)
+        host = dc_host_decide(inv, cl, act)
+        out["parity"] = bool((dev == host).all())
+        if best and best > 0 and out["parity"]:
+            out["dc_events_per_s"] = (rows * events) / best
+    except Exception:                           # pragma: no cover
+        log.warning("dc rate probe failed", exc_info=True)
+    out["probe_s"] = round(time.monotonic() - t0, 4)
+    return out
+
+
+def router_prefers_dc(w: int, n_events: int, rows: int,
+                      rates: Optional[dict] = None) -> bool:
+    """Would the cost router run the peel pre-filter for this bucket
+    shape? True when the dc term prices below every frontier device
+    backend (the pre-filter's worst case adds its own cost to the
+    scan's, so it must be cheap relative to the scan to be worth
+    skipping scans with)."""
+    from ..fleet import CostRouter
+    r = CostRouter(rates=rates)
+    costs = r.price_wgl(w, n_events, rows, dc=True)
+    dc = costs.get("wgl-dc")
+    if dc is None:
+        return False
+    dev = [v for k, v in costs.items()
+           if k in ("wgl-device", "wgl-pallas")]
+    return bool(dev) and dc < min(dev)
+
+
+# ------------------------------------------------------ batch checking
+
+def dc_check_batch(model, histories: Sequence, *,
+                   details: object = "invalid") -> List[dict]:
+    """Check a batch with the peel pre-filter pinned on
+    (``wgl_backend="dc"``): decided rows skip their scan launch,
+    residue rides the unchanged WGL pipeline inside the same
+    dispatch. Rows whose scan was skipped carry
+    ``provenance="wgl-dc"`` (the scheduler's row_provenance seam);
+    residue rows keep their scan provenance — the verdict path is
+    always named. This is route_check's ``wgl-dc`` group engine and
+    the parity-test seam."""
+    from .linearize import check_batch_columnar
+    rs = check_batch_columnar(model, histories, details=details,
+                              scheduler_opts={"wgl_backend": "dc"})
+    for r in rs:
+        r.setdefault("provenance", "wgl-dc")
+    return rs
+
+
+# --------------------------------------------- incremental (online) DC
+
+class IncrementalDC:
+    """The peel loop's decrement structure at the online daemon's
+    ResidentFrontier seam ($JT_ONLINE_DC): each tick peels only the
+    carried segment — the ops since the last *quiescent cut* — plus
+    whatever arrived since the last tick, never the whole prefix.
+
+    The cut rule is the soundness anchor: when a tick certifies the
+    carry AND no invocation is open, the entire carry seals (drops)
+    and its OVERWRITTEN values are remembered; the current epoch's
+    write — when real time makes it the unique final — re-carries as
+    a cut-pinned pseudo-write so live-value reads stay served. Everything after the cut
+    is invoked in real time after everything before it responded, so
+    a witness for the suffix composes with the sealed prefix's
+    witness by pure concatenation — writes are valid from every
+    state, suffix reads must observe suffix writes, and any late op
+    touching a sealed value latches the carry undecided (the full
+    engine owns that verdict; this monitor only ever *certifies*).
+
+    ``advance`` returns True only for a certified-valid prefix and
+    None whenever it cannot serve the tick — the caller falls through
+    to the resident frontier, verdicts unchanged. Callers must drop
+    the carry on ANY mid-advance fault (the engine's soundness guard
+    does), exactly like the frontier itself."""
+
+    def __init__(self):
+        self.pos = 0                   # consumed history lines
+        self.dead = False
+        self.sealed_values: set = set()
+        self._open: Dict[object, Tuple[str, object, int]] = {}
+        # carried completed client ops since the cut: (inv, resp, f, v)
+        self.ops: List[Tuple[int, int, str, object]] = []
+        self.last_delta_ops = 0
+        self.seals = 0
+
+    def _latch(self) -> None:
+        self.dead = True
+        self.ops = []
+
+    def advance(self, history: Sequence) -> Optional[bool]:
+        if self.dead:
+            return None
+        new = history[self.pos:]
+        self.last_delta_ops = len(new)
+        t = self.pos
+        for op in new:
+            if getattr(op, "is_client", True):
+                if op.type == "invoke":
+                    if op.f not in ("read", "write"):
+                        self._latch()
+                        return None
+                    self._open[op.process] = (op.f, op.value, t)
+                elif op.type == "ok":
+                    ent = self._open.pop(op.process, None)
+                    if ent is None:
+                        self._latch()
+                        return None
+                    f, _, inv_t = ent
+                    if op.value in self.sealed_values:
+                        # A late op on a sealed epoch: either invalid
+                        # or beyond this monitor — never certified.
+                        self._latch()
+                        return None
+                    if f == "read" and op.value is None:
+                        # A read of the initial state: once any write
+                        # sealed the initial value is history, and
+                        # before that the peel order would need a
+                        # virtual epoch — outside this monitor's
+                        # class either way (the full engine decides).
+                        self._latch()
+                        return None
+                    # Times are doubled so a cut-pinned pseudo-write
+                    # can sit STRICTLY between two history lines.
+                    self.ops.append((2 * inv_t, 2 * t, f, op.value))
+                else:                   # fail / info: pending forever
+                    self._latch()
+                    return None
+            t += 1
+        self.pos = len(history)
+        writes = [v for (_, _, f, v) in self.ops if f == "write"]
+        if len(set(writes)) != len(writes):
+            self._latch()
+            return None
+        vals = set(writes)
+        # Reads must observe carried (completed) writes: a read of a
+        # still-pending write means the completed part alone is not
+        # the whole story — not servable this tick, maybe the next.
+        for (_, _, f, v) in self.ops:
+            if f == "read" and v is not None and v not in vals:
+                return None
+        if not self._run_peel():
+            return None
+        if not self._open:
+            # Quiescent cut: the certified carry seals wholesale —
+            # except the CURRENT epoch. When one carried write strictly
+            # follows every other carried write in real time, EVERY
+            # valid linearization ends with it, so its value is the
+            # register's unique state at the cut: it re-carries as a
+            # zero-width pseudo-write pinned just before the cut and
+            # later reads of the live value keep being served. An
+            # ambiguous final (overlapping tail writes) seals
+            # everything — conservative, still sound.
+            ws = [(i_, r_, v) for (i_, r_, f, v) in self.ops
+                  if f == "write"]
+            cur = None
+            if ws:
+                cand = max(ws, key=lambda e: e[0])
+                if all(cand[0] > r_ for (i_, r_, _) in ws
+                       if (i_, r_) != (cand[0], cand[1])):
+                    cur = cand[2]
+            self.sealed_values |= {v for v in vals if v != cur}
+            cut = 2 * self.pos - 1
+            self.ops = ([] if cur is None
+                        else [(cut, cut, "write", cur)])
+            self.seals += 1
+        return True
+
+    def _run_peel(self) -> bool:
+        """Host peel over the carry. Open invocations are simply not
+        linearized — a valid completed part IS a valid prefix (the
+        pending set stays pending), so excluding them is sound for a
+        monitor that only certifies."""
+        if not self.ops:
+            return True
+        n = len(self.ops)
+        inv = np.fromiter((o[0] for o in self.ops), np.int64, n)
+        resp = np.fromiter((o[1] for o in self.ops), np.int64, n)
+        wid = {v: k for k, (_, _, f, v) in enumerate(self.ops)
+               if f == "write"}
+        cl = np.fromiter((wid[o[3]] for o in self.ops), np.int64, n)
+        alive = np.ones(n, bool)
+        # Within-cluster feasibility, aggregated PER CLUSTER: the
+        # write must be invoked before every member read responds
+        # (inv_w < resp_r), or no linearization point exists and the
+        # cluster can never peel — the carry stays undecided and the
+        # tick answers None (the full engine owns the verdict).
+        bad = np.zeros(n, bool)
+        np.logical_or.at(bad, cl, inv[cl] > resp)
+        while alive.any():
+            m_resp = np.full(n, _BIG, np.int64)
+            np.minimum.at(m_resp, cl[alive], resp[alive])
+            m_inv = np.full(n, -1, np.int64)
+            np.maximum.at(m_inv, cl[alive], inv[alive])
+            a1 = int(np.argmin(m_resp))
+            m2 = m_resp.copy()
+            m2[a1] = _BIG
+            t_out = np.where(np.arange(n) == a1, m2.min(), m_resp[a1])
+            peel = (m_resp < _BIG) & (m_inv <= t_out) & ~bad
+            new_alive = alive & ~peel[cl]
+            if (new_alive == alive).all():
+                return False
+            alive = new_alive
+        return True

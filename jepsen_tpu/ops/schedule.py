@@ -1246,12 +1246,17 @@ class BucketScheduler:
         # (fleet.router_rates — startup probe / persisted store rates /
         # env pins) per bucket shape; "pallas" / "xla" force. With no
         # measured pallas rate, or under $JT_ROUTER_PALLAS=0, auto is
-        # bit-identical to the pre-pallas scheduler.
+        # bit-identical to the pre-pallas scheduler. "dc" pins the
+        # decrease-and-conquer peel PRE-FILTER on (residue still rides
+        # the xla scan in the same _ship sequence); in auto the
+        # pre-filter engages per bucket shape only when the router
+        # prices it under every frontier backend AND the bucket's
+        # capable fraction clears $JT_DC_RESIDUE_MAX_FRAC.
         if wgl_backend is None:
             wgl_backend = os.environ.get("JT_WGL_BACKEND", "auto")
-        if wgl_backend not in ("auto", "xla", "pallas"):
+        if wgl_backend not in ("auto", "xla", "pallas", "dc"):
             log.warning("ignoring unknown wgl_backend=%r (want "
-                        "auto|xla|pallas)", wgl_backend)
+                        "auto|xla|pallas|dc)", wgl_backend)
             wgl_backend = "auto"
         self.wgl_backend = wgl_backend
         self._backend_choice: Dict[Tuple, bool] = {}
@@ -1345,6 +1350,8 @@ class BucketScheduler:
             "faults_injected": 0, "backpressure_events": 0,
             "event_routed_rows": 0, "event_routed_dispatches": 0,
             "pallas_dispatches": 0, "pallas_rows": 0,
+            "dc_dispatches": 0, "dc_rows": 0, "dc_decided_rows": 0,
+            "dc_skipped_scans": 0,
             "wgl_backend": self.wgl_backend,
         }
         self._t0 = None
@@ -1445,7 +1452,9 @@ class BucketScheduler:
         router to price both device backends from the measured rates
         (memoized per bucket shape — the router's answer is stable
         within one run)."""
-        if self.wgl_backend == "xla":
+        if self.wgl_backend in ("xla", "dc"):
+            # "dc" residue rides the deterministic lax.scan kernel —
+            # one moving part per verdict path.
             return False
         from .pallas_wgl import (pallas_available, pallas_supports,
                                  router_prefers_pallas)
@@ -1464,6 +1473,36 @@ class BucketScheduler:
                                         max(batch.batch, 1))
             self._backend_choice[key] = hit
         return hit
+
+    def _dc_for(self, batch: EncodedBatch) -> bool:
+        """Does this bucket's dispatch run the decrease-and-conquer
+        peel PRE-FILTER first? Forced "dc" short-circuits (capability
+        is still per row — the plan decides); "auto" engages only when
+        the router prices the peel loop under every frontier backend
+        (measured dc_events_per_s, never hardcoded) AND the bucket's
+        capable fraction clears the residue gate — a mostly-incapable
+        bucket must not pay dc + scan. Memoized per bucket shape like
+        _pallas_for."""
+        if self.wgl_backend in ("xla", "pallas"):
+            return False
+        from .dc_monitor import (dc_available, dc_plan_for,
+                                 dc_residue_max_frac, router_prefers_dc)
+        if not dc_available():
+            return False
+        if self.wgl_backend == "dc":
+            return dc_plan_for(batch) is not None
+        key = ("dc", batch.V, batch.W,
+               _round_up(batch.n_events, EVENT_QUANTUM))
+        hit = self._backend_choice.get(key)
+        if hit is None:
+            hit = router_prefers_dc(batch.W, batch.n_events,
+                                    max(batch.batch, 1))
+            self._backend_choice[key] = hit
+        if not hit:
+            return False
+        plan = dc_plan_for(batch)
+        return (plan is not None
+                and plan.capable_frac >= 1.0 - dc_residue_max_frac())
 
     def _resolve_pallas(self, batch: EncodedBatch, Bp: int, Np: int):
         """Pallas twin of _resolve: a parked pre-warm/shipped
@@ -1502,6 +1541,33 @@ class BucketScheduler:
         delay = 0.0
         if self.faults is not None:
             delay = self.faults.sleep_for(self.faults.fire("dispatch"))
+        if self._dc_for(batch):
+            # Decrease-and-conquer pre-filter: peel the chunk's rows
+            # on device; a fully-decided-valid chunk skips its scan
+            # launch outright (synthesized all-valid verdicts carry
+            # the INT32_MAX sentinel the validator demands), anything
+            # else — residue, incapable rows, full-frontier decode
+            # mode — falls through to the unchanged scan below.
+            from .dc_monitor import dc_prefilter_chunk
+            with telemetry.span("dispatch", cat="device",
+                                family="wgl-dc", V=batch.V, W=batch.W,
+                                rows=hi - lo, chunk=ordinal, tag=tag):
+                decided = dc_prefilter_chunk(batch, lo, hi)
+            if decided is not None:
+                DISPATCH_LOG.append(("dc", batch.V, batch.W, hi - lo))
+                self._inc("dc_dispatches")
+                self._inc("dc_rows", hi - lo)
+                nd = int(decided.sum())
+                if nd:
+                    self._inc("dc_decided_rows", nd)
+                if nd == hi - lo and self.return_frontier is not True:
+                    self._inc("dc_skipped_scans")
+                    self._inc("dispatches")
+                    for r in range(lo, hi):
+                        self.row_provenance[batch.indices[r]] = "wgl-dc"
+                    return (np.ones(Bp, bool),
+                            np.full(Bp, INT32_MAX, np.int32),
+                            None), delay
         use_pallas = self._pallas_for(batch)
         family = "wgl-pallas" if use_pallas else "wgl"
         with telemetry.span("dispatch", cat="device", family=family,
@@ -1562,6 +1628,7 @@ class BucketScheduler:
                                         "data1")
                 outs = [out]
             elif (pall := [self._pallas_for(run.batch)
+                           or self._dc_for(run.batch)
                            for run, _, _, _ in members]) and \
                     any(pall) and pall.count(False) <= 1:
                 # A Pallas member owns its launch economics (the whole
@@ -1571,6 +1638,10 @@ class BucketScheduler:
                 # to fuse WITH: ship each member through the one
                 # dispatch sequence instead. Fault ordinals still fire
                 # once per member, exactly as fusion promises.
+                # (dc-routed members ride the same rule: the peel
+                # pre-filter lives inside _ship, and a decided chunk
+                # skips its scan launch entirely — fusing it away
+                # would launch the scan it was about to skip.)
                 outs = []
                 delay = 0.0
                 for run, lo, hi, Bp in members:

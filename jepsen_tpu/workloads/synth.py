@@ -117,6 +117,64 @@ def synth_cas_batch(n: int, seed0: int = 0, **kw) -> List[List[Op]]:
             for s, rng in seeded_rngs(seed0, n)]
 
 
+def synth_rw_history(seed: int, *, n_procs: int = 12, n_ops: int = 48,
+                     p_read: float = 0.55, stale: float = 0.0,
+                     rng: Optional[random.Random] = None) -> List[Op]:
+    """One unkeyed wide-window read/write register history — the
+    decrease-and-conquer headline workload (every op completes ok,
+    written values globally distinct, window ~ n_procs, so W=11+ is
+    just n_procs=11+; every frontier backend pays 2^W here, the peel
+    loop doesn't).
+
+    stale — probability an observed read is drawn from ALL past
+            writes instead of the register (possibly stale: the
+            invalid-history knob whose violations stay register-class
+            capable, so they exercise the peel loop's stuck-residue
+            fallthrough rather than its capability sniff).
+    """
+    rng = rng if rng is not None else random.Random(seed)
+    reg: Optional[int] = None
+    written: List[int] = []
+    h: List[Op] = []
+    live = {}
+    free = list(range(n_procs))
+    started = 0
+    nextv = 1
+    while started < n_ops or live:
+        # Invoke-biased: keep ~n_procs ops concurrently open so the
+        # pending window sits at the process count, not far below it.
+        if free and started < n_ops and (not live or rng.random() < 0.75):
+            p = free.pop(rng.randrange(len(free)))
+            if rng.random() < p_read:
+                h.append(invoke_op(p, "read", None))
+                live[p] = ("read", None)
+            else:
+                h.append(invoke_op(p, "write", nextv))
+                live[p] = ("write", nextv)
+                nextv += 1
+            started += 1
+        else:
+            p = rng.choice(sorted(live.keys()))
+            f, v = live.pop(p)
+            if f == "write":
+                reg = v
+                written.append(v)
+                h.append(ok_op(p, "write", v))
+            else:
+                val = reg
+                if stale and written and rng.random() < stale:
+                    val = rng.choice(written)
+                h.append(ok_op(p, "read", val))
+            free.append(p)
+    return index(h)
+
+
+def synth_rw_batch(n: int, seed0: int = 0, **kw) -> List[List[Op]]:
+    """n seeded wide-window register histories down ``seed_stream``."""
+    return [synth_rw_history(s, rng=rng, **kw)
+            for s, rng in seeded_rngs(seed0, n)]
+
+
 def synth_la_history(seed: int, *, n_procs: int = 4, n_ops: int = 24,
                      n_keys: int = 2, corrupt: float = 0.0,
                      rng: Optional[random.Random] = None) -> List[Op]:

@@ -172,7 +172,11 @@ def test_bench_emits_contract_json():
     # Same spec + seeds at every point: identical verdicts.
     assert len({p["invalid"] for p in fl["points"]}) == 1
     tblw = {row["W"]: row["backend"] for row in fl["router_table"]}
-    assert tblw[4] == "wgl-device" and tblw[20] == "host-oracle"
+    # Past max_device_w the 2^W frontier backends are ineligible: only
+    # host-oracle and the r17 peel backend may appear, and a probed dc
+    # rate routes W=20 to wgl-dc.
+    assert tblw[4] == "wgl-device" and tblw[20] in ("wgl-dc",
+                                                    "host-oracle")
     # Online checker-daemon section (ISSUE 9 acceptance): live-tailed
     # verdicts while the histories are still being written, plus the
     # forced overload burst degrading through the ladder without
@@ -262,15 +266,26 @@ def test_bench_emits_contract_json():
         assert bc["probe"]["parity"] is True
     assert "crossover_w" in bc
     assert bc["headline_pallas_dispatches"] >= 0
+    # Decrease-and-conquer column (ISSUE 17): the peel loop's W-flat
+    # rate rides every point, plus its own crossover field — at W=4
+    # the scan usually wins on this shape; the claim here is the
+    # SHAPE, the W=11+ crossover is the slow-marked router test.
+    assert "dc_hist_per_s" in p0 and "dc_speedup" in p0
+    assert "dc_crossover_w" in bc
+    assert bc["headline_dc_dispatches"] >= 0
+    if "dc_error" not in p0:
+        assert p0["dc_hist_per_s"] > 0 and p0["dc_speedup"] > 0
+    assert "dc_events_per_s" in bc["probe"]
     # Static verification plane (ISSUE 15 acceptance shape): the full
     # lint ran inside bench — every rule, every registered kernel
     # family — found nothing on a clean tree, and reported its
     # wall-clock.
     an = d["analysis"]
     assert len(an["rules_run"]) == 12
-    assert len(an["families"]) == 10
+    assert len(an["families"]) == 11
     assert "wgl-scan" in an["families"] and \
-        "pallas-wgl" in an["families"]
+        "pallas-wgl" in an["families"] and \
+        "dc-peel" in an["families"]
     assert an["files_scanned"] > 80
     assert an["findings"] == 0 and an["by_rule"] == {}
     assert an["suppressed"] == 0        # the committed baseline is empty

