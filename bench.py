@@ -110,6 +110,12 @@ RATE_KEYS = (
     "synth_device.host_hist_per_s",
     "synth_device.streamed_gen_check_subs_per_s",
     "online.verdicts_per_s_while_writing",
+    # Wire-ingest plane (ISSUE 18): keys added to the curated list in
+    # the SAME round the section ships, so --compare gates wire
+    # throughput from the first round BOTH sides carry it (keys
+    # absent from the baseline are skipped by design, never guessed).
+    "ingest.wire_ops_per_s",
+    "ingest.wire_ops_per_s_per_core",
 )
 
 
@@ -1992,6 +1998,92 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
                 sched_stats.get("dc_dispatches", 0) or 0,
         }
 
+    # ---- Wire-ingest plane (ISSUE 18): stream a corpus through the
+    # socket ingest server and report landed wire ops/s (absolute and
+    # per core) plus the shed path exercised as graceful degradation —
+    # a deliberately-held admission slot forces counted BUSY sheds,
+    # then the shed client retries to a verdict-ready landed WAL.
+    # JT_BENCH_INGEST=0 skips.
+    ingest_section = None
+    if os.environ.get("JT_BENCH_INGEST", "1") != "0":
+        import tempfile as _tempfile
+
+        from jepsen_tpu import ingest as _ingest
+        from jepsen_tpu import telemetry as _tel
+        from jepsen_tpu.history.ops import Op as _Op
+        from jepsen_tpu.store import Store as _Store
+        n_ing = int(os.environ.get("JT_BENCH_INGEST_OPS", "2000"))
+        ing_ops = []
+        for i in range(n_ing // 2):
+            ing_ops.append(_Op(process=i % 4, type="invoke",
+                               f="write", value=i))
+            ing_ops.append(_Op(process=i % 4, type="ok",
+                               f="write", value=i))
+        _pre = (_tel.snapshot().get("counters") or {})
+        _shed0 = _pre.get("ingest.shed", 0)
+        _env_ra = os.environ.get("JT_INGEST_RETRY_AFTER_S")
+        os.environ["JT_INGEST_RETRY_AFTER_S"] = "0.05"
+        try:
+            with _tempfile.TemporaryDirectory() as _td:
+                _istore = _Store(Path(_td) / "store")
+                _isrv = _ingest.IngestServer(
+                    _istore, core=_ingest.IngestCore(
+                        _istore, tenant_bound=1)).serve()
+                t0 = time.perf_counter()
+                _r = _ingest.stream_ops(
+                    _isrv.host, _isrv.port, "bench-wire", "t0",
+                    ing_ops, batch=512)
+                t_wire = time.perf_counter() - t0
+                # Shed path: hold THE admission slot open (end=False
+                # keeps the tenant active past the bound), burst a
+                # second tenant into the full plane — it sheds
+                # (counted, Retry-After honored), retries, and still
+                # lands once the hold releases: graceful degradation,
+                # not failure.
+                _ingest.stream_ops(_isrv.host, _isrv.port, "hold",
+                                   "t0", ing_ops[:2], end=False)
+                import threading as _threading
+                _burst = {}
+
+                def _burst_in():
+                    _burst["r"] = _ingest.stream_ops(
+                        _isrv.host, _isrv.port, "burst", "t0",
+                        ing_ops[:4], attempts=100)
+
+                _bt = _threading.Thread(target=_burst_in)
+                _bt.start()
+                time.sleep(0.15)          # let it shed at least once
+                _ingest.stream_ops(_isrv.host, _isrv.port, "hold",
+                                   "t0", ing_ops[:2])  # release slot
+                _bt.join(timeout=30)
+                _isrv.shutdown()
+                _audit = _ingest.sequence_audit(
+                    _istore.run_dir("bench-wire", "t0")
+                    / "history.wal.jsonl")
+                _now = (_tel.snapshot().get("counters") or {})
+                _sheds = _now.get("ingest.shed", 0) - _shed0
+                _admitted = 3     # bench-wire, hold, burst
+                wire_rate = _r["acked"] / max(t_wire, 1e-9)
+                ingest_section = {
+                    "wire_ops": _r["acked"],
+                    "wire_ops_per_s": round(wire_rate, 2),
+                    "wire_ops_per_s_per_core": round(
+                        wire_rate / max(os.cpu_count() or 1, 1), 2),
+                    "wire_time_s": round(t_wire, 3),
+                    "audit_ok": _audit["ok"],
+                    "shed": _sheds,
+                    "shed_fraction": round(
+                        _sheds / max(_sheds + _admitted, 1), 4),
+                    "burst_landed": bool(
+                        _burst.get("r", {}).get("acked") == 4),
+                    "burst_sheds": _burst.get("r", {}).get("sheds"),
+                }
+        finally:
+            if _env_ra is None:
+                os.environ.pop("JT_INGEST_RETRY_AFTER_S", None)
+            else:
+                os.environ["JT_INGEST_RETRY_AFTER_S"] = _env_ra
+
     # ---- Static verification plane (ISSUE 15): run the full lint —
     # device-plane jaxpr tracing over every registered kernel family
     # plus the host-plane ast passes — and report rules run, findings,
@@ -2144,6 +2236,7 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
         "online": online_section,
         "fleet": fleet_section,
         "service": service_section,
+        "ingest": ingest_section,
         "analysis": analysis_section,
     }
     rc = 0

@@ -48,10 +48,11 @@ H_KNOB = "JTL-H-KNOB"      # undeclared JT_* knob reference
 H_KNOB_STALE = "JTL-H-KNOB-STALE"  # declared knob nothing reads
 H_PURITY = "JTL-H-PURITY"  # host-pure module reaches jax statically
 H_CLOCK = "JTL-H-CLOCK"    # wall-clock duration arithmetic
+H_SOCK = "JTL-H-SOCK"      # raw socket send outside framed primitives
 
 DEVICE_RULES = (D_HOST, D_DTYPE, D_DONATE, D_SHAPE, D_PRIM, D_VMEM)
 HOST_RULES = (H_DWRITE, H_LOCK, H_KNOB, H_KNOB_STALE, H_PURITY,
-              H_CLOCK)
+              H_CLOCK, H_SOCK)
 ALL_RULES = DEVICE_RULES + HOST_RULES
 
 

@@ -40,6 +40,14 @@ test in tests/test_analysis.py):
   use ``time.monotonic()``. Cross-process comparisons against stored
   wall stamps (lease heartbeats, file mtimes) are wall-clock by
   design and do not match this rule.
+
+* ``JTL-H-SOCK`` — framed-wire discipline. In the ingest-owning
+  modules (SOCK_MODULES: ingest.py, web.py), raw socket
+  ``sendall``/``send`` calls are legal only inside the blessed
+  framed/acked primitives (``write_frame``, ``_send``). Wire bytes
+  that bypass the CRC framing or the typed HTTP reply path would also
+  bypass the exactly-once ack contract and the wire nemesis's torn
+  enactment (doc/ingest.md).
 """
 from __future__ import annotations
 
@@ -50,7 +58,7 @@ from pathlib import Path
 from typing import Dict, List, Optional, Set, Tuple
 
 from . import (Finding, H_CLOCK, H_DWRITE, H_KNOB, H_KNOB_STALE,
-               H_LOCK, H_PURITY)
+               H_LOCK, H_PURITY, H_SOCK)
 from .knobs import KNOBS
 
 #: Modules owning durable store-namespace artifacts (repo-relative).
@@ -63,7 +71,22 @@ DURABLE_MODULES = frozenset({
     "jepsen_tpu/online.py",
     "jepsen_tpu/series.py",
     "jepsen_tpu/alerts.py",
+    "jepsen_tpu/ingest.py",
 })
+
+#: Ingest-owning modules (JTL-H-SOCK): wire bytes in these must ride
+#: the framed/acked primitives — a raw socket ``sendall``/``send``
+#: outside them bypasses the CRC framing and the exactly-once ack
+#: discipline the ingest contract rests on (doc/ingest.md).
+SOCK_MODULES = frozenset({
+    "jepsen_tpu/ingest.py",
+    "jepsen_tpu/web.py",
+})
+
+#: The blessed wire-write primitives: raw sends are legal only inside
+#: these function bodies (write_frame is ingest.py's single framed
+#: send; _send is web.py's typed HTTP reply).
+SOCK_PRIMS = frozenset({"write_frame", "_send"})
 
 #: Calls that make a raw write durable when present in the same
 #: function body (or ARE the durable primitive being defined).
@@ -272,6 +295,17 @@ class _FileVisitor(ast.NodeVisitor):
             elif name in ("write_text", "write_bytes"):
                 frame.writes.append(
                     (node.lineno, f".{name}()", None))
+        if (self.rel in SOCK_MODULES
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("sendall", "send")
+                and not any(f.name in SOCK_PRIMS
+                            for f in self.func_stack)):
+            self._find(
+                H_SOCK, node.lineno,
+                f"raw socket .{node.func.attr}() outside the framed "
+                f"primitives ({', '.join(sorted(SOCK_PRIMS))}) — wire "
+                f"bytes must ride the CRC-framed/acked path "
+                f"(doc/ingest.md)", self._qualname())
         self.generic_visit(node)
 
     # ------------------------------------------------- locked mutation

@@ -327,6 +327,33 @@ _k("JT_BENCH_COMPARE_EVENTS", "256", "int", "bench.py",
    "Events per backend-compare row.")
 _k("JT_BENCH_ANALYSIS", "1", "flag", "bench.py",
    "Static-verification lint section (0 skips).")
+_k("JT_BENCH_INGEST", "1", "flag", "bench.py",
+   "Wire-ingest section: stream a corpus through the socket plane "
+   "and report landed ops/s, per-core rate, shed fraction (0 skips).")
+_k("JT_BENCH_INGEST_OPS", "2000", "int", "bench.py",
+   "Ops streamed per tenant in the bench ingest section.")
+
+# ------------------------------------------------------ ingest plane
+_k("JT_INGEST_FAULT_PLAN", None, "str", "ingest.py",
+   "Wire nemesis schedule: stage:kind[:nth] comma-separated, stages "
+   "accept/frame/land/ack, kinds disconnect/torn/dup/stall/kill, "
+   "nth `*` = sticky (doc/ingest.md).")
+_k("JT_INGEST_MAX_TENANTS", "64", "int", "ingest.py",
+   "Active wire streams admitted before the plane sheds (counted "
+   "BUSY/429 with Retry-After, never silent drop).")
+_k("JT_INGEST_RETRY_AFTER_S", "1", "float", "ingest.py",
+   "Retry-After a shed advertises when the router has no wire-ingest "
+   "rate to price one with.")
+_k("JT_INGEST_BATCH_OPS", "256", "int", "ingest.py",
+   "Client ops per frame — the wire group-commit unit (one fsync and "
+   "one ack per frame).")
+_k("JT_INGEST_RETRIES", "5", "int", "ingest.py",
+   "Reconnect attempts beyond the first in the client's "
+   "resume-from-acked-offset loop (with_retry convention).")
+_k("JT_INGEST_OPS_PER_S", None, "float", "fleet.py",
+   "Assumed/measured wire-ingest landing rate; prices the ingest "
+   "plane's Retry-After through router_rates (unset/0 = fall back "
+   "to JT_INGEST_RETRY_AFTER_S).")
 
 
 def knob_names() -> frozenset:

@@ -1227,11 +1227,88 @@ def lint_cmd() -> dict:
     return {"lint": {"add_opts": add_opts, "run": run}}
 
 
+def ingest_cmd() -> dict:
+    """``ingest``: the network ingest plane (jepsen_tpu.ingest,
+    doc/ingest.md). ``--serve`` runs the CRC-framed socket server,
+    landing per-tenant op streams in ordinary JTWAL1 WALs behind the
+    group-commit discipline — an online daemon (``watch``) pointed at
+    the same store checks and finalizes wire tenants exactly like
+    filesystem ones. Without ``--serve`` it is the client: stream a
+    history file (JSONL op lines, or a Jepsen ``history.edn``) to a
+    server with the resume-from-acked-offset reconnect loop. The wire
+    nemesis arms from $JT_INGEST_FAULT_PLAN on the serve side."""
+    def add_opts(p):
+        p.add_argument("--serve", action="store_true", default=False,
+                       help="Run the socket ingest server (prints a "
+                            "JSON line with the bound port, then "
+                            "serves until signaled)")
+        p.add_argument("--host", default="127.0.0.1")
+        p.add_argument("--port", type=int, default=0,
+                       help="Bind (serve) or connect (client) port; "
+                            "0 binds ephemeral")
+        p.add_argument("--send", default=None, metavar="FILE",
+                       help="Client mode: history to stream — JSONL "
+                            "op lines, or .edn (Jepsen history)")
+        p.add_argument("--tenant", default=None,
+                       help="Tenant (test) name to land under")
+        p.add_argument("--ts", default=None,
+                       help="Run timestamp dir (default: now)")
+        p.add_argument("--http", action="store_true", default=False,
+                       help="Client mode: stream over the HTTP "
+                            "/ingest/ endpoint instead of the socket "
+                            "protocol")
+
+    def run(opts):
+        import json as _json
+        import time as _time
+        from pathlib import Path as _Path
+
+        from . import ingest as _ingest
+        from .runtime import GracefulShutdown
+        from .store import DEFAULT
+
+        if opts.serve:
+            srv = _ingest.IngestServer(DEFAULT, host=opts.host,
+                                       port=opts.port).serve()
+            print(_json.dumps({"serving": True, "host": srv.host,
+                               "port": srv.port}), flush=True)
+            with GracefulShutdown() as gs:
+                gs.stop.wait()
+            srv.shutdown()
+            print(_json.dumps(
+                {"serving": False,
+                 "streams": len(srv.core.tenants)}))
+            return 0
+        if not opts.send or not opts.tenant:
+            print(_json.dumps({"error": "client mode needs --send "
+                                        "FILE and --tenant NAME"}))
+            return 1
+        text = _Path(opts.send).read_text()
+        if opts.send.endswith(".edn"):
+            ops = _ingest.parse_edn_history(text)
+        else:
+            from .history.codec import loads_op
+            ops = [loads_op(line) for line in text.splitlines()
+                   if line.strip()]
+        ts = opts.ts or _time.strftime("%Y%m%dT%H%M%S")
+        fn = _ingest.http_stream_ops if opts.http \
+            else _ingest.stream_ops
+        try:
+            r = fn(opts.host, opts.port, opts.tenant, ts, ops)
+        except (_ingest.IngestError, OSError) as e:
+            print(_json.dumps({"error": str(e)}))
+            return 1
+        print(_json.dumps({"tenant": opts.tenant, "ts": ts, **r}))
+        return 0
+
+    return {"ingest": {"add_opts": add_opts, "run": run}}
+
+
 def main(argv: Optional[Sequence[str]] = None) -> None:
     run_cli({**suite_cmd(), **serve_cmd(), **recheck_cmd(),
              **salvage_cmd(), **fuzz_cmd(), **fleet_cmd(),
              **trace_cmd(), **metrics_cmd(), **watch_cmd(),
-             **lint_cmd()}, argv)
+             **ingest_cmd(), **lint_cmd()}, argv)
 
 
 if __name__ == "__main__":

@@ -135,7 +135,8 @@ _RATE_ENV = (("lane_ops_per_s", "JT_DISPATCH_COST_LANE_OPS_PER_S"),
              ("macs_per_s", "JT_GRAPH_MACS_PER_S"),
              ("graph_host_s_per_edge", "JT_GRAPH_HOST_S_PER_EDGE"),
              ("pallas_lane_ops_per_s", "JT_PALLAS_LANE_OPS_PER_S"),
-             ("dc_events_per_s", "JT_DC_EVENTS_PER_S"))
+             ("dc_events_per_s", "JT_DC_EVENTS_PER_S"),
+             ("ingest", "JT_INGEST_OPS_PER_S"))
 
 
 def set_measured_rates(rates: Optional[Dict[str, float]]) -> None:
@@ -176,6 +177,12 @@ def router_rates() -> Dict[str, float]:
         "graph_host_s_per_edge": 2e-6,
         "pallas_lane_ops_per_s": 0.0,
         "dc_events_per_s": 0.0,
+        # Wire-ingest landing rate (ops/s) — not a checker backend but
+        # the same pricing surface: the ingest plane's Retry-After is
+        # priced off it when positive ($JT_INGEST_OPS_PER_S, or a
+        # measured overlay); 0 falls back to the fixed
+        # $JT_INGEST_RETRY_AFTER_S.
+        "ingest": 0.0,
     }
     out.update(_MEASURED_RATES)
     for key, env in _RATE_ENV:

@@ -72,10 +72,22 @@ class HistoryWAL:
 
     ``run_fault`` threads the crash nemesis (ops.faults
     .RunFaultInjector) into the two places run-level faults fire:
-    after an op is durable, and at a phase boundary."""
+    after an op is durable, and at a phase boundary.
+
+    ``resume=True`` re-attaches to an EXISTING segment instead of
+    truncating it — the network ingest plane's crash seam (a SIGKILLed
+    ingest server restarts and appends after the last durable whole
+    line, so already-landed ops are never re-written and a torn tail
+    from the dead incarnation is dropped before the first new append
+    would weld onto it). The original header line is preserved
+    verbatim; ``ops_appended``/``phase`` recover from the segment, and
+    the recovered op count is the resume point exactly-once sequencing
+    acks from. Falls back to a fresh segment when the path is missing
+    or is not a history WAL."""
 
     def __init__(self, path, header: Optional[dict] = None,
-                 flush_ms: Optional[float] = None, run_fault=None):
+                 flush_ms: Optional[float] = None, run_fault=None,
+                 resume: bool = False):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self.flush_ms = flush_window_ms() if flush_ms is None \
@@ -91,20 +103,51 @@ class HistoryWAL:
         from collections import deque
         self.sync_ns = deque(maxlen=65536)
         self._record_sync = False
-        self._f = open(self.path, "w")
         self._dirty = False
-        self._last_sync = time.monotonic()
         self._closed = False
+        recovered = self._recover() if resume else None
+        if recovered is not None:
+            # Drop the torn tail BEFORE reopening for append: the
+            # cursor stops after the last whole parsed line, so the
+            # truncate is exact — durable ops are untouched, and the
+            # dead writer's in-flight partial line can never corrupt
+            # the first resumed append.
+            os.truncate(self.path, recovered.pos)
+            self._f = open(self.path, "a")
+            self._last_sync = time.monotonic()
+            self.header = recovered.header
+            self.ops_appended = recovered.n_ops
+            self.phase = recovered.phase or "setup"
+            self.sync()
+            return
+        self._f = open(self.path, "w")
+        self._last_sync = time.monotonic()
         # The writer pid lets a blind salvage sweep tell a LIVE run
         # (writer still alive on this host) from a crashed one.
         head = {"wal": WAL_MAGIC, **(header or {}),
                 "pid": os.getpid(), "phase": "setup"}
+        self.header = head
         self._f.write(json.dumps(head, default=repr) + "\n")
         self.sync()
         # The durable header IS the ``setup`` stamp — give the crash
         # nemesis its boundary (``phase:setup`` kills fire here).
         if self.run_fault is not None:
             self.run_fault.on_phase(self, "setup")
+
+    def _recover(self) -> Optional["TailState"]:
+        """Parse an existing segment to its durable end through the ONE
+        tolerant parser (tail_wal: whole lines only, torn tail left
+        behind the cursor). None when there is nothing to resume — the
+        file is absent, headerless, or not a history WAL."""
+        st = TailState()
+        while True:
+            prev = st.pos
+            st, out = tail_wal(self.path, st, materialize=False)
+            if out["missing"] or out["bad_magic"]:
+                return None
+            if st.pos == prev:
+                break
+        return st if st.header is not None else None
 
     # ------------------------------------------------------- writing
     def sync(self) -> None:

@@ -1,0 +1,995 @@
+"""Network-native ingest plane — crash-tolerant op streaming over the
+wire with exactly-once WAL landing (doc/ingest.md).
+
+Every scale-out layer so far (fleet leases, the federated service, the
+online daemon) assumes tenants arrive as WAL files on a shared
+filesystem. This module is the L0 that removes that assumption: a
+length-prefixed, CRC-framed socket protocol (plus the HTTP/chunked
+endpoint web.py mounts at ``/ingest/``) accepts per-tenant op streams
+and lands them in ORDINARY per-tenant ``history.wal.jsonl`` segments
+behind the existing group-commit discipline — so salvage, frontier
+checkpoints, takeover, and finalization parity are untouched
+downstream: the online daemon cannot tell a wire-fed tenant from a
+filesystem one (beyond the ``ingest: wire`` header tag).
+
+Robustness contract:
+
+* **Exactly-once landing.** The wire sequence number of an op IS its
+  history index; the server's resume point is the count of ops durably
+  landed in the WAL (recovered through ``HistoryWAL(resume=True)``'s
+  whole-lines-only parse after any crash). Frames at or below the
+  resume point are duplicates — acked, counted, never re-landed; a
+  frame past it is a gap — refused with the acked offset so the client
+  rewinds. Duplicated, reordered, and replayed frames all converge to
+  one copy of each op, in order.
+
+* **Acked = durable.** The server fsyncs the frame's ops (one group
+  commit per frame — the frame is the batch) BEFORE acking, so an ack
+  the client saw can never be lost to a server SIGKILL, and anything
+  unacked is safe to replay.
+
+* **Resume-from-acked-offset reconnect.** ``stream_ops`` retransmits
+  from the last acked offset after any transport failure, with
+  jittered exponential backoff (``control.core.backoff_delay`` — the
+  ``with_retry`` discipline) and bounded attempts ($JT_INGEST_RETRIES).
+
+* **Backpressure, never silent drop.** Admission ties to the online
+  daemon's overload ladder when one is coupled (``overload=`` a
+  callable returning the 0-3 level) and to $JT_INGEST_MAX_TENANTS
+  always; a refused stream gets a counted BUSY / HTTP 429 with a
+  Retry-After priced off the router's wire-ingest rate
+  (fleet.router_rates, $JT_INGEST_OPS_PER_S) when available.
+
+* **Wire nemesis.** ``IngestFaultPlan`` ($JT_INGEST_FAULT_PLAN,
+  ``stage:kind[:nth]`` — the DaemonFaultPlan syntax) injects
+  disconnects, torn frames, duplicate deliveries, stalls, and mid-ack
+  SIGKILLs at every protocol boundary; ``ingest_fault_schedules()`` is
+  the canonical single-fault matrix the parity tests sweep.
+
+A minimal Jepsen EDN adapter (``parse_edn_history``) converts a
+foreign ``history.edn`` trace into ops at the same boundary, so
+unmodified Jepsen runs can stream into the checker.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import socket
+import socketserver
+import struct
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import telemetry
+from .control.core import backoff_delay
+from .history.codec import dumps_op, loads_op
+from .history.ops import Op
+from .history.wal import PHASES, WAL_FILE, HistoryWAL
+from .store import DEFAULT, Store
+
+log = logging.getLogger("jepsen.ingest")
+
+#: Wire frame: 4-byte big-endian payload length, 4-byte CRC32 of the
+#: payload, then the JSON payload. The CRC catches torn/corrupted
+#: frames the length prefix alone would mis-parse as the next frame.
+FRAME_HEADER = struct.Struct(">II")
+MAX_FRAME_BYTES = 8 << 20
+
+#: Protocol boundaries the wire nemesis can fire at.
+INGEST_STAGES = ("accept", "frame", "land", "ack")
+#: Fault kinds: disconnect (close the connection), torn (truncate the
+#: in-flight frame), dup (deliver the frame twice), stall (sleep),
+#: kill (SIGKILL this process — the mid-ack crash).
+INGEST_KINDS = ("disconnect", "torn", "dup", "stall", "kill")
+
+#: Counters pre-registered on the telemetry registry so /metrics
+#: exposes the series the moment an ingest plane exists.
+INGEST_COUNTERS = ("ingest.frames", "ingest.ops", "ingest.dups",
+                   "ingest.retries", "ingest.shed", "ingest.torn",
+                   "ingest.streams")
+
+
+# ----------------------------------------------------------------- knobs
+
+def max_tenants() -> int:
+    """$JT_INGEST_MAX_TENANTS: active wire streams admitted before the
+    plane sheds (counted BUSY / 429, never a silent drop)."""
+    try:
+        return int(os.environ.get("JT_INGEST_MAX_TENANTS", "64"))
+    except ValueError:
+        return 64
+
+
+def retry_after_default_s() -> float:
+    """$JT_INGEST_RETRY_AFTER_S: the Retry-After a shed advertises when
+    the router has no wire-ingest rate to price one with."""
+    try:
+        return float(os.environ.get("JT_INGEST_RETRY_AFTER_S", "1"))
+    except ValueError:
+        return 1.0
+
+
+def batch_ops() -> int:
+    """$JT_INGEST_BATCH_OPS: client ops per frame — the wire
+    group-commit unit (one fsync + one ack per frame)."""
+    try:
+        return max(1, int(os.environ.get("JT_INGEST_BATCH_OPS",
+                                         "256")))
+    except ValueError:
+        return 256
+
+
+def client_retries() -> int:
+    """$JT_INGEST_RETRIES: reconnect attempts beyond the first in the
+    resume-from-acked-offset loop (the with_retry convention)."""
+    try:
+        return max(0, int(os.environ.get("JT_INGEST_RETRIES", "5")))
+    except ValueError:
+        return 5
+
+
+# ------------------------------------------------------------ exceptions
+
+class FrameError(Exception):
+    """A frame failed to parse: short read, CRC mismatch, oversized
+    length, or malformed payload. Transport-level — the client's
+    reconnect loop retries it."""
+
+
+class IngestBusy(Exception):
+    """Admission refused — the counted shed. Carries the advertised
+    Retry-After so clients back off for a priced interval instead of
+    stampeding."""
+
+    def __init__(self, retry_after: float):
+        self.retry_after = float(retry_after)
+        super().__init__(f"ingest shed; retry after "
+                         f"{self.retry_after:.3f}s")
+
+
+class IngestError(Exception):
+    """The client exhausted its reconnect budget."""
+
+
+class IngestFault(RuntimeError):
+    """An injected wire fault fired (the nemesis engaging, not a
+    bug)."""
+
+    def __init__(self, stage: str, ordinal: int, kind: str):
+        self.stage, self.ordinal, self.kind = stage, ordinal, kind
+        super().__init__(f"injected ingest fault {kind} at {stage} "
+                         f"ordinal {ordinal}")
+
+
+# ---------------------------------------------------------- wire nemesis
+
+@dataclass(frozen=True)
+class IngestFaultSpec:
+    """``kind`` at ``stage``, firing on that stage's Nth crossing
+    (``nth`` None = sticky)."""
+
+    stage: str
+    kind: str
+    nth: Optional[int] = 0
+
+    def __post_init__(self):
+        assert self.stage in INGEST_STAGES, self.stage
+        assert self.kind in INGEST_KINDS, self.kind
+
+    def matches(self, stage: str, ordinal: int) -> bool:
+        return self.stage == stage and (self.nth is None
+                                        or self.nth == ordinal)
+
+
+class IngestFaultPlan:
+    """Deterministic wire fault schedule — the DaemonFaultPlan idiom
+    lifted to the ingest protocol's boundaries. ``stall_s`` is what a
+    ``stall`` fault sleeps (test-scale by default)."""
+
+    def __init__(self, specs: List[IngestFaultSpec], *,
+                 stall_s: float = 0.05):
+        self.specs = list(specs)
+        self.stall_s = stall_s
+
+    @classmethod
+    def single(cls, stage: str, kind: str, nth: int = 0,
+               **kw) -> "IngestFaultPlan":
+        return cls([IngestFaultSpec(stage, kind, nth)], **kw)
+
+    @classmethod
+    def parse(cls, text: str, **kw) -> "IngestFaultPlan":
+        """``"stage:kind[:nth]"`` comma/semicolon-separated; nth ``*``
+        = sticky (the $JT_INGEST_FAULT_PLAN syntax)."""
+        specs = []
+        for part in text.replace(";", ",").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.split(":")
+            nth: Optional[int] = 0
+            if len(bits) > 2:
+                nth = None if bits[2] == "*" else int(bits[2])
+            specs.append(IngestFaultSpec(bits[0], bits[1], nth))
+        return cls(specs, **kw)
+
+    def match(self, stage: str, ordinal: int
+              ) -> Optional[IngestFaultSpec]:
+        for s in self.specs:
+            if s.matches(stage, ordinal):
+                return s
+        return None
+
+
+def ingest_fault_schedules() -> List[Tuple[str, IngestFaultPlan]]:
+    """The canonical single-fault matrix the wire parity tests sweep:
+    a disconnect at every protocol boundary, a torn frame and a torn
+    ack, a duplicate delivery, and stalls on the hot stages — each
+    fired exactly once, on the first crossing of its stage. The
+    mid-ack SIGKILL (``ack:kill``) is deliberately NOT here: it kills
+    the process, so its parity gate runs the server in a subprocess."""
+    out = [(f"disconnect@{s}", IngestFaultPlan.single(s, "disconnect"))
+           for s in INGEST_STAGES]
+    out += [
+        ("torn@frame", IngestFaultPlan.single("frame", "torn")),
+        ("torn@ack", IngestFaultPlan.single("ack", "torn")),
+        ("dup@frame", IngestFaultPlan.single("frame", "dup")),
+        ("stall@frame", IngestFaultPlan.single("frame", "stall")),
+        ("stall@land", IngestFaultPlan.single("land", "stall")),
+    ]
+    return out
+
+
+class IngestFaultInjector:
+    """Executes an IngestFaultPlan at the server's protocol crossings.
+    ``fire(stage)`` sleeps through ``stall``, SIGKILLs this process
+    for ``kill`` (the ops it acked are fsynced; the ack in flight is
+    lost — exactly the case the client's replay must absorb), and
+    RETURNS the kind for faults the call site must enact on the wire
+    (disconnect / torn / dup). ``log`` records every firing so tests
+    can assert the schedule actually engaged."""
+
+    def __init__(self, plan: IngestFaultPlan):
+        self.plan = plan
+        self.log: List[Tuple[str, int, str]] = []
+        self._lock = threading.Lock()
+        self._ordinal: Dict[str, int] = {s: 0 for s in INGEST_STAGES}
+
+    def fire(self, stage: str) -> Optional[str]:
+        with self._lock:
+            n = self._ordinal[stage]
+            self._ordinal[stage] = n + 1
+            spec = self.plan.match(stage, n)
+            if spec is None:
+                return None
+            self.log.append((stage, n, spec.kind))
+        if spec.kind == "stall":
+            time.sleep(self.plan.stall_s)
+            return None
+        if spec.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        return spec.kind
+
+    @classmethod
+    def from_env(cls) -> Optional["IngestFaultInjector"]:
+        text = os.environ.get("JT_INGEST_FAULT_PLAN")
+        if not text:
+            return None
+        return cls(IngestFaultPlan.parse(text))
+
+
+# ----------------------------------------------------------- frame codec
+
+def encode_frame(obj: dict) -> bytes:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    if len(payload) > MAX_FRAME_BYTES:
+        raise FrameError(f"frame over {MAX_FRAME_BYTES} bytes")
+    return FRAME_HEADER.pack(len(payload),
+                             zlib.crc32(payload)) + payload
+
+
+def write_frame(sock, obj: dict, *, torn: bool = False) -> None:
+    """THE framed write primitive (JTL-H-SOCK: raw socket sends live
+    here and nowhere else). ``torn=True`` is the nemesis enactment —
+    send a strict prefix of the frame, so the peer's CRC/length check
+    must catch it."""
+    data = encode_frame(obj)
+    if torn:
+        sock.sendall(data[:max(1, len(data) // 2)])
+        return
+    sock.sendall(data)
+
+
+def _read_exact(f, n: int) -> Optional[bytes]:
+    """Read exactly n bytes; None on clean EOF at a frame boundary,
+    FrameError on a mid-frame truncation (the torn case)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = f.read(n - len(buf))
+        if not chunk:
+            if not buf:
+                return None
+            raise FrameError(f"torn frame: {len(buf)}/{n} bytes")
+        buf += chunk
+    return buf
+
+
+def read_frame(f) -> Optional[dict]:
+    """Read one frame from a file-like (socket makefile). None on a
+    clean close between frames; FrameError on torn/corrupt frames."""
+    head = _read_exact(f, FRAME_HEADER.size)
+    if head is None:
+        return None
+    length, crc = FRAME_HEADER.unpack(head)
+    if length > MAX_FRAME_BYTES:
+        raise FrameError(f"frame length {length} over bound")
+    payload = _read_exact(f, length)
+    if payload is None:
+        raise FrameError("torn frame: missing payload")
+    if zlib.crc32(payload) != crc:
+        raise FrameError("frame CRC mismatch")
+    try:
+        return json.loads(payload)
+    except ValueError as e:
+        raise FrameError(f"unparseable frame payload: {e}") from e
+
+
+def encode_ops(ops: Sequence[Op]) -> List[dict]:
+    """Wire form of a history: each op through the ONE codec the WAL
+    and history files use (dumps_op — KV/set/bytes round-trip), with
+    the op's history index pinned to its wire sequence number. An op
+    with a conflicting pre-assigned index is refused — seq==index is
+    the invariant the exactly-once audit checks."""
+    out = []
+    for seq, op in enumerate(ops):
+        if op.index is None:
+            op = op.with_(index=seq)
+        elif op.index != seq:
+            raise ValueError(
+                f"op index {op.index} != wire seq {seq}: the stream "
+                f"must be a dense indexed history prefix")
+        out.append(json.loads(dumps_op(op)))
+    return out
+
+
+def decode_op(d: dict) -> Op:
+    return loads_op(json.dumps(d, separators=(",", ":")))
+
+
+# ------------------------------------------------------------- sequencer
+
+class IngestTenant:
+    """One wire-fed run: a resumable JTWAL1 segment plus the monotone
+    sequence cursor (``next_seq`` == ops durably landed) that makes
+    landing exactly-once."""
+
+    def __init__(self, core: "IngestCore", name: str, ts: str,
+                 header: Optional[dict] = None):
+        self.core = core
+        self.name, self.ts = name, ts
+        self.key = f"{name}/{ts}"
+        self.lock = threading.Lock()
+        run_dir = core.store.run_dir(name, ts)
+        run_dir.mkdir(parents=True, exist_ok=True)
+        # resume=True is the exactly-once seam: after ANY server crash
+        # the durable op count recovers from the segment itself — no
+        # sidecar to drift from the WAL.
+        self.wal = HistoryWAL(run_dir / WAL_FILE,
+                              header={"test": {"name": name},
+                                      "ingest": "wire",
+                                      **(header or {})},
+                              resume=True)
+        if self.wal.ops_appended == 0 and self.wal.phase == "setup":
+            self.wal.stamp_phase("run")
+        self.done = False
+
+    @property
+    def next_seq(self) -> int:
+        return self.wal.ops_appended
+
+    def _stamp_forward(self, phase: str) -> None:
+        """Idempotent phase advance: a replayed END frame must not
+        double-stamp the segment."""
+        if PHASES.index(phase) > PHASES.index(self.wal.phase):
+            self.wal.stamp_phase(phase)
+
+    def land(self, start_seq: int, op_dicts: Sequence[dict]) -> dict:
+        """Land one frame exactly-once and group-commit it. Returns
+        the ack (or gap-error) reply dict; ops at seq < next_seq are
+        duplicates (skipped, counted), a start past next_seq is a gap
+        (refused with the acked offset so the client rewinds)."""
+        with self.lock:
+            t0 = time.monotonic()
+            if start_seq > self.next_seq:
+                return {"t": "error", "err": "gap",
+                        "acked": self.next_seq}
+            skip = self.next_seq - start_seq
+            dups = min(skip, len(op_dicts))
+            landed = 0
+            for i, d in enumerate(op_dicts[skip:]):
+                seq = self.next_seq
+                op = decode_op(d)
+                if op.index != seq:
+                    return {"t": "error", "err": "index",
+                            "acked": self.next_seq,
+                            "msg": f"op index {op.index} != seq "
+                                   f"{seq}"}
+                self.wal.append_op(op)
+                landed += 1
+            # The frame IS the group-commit batch: everything acked is
+            # fsynced, so a post-ack SIGKILL can never lose acked ops.
+            self.wal.sync()
+            if dups:
+                telemetry.REGISTRY.counter("ingest.dups").inc(dups)
+            if landed:
+                telemetry.REGISTRY.counter("ingest.ops").inc(landed)
+            telemetry.REGISTRY.histogram("ingest.ack_ms").observe(
+                (time.monotonic() - t0) * 1e3)
+            return {"t": "ack", "acked": self.next_seq}
+
+    def end(self, count: int) -> dict:
+        """The stream is complete: verify the full sequence landed,
+        stamp ``analyzed`` (idempotently — replayed ENDs are no-ops)
+        and close the segment. The online daemon finalizes an
+        ``analyzed`` tenant immediately, writer liveness regardless —
+        wire completion behaves exactly like a run that analyzed."""
+        with self.lock:
+            if count != self.next_seq:
+                return {"t": "error", "err": "gap",
+                        "acked": self.next_seq}
+            self._stamp_forward("analyzed")
+            self.wal.close()
+            self.done = True
+            return {"t": "ack", "acked": self.next_seq, "done": True}
+
+    def close(self) -> None:
+        with self.lock:
+            self.wal.close()
+
+
+class IngestCore:
+    """The landing engine both transports share: admission, per-tenant
+    sequencing, and the fault injector. The socket server owns one;
+    web.py's ``/ingest/`` endpoint binds one per store — either way
+    the WAL itself is the source of truth for the resume point, so
+    separate cores (even separate processes) still land exactly-once.
+    """
+
+    def __init__(self, store: Optional[Store] = None, *,
+                 overload: Optional[Callable[[], int]] = None,
+                 faults: Optional[IngestFaultInjector] = None,
+                 tenant_bound: Optional[int] = None):
+        self.store = store or DEFAULT
+        self.overload = overload
+        self.faults = faults if faults is not None \
+            else IngestFaultInjector.from_env()
+        self.tenant_bound = tenant_bound
+        self.tenants: Dict[Tuple[str, str], IngestTenant] = {}
+        self.lock = threading.Lock()
+        telemetry.preregister(INGEST_COUNTERS)
+
+    # ------------------------------------------------------ admission
+    def _active(self) -> int:
+        return sum(1 for t in self.tenants.values() if not t.done)
+
+    def retry_after(self) -> float:
+        """Price the shed's Retry-After off the router's wire-ingest
+        rate when one is configured ($JT_INGEST_OPS_PER_S via
+        fleet.router_rates) — the backlog of one batch per active
+        stream — else the fixed $JT_INGEST_RETRY_AFTER_S."""
+        from .fleet import router_rates
+        rate = float(router_rates().get("ingest") or 0.0)
+        if rate > 0:
+            backlog = max(1, self._active()) * batch_ops()
+            return max(0.05, backlog / rate)
+        return retry_after_default_s()
+
+    def attach(self, name: str, ts: str,
+               header: Optional[dict] = None
+               ) -> Tuple[IngestTenant, int]:
+        """Admit (or re-attach) a stream; returns (tenant, acked
+        offset). Refusal is a counted IngestBusy with Retry-After —
+        backpressure, never a silent drop: past the tenant bound, or
+        when the coupled online daemon's overload ladder is at
+        shed-or-worse (level >= 2)."""
+        with self.lock:
+            t = self.tenants.get((name, ts))
+            if t is not None and not t.done:
+                return t, t.next_seq
+            shed = self._active() >= (self.tenant_bound
+                                      if self.tenant_bound is not None
+                                      else max_tenants())
+            if not shed and self.overload is not None:
+                shed = self.overload() >= 2
+            if shed:
+                telemetry.REGISTRY.counter("ingest.shed").inc()
+                raise IngestBusy(self.retry_after())
+            t = IngestTenant(self, name, ts, header)
+            self.tenants[(name, ts)] = t
+            telemetry.REGISTRY.counter("ingest.streams").inc()
+            return t, t.next_seq
+
+    def close(self) -> None:
+        with self.lock:
+            for t in self.tenants.values():
+                t.close()
+            self.tenants.clear()
+
+
+# ---------------------------------------------------------- socket plane
+
+class _IngestHandler(socketserver.BaseRequestHandler):
+    """One client connection: HELLO -> ACK(acked offset), then OPS
+    frames each acked after their group commit, then END. Every
+    protocol boundary crosses the wire nemesis."""
+
+    def handle(self):
+        core: IngestCore = self.server.core
+        faults = core.faults
+        if faults is not None and \
+                faults.fire("accept") == "disconnect":
+            return
+        rfile = self.request.makefile("rb")
+        tenant: Optional[IngestTenant] = None
+        try:
+            while True:
+                try:
+                    msg = read_frame(rfile)
+                except FrameError as e:
+                    telemetry.REGISTRY.counter("ingest.torn").inc()
+                    self._reply(faults, {"t": "error", "err": "torn",
+                                         "msg": str(e)})
+                    return
+                if msg is None:
+                    return
+                telemetry.REGISTRY.counter("ingest.frames").inc()
+                deliveries = 1
+                if faults is not None:
+                    kind = faults.fire("frame")
+                    if kind == "disconnect":
+                        return
+                    if kind == "torn":
+                        # The nemesis tore this frame in flight: the
+                        # server must treat it as never received.
+                        telemetry.REGISTRY.counter(
+                            "ingest.torn").inc()
+                        self._reply(faults,
+                                    {"t": "error", "err": "torn"})
+                        return
+                    if kind == "dup":
+                        deliveries = 2
+                reply = None
+                for _ in range(deliveries):
+                    try:
+                        tenant, reply = self._apply(core, tenant, msg)
+                    except IngestBusy as b:
+                        self._reply(faults, {
+                            "t": "busy",
+                            "retry_after": b.retry_after})
+                        return
+                    except IngestFault:
+                        return        # land:disconnect — no ack
+                if reply is not None and \
+                        not self._reply(faults, reply):
+                    return
+                if reply is not None and (reply.get("done")
+                                          or reply.get("err")
+                                          == "torn"):
+                    return
+        except (OSError, ValueError):
+            return                    # peer vanished mid-frame
+
+    def _apply(self, core: IngestCore,
+               tenant: Optional[IngestTenant], msg: dict):
+        t = msg.get("t")
+        if t == "hello":
+            tenant, acked = core.attach(msg["tenant"], msg["ts"],
+                                        msg.get("header"))
+            return tenant, {"t": "ack", "acked": acked}
+        if tenant is None:
+            return None, {"t": "error", "err": "protocol",
+                          "msg": "ops before hello"}
+        if t == "ops":
+            if core.faults is not None and \
+                    core.faults.fire("land") == "disconnect":
+                # Landed-but-unacked is the contract under test: the
+                # ops go durable, the ack never leaves, the client
+                # replays, the sequencer dedupes.
+                tenant.land(int(msg["seq"]), msg.get("ops") or [])
+                raise IngestFault("land", -1, "disconnect")
+            return tenant, tenant.land(int(msg["seq"]),
+                                       msg.get("ops") or [])
+        if t == "end":
+            return tenant, tenant.end(int(msg["count"]))
+        return tenant, {"t": "error", "err": "protocol",
+                        "msg": f"unknown frame type {t!r}"}
+
+    def _reply(self, faults, obj: dict) -> bool:
+        """Send one reply frame through the ack-stage nemesis. False
+        when the connection must drop (fault enacted or peer gone)."""
+        if faults is not None:
+            kind = faults.fire("ack")
+            if kind == "disconnect":
+                return False
+            if kind == "torn":
+                try:
+                    write_frame(self.request, obj, torn=True)
+                except OSError:
+                    pass
+                return False
+        try:
+            write_frame(self.request, obj)
+            return True
+        except OSError:
+            return False
+
+
+class IngestServer:
+    """The socket ingest plane: a threading TCP server landing frames
+    through one shared IngestCore. ``port=0`` binds an ephemeral port
+    (``.port`` carries the bound one)."""
+
+    def __init__(self, store: Optional[Store] = None,
+                 host: str = "127.0.0.1", port: int = 0, *,
+                 core: Optional[IngestCore] = None,
+                 faults: Optional[IngestFaultInjector] = None,
+                 overload: Optional[Callable[[], int]] = None):
+        self.core = core or IngestCore(store, faults=faults,
+                                       overload=overload)
+
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _Srv((host, port), _IngestHandler)
+        self._srv.core = self.core
+        self.host, self.port = self._srv.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def serve(self, block: bool = False):
+        if block:
+            self._srv.serve_forever(poll_interval=0.05)
+            return self
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            daemon=True, name="jepsen ingest")
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        self.core.close()
+
+
+# ---------------------------------------------------------- socket client
+
+class _Busy(Exception):
+    def __init__(self, retry_after: float):
+        self.retry_after = retry_after
+        super().__init__()
+
+
+#: Client backoff base/cap — test-scale; the shape (jittered
+#: exponential, backoff_delay) is the control plane's.
+BACKOFF_BASE_S = 0.05
+BACKOFF_CAP_S = 2.0
+
+
+def stream_ops(host: str, port: int, name: str, ts: str,
+               ops: Sequence[Op], *, header: Optional[dict] = None,
+               attempts: Optional[int] = None,
+               batch: Optional[int] = None, end: bool = True,
+               timeout: float = 30.0) -> dict:
+    """Stream an indexed history to an ingest server with the
+    resume-from-acked-offset reconnect loop: on ANY transport failure
+    (connection refused/reset, torn frame, lost ack) the client backs
+    off with jittered exponential delay (backoff_delay — the
+    with_retry discipline), reconnects, learns the durable acked
+    offset from HELLO, and retransmits only the unacked suffix. A
+    counted BUSY shed sleeps the server's advertised Retry-After
+    instead. Returns ``{"acked", "retries", "sheds"}``."""
+    encoded = encode_ops(list(ops))
+    attempts = client_retries() if attempts is None else int(attempts)
+    bsz = batch or batch_ops()
+    retries = sheds = 0
+    attempt = 0
+    while True:
+        try:
+            return {**_stream_once(host, port, name, ts, encoded,
+                                   header, bsz, end, timeout),
+                    "retries": retries, "sheds": sheds}
+        except _Busy as b:
+            sheds += 1
+            delay = b.retry_after
+        except (OSError, FrameError):
+            delay = backoff_delay(attempt, base=BACKOFF_BASE_S,
+                                  cap=BACKOFF_CAP_S)
+        if attempt >= attempts:
+            raise IngestError(
+                f"{name}/{ts}: out of reconnect attempts "
+                f"({attempts + 1} tried)")
+        retries += 1
+        telemetry.REGISTRY.counter("ingest.retries").inc()
+        attempt += 1
+        time.sleep(delay)
+
+
+def _stream_once(host, port, name, ts, encoded, header, bsz, end,
+                 timeout) -> dict:
+    """One connection's worth of streaming; raises on any transport
+    failure (the caller's loop owns retry)."""
+    with socket.create_connection((host, port),
+                                  timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        rfile = sock.makefile("rb")
+        write_frame(sock, {"t": "hello", "tenant": name, "ts": ts,
+                           "header": header or {},
+                           "count": len(encoded)})
+        r = read_frame(rfile)
+        if r is None:
+            raise FrameError("connection closed during hello")
+        if r.get("t") == "busy":
+            raise _Busy(float(r.get("retry_after") or
+                              retry_after_default_s()))
+        if r.get("t") != "ack":
+            raise FrameError(f"unexpected hello reply: {r}")
+        acked = int(r["acked"])
+        while acked < len(encoded):
+            write_frame(sock, {"t": "ops", "seq": acked,
+                               "ops": encoded[acked:acked + bsz]})
+            r = read_frame(rfile)
+            if r is None:
+                raise FrameError("connection closed awaiting ack")
+            if r.get("t") == "error":
+                if r.get("err") == "gap":
+                    acked = int(r["acked"])   # rewind and resend
+                    continue
+                raise FrameError(f"server refused frame: {r}")
+            acked = int(r["acked"])
+        if end:
+            write_frame(sock, {"t": "end", "count": len(encoded)})
+            r = read_frame(rfile)
+            if r is None or r.get("t") != "ack":
+                raise FrameError(f"no final ack: {r}")
+            acked = int(r["acked"])
+        return {"acked": acked}
+
+
+# ------------------------------------------------------------ HTTP client
+
+def http_stream_ops(host: str, port: int, name: str, ts: str,
+                    ops: Sequence[Op], *,
+                    attempts: Optional[int] = None,
+                    batch: Optional[int] = None, end: bool = True,
+                    chunked: bool = True,
+                    timeout: float = 30.0) -> dict:
+    """The same contract over web.py's ``/ingest/`` endpoint: each
+    batch POSTs as JSONL (chunked transfer-encoding by default) with
+    ``X-JT-Seq`` the batch's first sequence number and ``X-JT-CRC``
+    the body's CRC32; a GET probes the durable acked offset on
+    reconnect. 429 sheds honor Retry-After; 409 gaps rewind to the
+    server's acked offset; transport failures back off and retry."""
+    import http.client
+
+    encoded = encode_ops(list(ops))
+    attempts = client_retries() if attempts is None else int(attempts)
+    bsz = batch or batch_ops()
+    path = f"/ingest/{name}/{ts}"
+    retries = sheds = 0
+    attempt = 0
+
+    def fail(delay):
+        nonlocal attempt, retries
+        if attempt >= attempts:
+            raise IngestError(
+                f"{name}/{ts}: out of HTTP attempts "
+                f"({attempts + 1} tried)")
+        retries += 1
+        telemetry.REGISTRY.counter("ingest.retries").inc()
+        attempt += 1
+        time.sleep(delay)
+
+    acked: Optional[int] = None
+    while True:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            if acked is None:
+                conn.request("GET", path)
+                r = conn.getresponse()
+                body = json.loads(r.read() or b"{}")
+                if r.status == 429:
+                    sheds += 1
+                    fail(float(r.headers.get("Retry-After")
+                               or body.get("retry_after")
+                               or retry_after_default_s()))
+                    continue
+                if r.status != 200:
+                    raise FrameError(f"probe {r.status}: {body}")
+                acked = int(body["acked"])
+            while acked < len(encoded) or end:
+                batch_ops_ = encoded[acked:acked + bsz]
+                final = end and acked + len(batch_ops_) \
+                    >= len(encoded)
+                payload = "".join(
+                    json.dumps(d, separators=(",", ":")) + "\n"
+                    for d in batch_ops_).encode()
+                headers = {"Content-Type": "application/jsonl",
+                           "X-JT-Seq": str(acked),
+                           "X-JT-CRC": str(zlib.crc32(payload))}
+                if final:
+                    headers["X-JT-End"] = str(len(encoded))
+                if chunked:
+                    headers["Transfer-Encoding"] = "chunked"
+                    conn.request("POST", path, body=iter([payload]),
+                                 headers=headers,
+                                 encode_chunked=True)
+                else:
+                    conn.request("POST", path, body=payload,
+                                 headers=headers)
+                r = conn.getresponse()
+                body = json.loads(r.read() or b"{}")
+                if r.status == 429:
+                    sheds += 1
+                    fail(float(r.headers.get("Retry-After")
+                               or body.get("retry_after")
+                               or retry_after_default_s()))
+                    break
+                if r.status == 409:           # gap: rewind
+                    acked = int(body["acked"])
+                    continue
+                if r.status != 200:
+                    raise FrameError(f"POST {r.status}: {body}")
+                acked = int(body["acked"])
+                if final and body.get("done"):
+                    return {"acked": acked, "retries": retries,
+                            "sheds": sheds}
+                if not end and acked >= len(encoded):
+                    return {"acked": acked, "retries": retries,
+                            "sheds": sheds}
+        except (OSError, FrameError, ValueError):
+            acked = None               # re-probe the durable offset
+            fail(backoff_delay(attempt, base=BACKOFF_BASE_S,
+                               cap=BACKOFF_CAP_S))
+        finally:
+            conn.close()
+
+
+# -------------------------------------------------------------- sequence
+
+def sequence_audit(wal_path) -> dict:
+    """The exactly-once audit: read a landed segment and verify the op
+    indices are exactly ``0..n-1`` in order — zero duplicated, zero
+    lost, zero reordered ops, whatever the wire did. Returns
+    ``{"ops", "ok", "duplicates", "gaps"}``."""
+    from .history.wal import read_wal
+    ops = read_wal(wal_path)["ops"]
+    dup, gaps = [], []
+    expect = 0
+    for op in ops:
+        if op.index == expect:
+            expect += 1
+        elif op.index < expect:
+            dup.append(op.index)
+        else:
+            gaps.extend(range(expect, op.index))
+            expect = op.index + 1
+    return {"ops": len(ops), "ok": not dup and not gaps,
+            "duplicates": dup, "gaps": gaps}
+
+
+# ------------------------------------------------------------ EDN adapter
+
+def parse_edn_history(text: str) -> List[Op]:
+    """Minimal Jepsen EDN history adapter: one op map per line (the
+    ``history.edn`` a stock Jepsen run stores), covering the subset a
+    history needs — maps, vectors, keywords, strings, numbers,
+    nil/true/false. Keywords become strings (``:invoke`` -> "invoke");
+    ``:index``/``:time`` map onto the op's fields; unknown keys ride
+    in ``extra``. Indices are reassigned densely when absent — the
+    wire requires a dense prefix."""
+    ops: List[Op] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith(";"):
+            continue
+        val, pos = _edn_value(line, 0)
+        if not isinstance(val, dict):
+            raise ValueError(f"EDN history line is not a map: "
+                             f"{line[:80]}")
+        known = {"process", "type", "f", "value", "time", "index",
+                 "error"}
+        extra = {k: v for k, v in val.items() if k not in known}
+        ops.append(Op(process=val.get("process"),
+                      type=val.get("type"),
+                      f=val.get("f"),
+                      value=val.get("value"),
+                      time=val.get("time"),
+                      index=val.get("index"),
+                      error=val.get("error"),
+                      extra=extra or None))
+    if any(op.index is None for op in ops):
+        for i, op in enumerate(ops):
+            op.index = i
+    return ops
+
+
+_EDN_WS = " \t\r\n,"
+
+
+def _edn_value(s: str, i: int):
+    """Parse one EDN value at s[i:]; returns (value, next index)."""
+    while i < len(s) and s[i] in _EDN_WS:
+        i += 1
+    if i >= len(s):
+        raise ValueError("unexpected end of EDN input")
+    c = s[i]
+    if c == "{":
+        out = {}
+        i += 1
+        while True:
+            while i < len(s) and s[i] in _EDN_WS:
+                i += 1
+            if i < len(s) and s[i] == "}":
+                return out, i + 1
+            k, i = _edn_value(s, i)
+            v, i = _edn_value(s, i)
+            out[k] = v
+    if c in "[(":
+        close = "]" if c == "[" else ")"
+        out = []
+        i += 1
+        while True:
+            while i < len(s) and s[i] in _EDN_WS:
+                i += 1
+            if i < len(s) and s[i] == close:
+                return out, i + 1
+            v, i = _edn_value(s, i)
+            out.append(v)
+    if c == '"':
+        j = i + 1
+        buf = []
+        while j < len(s):
+            if s[j] == "\\":
+                esc = s[j + 1]
+                buf.append({"n": "\n", "t": "\t", '"': '"',
+                            "\\": "\\"}.get(esc, esc))
+                j += 2
+                continue
+            if s[j] == '"':
+                return "".join(buf), j + 1
+            buf.append(s[j])
+            j += 1
+        raise ValueError("unterminated EDN string")
+    if c == ":":
+        j = i + 1
+        while j < len(s) and s[j] not in _EDN_WS + "}])":
+            j += 1
+        # Namespaced keywords keep only the name part (:jepsen/op ->
+        # "op"), matching how the checker reads plain histories.
+        return s[i + 1:j].split("/")[-1], j
+    j = i
+    while j < len(s) and s[j] not in _EDN_WS + "}])":
+        j += 1
+    tok = s[i:j]
+    if tok == "nil":
+        return None, j
+    if tok == "true":
+        return True, j
+    if tok == "false":
+        return False, j
+    try:
+        return int(tok), j
+    except ValueError:
+        pass
+    try:
+        return float(tok), j
+    except ValueError:
+        pass
+    return tok, j                       # bare symbol degrades to string

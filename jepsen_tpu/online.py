@@ -1051,7 +1051,12 @@ class OnlineTenant:
                     (self.key, self.state.ino)
                     in self.daemon.engine.resident.frontiers),
                 "delta_checks": self.stats.get("delta_checks", 0),
-                "rotations": self.rotations}
+                "rotations": self.rotations,
+                # Wire-fed tenant (landed by the ingest plane rather
+                # than a filesystem writer) — display-only: every
+                # checking/finalization path treats both identically.
+                "wire": (self.state.header or {}).get("ingest")
+                == "wire"}
 
 
 # --------------------------------------------------------------- daemon

@@ -725,6 +725,13 @@ class ServiceWorker(OnlineDaemon):
                         if t.status != "done" and t.peak_w
                         > int(self._budget.get("wide_w") or 0)),
             "ingest_ops_s": round(self.ingest_rate(), 3),
+            # Wire-fed tenants admitted like file tenants: the count
+            # is the only place the distinction surfaces (admission,
+            # leases, takeover, SLOs are all transport-blind).
+            "wire_tenants": sum(
+                1 for t in self.tenants.values()
+                if t.status != "done"
+                and t.summary().get("wire")),
         }
         rec = {
             "service": SERVICE_MAGIC, "worker": self.worker_id,

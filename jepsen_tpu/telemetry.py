@@ -888,6 +888,16 @@ def snapshot() -> dict:
     return REGISTRY.snapshot()
 
 
+def preregister(counters=()) -> None:
+    """Create counter series at zero so /metrics exposes them before
+    the first event — a scraper watching ``ingest.shed`` must see an
+    explicit 0, not an absent series, to tell "no sheds" apart from
+    "no ingest plane". (inc(0) materializes the entry; histograms are
+    deliberately NOT pre-created — openmetrics skips count==0.)"""
+    for name in counters:
+        REGISTRY.counter(name).inc(0)
+
+
 def metrics_prefixed(prefix: str) -> dict:
     """Flat {metric: value} slice of the registry under a name prefix
     — counters/gauges verbatim, histograms as their summary dicts

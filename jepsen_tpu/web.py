@@ -82,6 +82,16 @@ def live_stale_s() -> float:
 
 class Handler(BaseHTTPRequestHandler):
     store: Store = DEFAULT
+    #: Optional overload probe (callable -> 0-3 ladder level, the
+    #: online daemon's). At shed-or-worse every endpoint answers a
+    #: typed 429 with Retry-After — graceful degradation is uniform
+    #: across the plane, not per-route ad hoc.
+    overload = None
+    #: Lazily-built ingest.IngestCore for the /ingest/ endpoints
+    #: (shared across requests; the WAL itself carries the resume
+    #: point, so a rebuilt core stays exactly-once).
+    _ingest_core = None
+    _ingest_lock = threading.Lock()
 
     # ----------------------------------------------------------- plumbing
     def log_message(self, fmt, *args):  # quiet by default
@@ -112,10 +122,54 @@ class Handler(BaseHTTPRequestHandler):
             return p
         return None
 
+    def _send_error(self, code: int, err: str,
+                    retry_after: Optional[float] = None, **extra):
+        """The typed error reply every endpoint shares: JSON body
+        (machine-readable ``error`` plus any detail) with explicit
+        Content-Type, and — for overload — a Retry-After header so
+        clients back off for a priced interval instead of polling.
+        Counted shed, never a silent drop."""
+        body = {"error": err, **extra}
+        headers = []
+        if retry_after is not None:
+            body["retry_after"] = round(float(retry_after), 3)
+            headers.append(("Retry-After",
+                            f"{max(0.0, float(retry_after)):.3f}"))
+        self._send(json.dumps(body) + "\n",
+                   ctype="application/json; charset=utf-8",
+                   code=code, headers=headers)
+
+    def _shed_if_overloaded(self) -> bool:
+        """Uniform admission gate: when the coupled overload ladder is
+        at shed-or-worse (level >= 2), answer 429 + Retry-After on ANY
+        endpoint and count the shed. True = request was shed."""
+        probe = type(self).overload
+        if probe is None or probe() < 2:
+            return False
+        from . import ingest as _ingest
+        telemetry.REGISTRY.counter("ingest.shed").inc()
+        self._send_error(429, "overloaded",
+                         retry_after=self._core().retry_after()
+                         if self._ingest_core is not None
+                         else _ingest.retry_after_default_s())
+        return True
+
+    def _core(self):
+        """The shared ingest landing core, built on first touch."""
+        cls = type(self)
+        with cls._ingest_lock:
+            if cls._ingest_core is None:
+                from . import ingest as _ingest
+                cls._ingest_core = _ingest.IngestCore(
+                    self.store, overload=cls.overload)
+            return cls._ingest_core
+
     # ------------------------------------------------------------- routes
     def do_GET(self):
         url = urlparse(self.path)
         path = unquote(url.path)
+        if self._shed_if_overloaded():
+            return
         if path == "/":
             return self.index()
         if path == "/live":
@@ -124,10 +178,19 @@ class Handler(BaseHTTPRequestHandler):
             return self.service()
         if path == "/metrics":
             return self.metrics(url.query)
+        if path.startswith("/ingest/"):
+            return self.ingest_probe(path[len("/ingest/"):])
         if path.startswith("/files/"):
             return self.files(path[len("/files/"):])
         if path.startswith("/zip/"):
             return self.zip(path[len("/zip/"):])
+        return self.not_found(path)
+
+    def do_POST(self):
+        url = urlparse(self.path)
+        path = unquote(url.path)
+        if path.startswith("/ingest/"):
+            return self.ingest_post(path[len("/ingest/"):])
         return self.not_found(path)
 
     def not_found(self, what: str = ""):
@@ -136,6 +199,123 @@ class Handler(BaseHTTPRequestHandler):
         both get something parseable, not an empty fallthrough."""
         self._send(f"not found: {what or self.path}\n", code=404,
                    ctype="text/plain; charset=utf-8")
+
+    # ------------------------------------------------------------- ingest
+    @staticmethod
+    def _ingest_key(rel: str):
+        bits = [b for b in rel.split("/") if b]
+        if len(bits) != 2:
+            return None
+        return bits[0], bits[1]
+
+    def ingest_probe(self, rel: str):
+        """GET /ingest/<name>/<ts>: the durable acked offset — the
+        HTTP client's resume point after any failure (doc/ingest.md).
+        Attaching counts as admission, so the probe itself can shed."""
+        from . import ingest as _ingest
+        key = self._ingest_key(rel)
+        if key is None:
+            return self.not_found()
+        try:
+            _, acked = self._core().attach(*key)
+        except _ingest.IngestBusy as b:
+            return self._send_error(429, "overloaded",
+                                    retry_after=b.retry_after)
+        self._send(json.dumps({"acked": acked}) + "\n",
+                   ctype="application/json; charset=utf-8")
+
+    def _read_body(self) -> bytes:
+        """Request body, Content-Length or chunked transfer-encoding
+        (http.server does not dechunk for us)."""
+        te = (self.headers.get("Transfer-Encoding") or "").lower()
+        if "chunked" in te:
+            out = []
+            while True:
+                size_line = self.rfile.readline(1024).strip()
+                size = int(size_line.split(b";")[0], 16)
+                if size == 0:
+                    self.rfile.readline(1024)     # trailing CRLF
+                    return b"".join(out)
+                chunk = self.rfile.read(size)
+                out.append(chunk)
+                self.rfile.readline(1024)         # chunk CRLF
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def ingest_post(self, rel: str):
+        """POST /ingest/<name>/<ts>: land one JSONL op batch with the
+        socket plane's exact contract — X-JT-Seq is the batch's first
+        sequence number, X-JT-CRC (optional) guards the body like the
+        socket frame's CRC32, X-JT-End marks stream completion. 200
+        acks the durable offset; 409 is a sequence gap (body carries
+        the acked offset to rewind to); 400 is a torn/corrupt body;
+        429 is the counted shed."""
+        import zlib as _zlib
+
+        from . import ingest as _ingest
+        key = self._ingest_key(rel)
+        if key is None:
+            return self.not_found()
+        try:
+            body = self._read_body()
+        except (ValueError, OSError):
+            telemetry.REGISTRY.counter("ingest.torn").inc()
+            return self._send_error(400, "torn")
+        crc = self.headers.get("X-JT-CRC")
+        if crc is not None and int(crc) != _zlib.crc32(body):
+            telemetry.REGISTRY.counter("ingest.torn").inc()
+            return self._send_error(400, "torn")
+        try:
+            seq = int(self.headers.get("X-JT-Seq") or 0)
+            op_dicts = [json.loads(line) for line
+                        in body.decode().splitlines() if line.strip()]
+        except ValueError:
+            telemetry.REGISTRY.counter("ingest.torn").inc()
+            return self._send_error(400, "torn")
+        core = self._core()
+        try:
+            tenant, _ = core.attach(*key)
+        except _ingest.IngestBusy as b:
+            return self._send_error(429, "overloaded",
+                                    retry_after=b.retry_after)
+        telemetry.REGISTRY.counter("ingest.frames").inc()
+        faults = core.faults
+        if faults is not None:
+            kind = faults.fire("frame")
+            if kind == "disconnect":
+                self.close_connection = True
+                return
+            if kind == "dup":
+                tenant.land(seq, op_dicts)
+        if faults is not None and \
+                faults.fire("land") == "disconnect":
+            # Landed-but-unacked: durable, no reply — the client must
+            # re-probe and replay (the exactly-once case under test).
+            tenant.land(seq, op_dicts)
+            self.close_connection = True
+            return
+        reply = tenant.land(seq, op_dicts)
+        if reply.get("err"):
+            code = 409 if reply["err"] == "gap" else 400
+            return self._send_error(code, reply["err"],
+                                    acked=reply.get("acked"))
+        end = self.headers.get("X-JT-End")
+        if end is not None:
+            reply = tenant.end(int(end))
+            if reply.get("err"):
+                return self._send_error(409, reply["err"],
+                                        acked=reply.get("acked"))
+        if faults is not None:
+            kind = faults.fire("ack")
+            if kind in ("disconnect", "torn"):
+                # Over HTTP a torn ack and a dropped one look the same
+                # to the client: no parseable 200, so it re-probes.
+                self.close_connection = True
+                return
+        self._send(json.dumps({"acked": reply["acked"],
+                               "done": bool(reply.get("done"))})
+                   + "\n",
+                   ctype="application/json; charset=utf-8")
 
     def metrics(self, query: str = ""):
         """Prometheus text exposition (doc/observability.md). Default:
@@ -508,11 +688,15 @@ class Handler(BaseHTTPRequestHandler):
 
 
 def serve(host: str = "127.0.0.1", port: int = 8080,
-          store: Optional[Store] = None, block: bool = False):
+          store: Optional[Store] = None, block: bool = False,
+          overload=None):
     """Start the results server (web.clj:315-320). Returns the server;
-    when block=True, serves forever."""
+    when block=True, serves forever. ``overload`` (callable -> the
+    online daemon's 0-3 ladder level) arms uniform 429/Retry-After
+    shedding across every endpoint, /ingest/ included."""
     handler = type("BoundHandler", (Handler,),
-                   {"store": store or DEFAULT})
+                   {"store": store or DEFAULT, "overload": overload,
+                    "_ingest_core": None})
     srv = ThreadingHTTPServer((host, port), handler)
     if block:
         srv.serve_forever()

@@ -116,6 +116,17 @@ def pytest_configure(config):
                    "`jepsen-tpu lint --strict` gate (deterministic; "
                    "runs in tier-1)")
     config.addinivalue_line(
+        "markers", "ingest: network ingest plane — CRC-framed socket "
+                   "+ HTTP/chunked op streaming into per-tenant "
+                   "WALs: exactly-once sequence landing under the "
+                   "wire nemesis (disconnects, torn frames, "
+                   "duplicates, mid-ack SIGKILL), "
+                   "resume-from-acked reconnect, counted "
+                   "429/Retry-After backpressure, filesystem-parity "
+                   "verdict gates, tail_wal racing a live network "
+                   "writer, and the Jepsen-EDN adapter "
+                   "(deterministic; runs in tier-1)")
+    config.addinivalue_line(
         "markers", "obsplane: cluster observability plane — durable "
                    "metrics series ring files, OpenMetrics exposition "
                    "validity, cross-worker trace correlation/merge, "

@@ -21,7 +21,7 @@ import pytest
 from jepsen_tpu.analysis import (
     D_DONATE, D_DTYPE, D_HOST, D_PRIM, D_SHAPE, D_VMEM, Finding,
     H_CLOCK, H_DWRITE, H_KNOB, H_KNOB_STALE, H_LOCK, H_PURITY,
-    apply_baseline, load_baseline, run_lint)
+    H_SOCK, apply_baseline, load_baseline, run_lint)
 from jepsen_tpu.analysis import ast_lint, jaxpr_lint
 from jepsen_tpu.analysis.ast_lint import (
     HostReport, check_import_purity, check_knobs, lint_file)
@@ -150,6 +150,36 @@ def test_lock_rule_fires_on_registry_private_access(tmp_path):
         "    REGISTRY.counter('x').inc()\n")
     assert not _host_lint(tmp_path, "jepsen_tpu/online.py",
                           "jepsen_tpu.online", good)
+
+
+def test_sock_rule_fires_on_raw_send_outside_primitives(tmp_path):
+    # A raw sendall in the wire modules bypasses the CRC framing —
+    # the exact defect class the torn-frame nemesis exists to catch.
+    bad = (
+        "def leak_ack(sock, data):\n"
+        "    sock.sendall(data)\n")
+    fs = _host_lint(tmp_path, "jepsen_tpu/ingest.py",
+                    "jepsen_tpu.ingest", bad)
+    assert [f for f in fs if f.rule == H_SOCK], fs
+    # Bare .send() flags too, and web.py is in scope.
+    bad_web = (
+        "class H:\n"
+        "    def reply(self):\n"
+        "        self.request.send(b'ack')\n")
+    fs = _host_lint(tmp_path, "jepsen_tpu/web.py",
+                    "jepsen_tpu.web", bad_web)
+    assert [f for f in fs if f.rule == H_SOCK], fs
+    # Inside the framed primitive the raw send IS the implementation.
+    good = (
+        "def write_frame(sock, obj, *, torn=False):\n"
+        "    data = obj\n"
+        "    sock.sendall(data)\n")
+    assert not _host_lint(tmp_path, "jepsen_tpu/ingest.py",
+                          "jepsen_tpu.ingest", good)
+    # Outside the socket modules the rule does not apply.
+    raw = "def f(sock):\n    sock.sendall(b'x')\n"
+    assert not _host_lint(tmp_path, "jepsen_tpu/report.py",
+                          "jepsen_tpu.report", raw)
 
 
 def test_knob_rule_fires_on_undeclared_reference():
@@ -415,7 +445,7 @@ def test_repo_is_lint_clean(full_report):
     assert full_report.findings == [], \
         [f.to_dict() for f in full_report.findings]
     assert full_report.suppressed == []          # baseline is empty
-    assert len(full_report.rules_run) == 12
+    assert len(full_report.rules_run) == 13   # +JTL-H-SOCK
     assert full_report.files_scanned > 80
     assert full_report.wall_s > 0
 

@@ -60,6 +60,10 @@ def test_bench_emits_contract_json():
                JT_BENCH_BACKEND="auto",
                JT_BENCH_COMPARE_WS="4", JT_BENCH_COMPARE_B="8",
                JT_BENCH_COMPARE_EVENTS="64",
+               # Wire-ingest section at toy scale (400 ops, 1 held
+               # slot, 1 forced shed) — the guard is the section's
+               # shape, audit, and counted-shed degradation.
+               JT_BENCH_INGEST_OPS="400",
                # Tracing stays ambient-off: the section flips the
                # flight recorder on for its own traced passes only.
                JT_TRACE="0")
@@ -281,7 +285,7 @@ def test_bench_emits_contract_json():
     # family — found nothing on a clean tree, and reported its
     # wall-clock.
     an = d["analysis"]
-    assert len(an["rules_run"]) == 12
+    assert len(an["rules_run"]) == 13    # +JTL-H-SOCK (ISSUE 18)
     assert len(an["families"]) == 11
     assert "wgl-scan" in an["families"] and \
         "pallas-wgl" in an["families"] and \
@@ -290,3 +294,14 @@ def test_bench_emits_contract_json():
     assert an["findings"] == 0 and an["by_rule"] == {}
     assert an["suppressed"] == 0        # the committed baseline is empty
     assert an["wall_s"] > 0
+    # Wire-ingest section (ISSUE 18 acceptance shape): a corpus
+    # streamed through the real socket server at toy scale — landed
+    # ops/s absolute and per core, a clean sequence audit, and the
+    # forced burst shedding (counted) yet still landing.
+    ing = d["ingest"]
+    assert ing["wire_ops"] == 400
+    assert ing["wire_ops_per_s"] > 0
+    assert ing["wire_ops_per_s_per_core"] > 0
+    assert ing["audit_ok"] is True
+    assert ing["shed"] >= 1 and ing["burst_landed"] is True
+    assert 0 < ing["shed_fraction"] < 1

@@ -1,0 +1,537 @@
+"""Network ingest plane (jepsen_tpu.ingest, doc/ingest.md).
+
+The robustness contract under test: op streams arriving over the wire
+— CRC-framed socket protocol or HTTP/chunked — land in ordinary
+per-tenant WALs exactly-once (monotone sequence numbers, acked =
+fsynced) under every wire nemesis schedule (disconnects, torn frames,
+duplicate deliveries, stalls, a mid-ack server SIGKILL with client
+reconnect-and-replay), with final daemon verdicts field-for-field
+identical to filesystem ingest, counted 429/Retry-After backpressure
+instead of silent drops, `tail_wal` racing the live network writer
+without loss or duplication, and a Jepsen-EDN foreign trace adapted
+at the same boundary.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from io import BytesIO
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import ingest, telemetry
+from jepsen_tpu.history.codec import dumps_op
+from jepsen_tpu.history.ops import invoke_op, ok_op
+from jepsen_tpu.history.wal import (HistoryWAL, TailState, WAL_FILE,
+                                    WAL_MAGIC, read_wal, tail_wal)
+from jepsen_tpu.ingest import (FrameError, IngestBusy, IngestCore,
+                               IngestFaultInjector, IngestFaultPlan,
+                               IngestServer, encode_frame, encode_ops,
+                               http_stream_ops, ingest_fault_schedules,
+                               parse_edn_history, read_frame,
+                               sequence_audit, stream_ops)
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.online import OnlineConfig, OnlineDaemon
+from jepsen_tpu.store import Store
+from jepsen_tpu.web import serve as web_serve
+
+pytestmark = pytest.mark.ingest
+
+REPO = Path(__file__).resolve().parent.parent
+DEAD_PID = 2 ** 22 + 12345
+
+
+# ------------------------------------------------------------- builders
+
+def reg_ops(n_pairs, corrupt_read=None):
+    """Deterministic single-process register history (write k / read k
+    pairs, indexed); ``corrupt_read=N`` makes the Nth read observe 999
+    — invalid from that completion on."""
+    ops, v, reads, idx = [], 0, 0, 0
+    for _ in range(n_pairs):
+        v += 1
+        group = [invoke_op(0, "write", v), ok_op(0, "write", v)]
+        reads += 1
+        rv = 999 if corrupt_read == reads else v
+        group += [invoke_op(0, "read", None), ok_op(0, "read", rv)]
+        for op in group:
+            op.index = idx
+            idx += 1
+            ops.append(op)
+    return ops
+
+
+def write_fs_run(base, name, ts, ops):
+    """The filesystem-ingest reference: the same byte shape a local
+    run's WAL leaves behind (dead writer, analyzed stamp)."""
+    d = Path(base) / name / ts
+    d.mkdir(parents=True, exist_ok=True)
+    lines = [json.dumps({"wal": WAL_MAGIC, "test": {"name": name},
+                         "seed": 0, "pid": DEAD_PID,
+                         "phase": "setup"}),
+             json.dumps({"phase": "run", "wal_ops": 0})]
+    lines += [dumps_op(o) for o in ops]
+    lines.append(json.dumps({"phase": "analyzed",
+                             "wal_ops": len(ops)}))
+    (d / WAL_FILE).write_text("\n".join(lines) + "\n")
+    return d
+
+
+def cfg(**kw):
+    kw.setdefault("model", cas_register())
+    kw.setdefault("poll_s", 0)
+    kw.setdefault("check_interval_ops", 4)
+    kw.setdefault("crash_quiet_s", 0)
+    return OnlineConfig(**kw)
+
+
+def daemon_verdict(store):
+    """Tick a fresh daemon over the store until its one tenant
+    finalizes; return the in-memory result (the parity object)."""
+    d = OnlineDaemon(store=store, config=cfg())
+    for _ in range(4):
+        d.tick()
+        if d.tenants and all(t.status == "done"
+                             for t in d.tenants.values()):
+            break
+    (t,) = d.tenants.values()
+    assert t.status == "done"
+    res = t.result
+    d.close()
+    return res
+
+
+def counter(name):
+    return telemetry.REGISTRY.get(name) or 0
+
+
+def wal_of(store, name="reg", ts="r1"):
+    return store.run_dir(name, ts) / WAL_FILE
+
+
+# ----------------------------------------------------------- frame codec
+
+def test_frame_roundtrip_and_corruption():
+    """The CRC catches what a bare length prefix cannot: bit flips and
+    truncations read as FrameError, never as a mis-parsed next frame;
+    clean EOF between frames reads as None."""
+    msg = {"t": "ops", "seq": 7, "ops": [{"value": [1, 2]}]}
+    data = encode_frame(msg)
+    assert read_frame(BytesIO(data)) == msg
+    assert read_frame(BytesIO(b"")) is None
+
+    flipped = bytearray(data)
+    flipped[-1] ^= 0xFF
+    with pytest.raises(FrameError):
+        read_frame(BytesIO(bytes(flipped)))
+
+    for cut in (3, len(data) // 2, len(data) - 1):
+        with pytest.raises(FrameError):
+            read_frame(BytesIO(data[:cut]))
+
+    huge = bytearray(data)
+    huge[0:4] = (ingest.MAX_FRAME_BYTES + 1).to_bytes(4, "big")
+    with pytest.raises(FrameError):
+        read_frame(BytesIO(bytes(huge)))
+
+
+def test_encode_ops_pins_seq_to_index():
+    """The wire sequence number IS the history index — a stream with a
+    conflicting pre-assigned index is refused at encode time."""
+    ops = reg_ops(2)
+    enc = encode_ops(ops)
+    assert [d["index"] for d in enc] == list(range(8))
+    ops[3].index = 99
+    with pytest.raises(ValueError):
+        encode_ops(ops)
+
+
+def test_fault_plan_parse_matches_daemon_idiom():
+    p = IngestFaultPlan.parse("frame:torn:2, ack:kill:*; land:stall")
+    assert [(s.stage, s.kind, s.nth) for s in p.specs] == [
+        ("frame", "torn", 2), ("ack", "kill", None),
+        ("land", "stall", 0)]
+    assert p.match("ack", 17).kind == "kill"     # sticky
+    assert p.match("frame", 1) is None
+
+
+# ------------------------------------------------ exactly-once sequencer
+
+def test_exactly_once_dup_overlap_gap(tmp_path):
+    """The sequencer's whole contract at the core level: duplicated,
+    overlapping, and replayed frames converge to one copy of each op;
+    a gap is refused with the rewind offset; the audit is clean."""
+    core = IngestCore(Store(tmp_path / "store"))
+    t, acked = core.attach("reg", "r1")
+    assert acked == 0
+    enc = encode_ops(reg_ops(4))          # 16 ops
+    dups0 = counter("ingest.dups")
+
+    assert t.land(0, enc[0:6]) == {"t": "ack", "acked": 6}
+    # Full duplicate of the first frame.
+    assert t.land(0, enc[0:6]) == {"t": "ack", "acked": 6}
+    # Overlapping frame: 4 dups + 4 novel.
+    assert t.land(2, enc[2:10]) == {"t": "ack", "acked": 10}
+    # Gap: refused, nothing landed.
+    r = t.land(12, enc[12:16])
+    assert r["t"] == "error" and r["err"] == "gap" and r["acked"] == 10
+    assert t.land(10, enc[10:16]) == {"t": "ack", "acked": 16}
+    # end is idempotent.
+    assert t.end(16)["done"] is True
+    assert counter("ingest.dups") - dups0 == 10
+    a = sequence_audit(wal_of(core.store))
+    assert a == {"ops": 16, "ok": True, "duplicates": [], "gaps": []}
+    core.close()
+
+
+def test_resume_across_core_restart(tmp_path):
+    """The WAL itself is the resume point: a fresh core (a crashed/
+    restarted server process) recovers the durable op count through
+    HistoryWAL(resume=True) and dedupes a full client replay."""
+    store = Store(tmp_path / "store")
+    enc = encode_ops(reg_ops(4))
+    core1 = IngestCore(store)
+    t1, _ = core1.attach("reg", "r1")
+    t1.land(0, enc[:10])
+    core1.close()                          # server "dies" mid-stream
+
+    core2 = IngestCore(store)
+    t2, acked = core2.attach("reg", "r1")
+    assert acked == 10                     # recovered, not trusted-0
+    t2.land(0, enc)                        # full replay: 10 dups
+    assert t2.end(16)["done"] is True
+    assert sequence_audit(wal_of(store))["ok"] is True
+    # The analyzed stamp appears exactly once despite the replay.
+    phases = [p for p, _ in read_wal(wal_of(store))["phases"]]
+    assert phases.count("analyzed") == 1
+    core2.close()
+
+
+# -------------------------------------------------- socket parity gates
+
+def test_socket_parity_under_every_fault_schedule(tmp_path):
+    """Acceptance: the same corpus streamed over the socket under
+    EVERY single-fault wire schedule yields a daemon verdict
+    field-for-field identical to filesystem ingest, with a clean
+    sequence audit — and every schedule provably engaged."""
+    for sub, corrupt in (("clean", 0), ("bad", 3)):
+        ops = reg_ops(6, corrupt_read=corrupt)
+        baseline = daemon_verdict(
+            Store(write_fs_run(tmp_path / sub / "fs", "reg", "r1",
+                               ops).parent.parent))
+        assert baseline["valid"] is (corrupt == 0)
+        for label, plan in ingest_fault_schedules():
+            store = Store(tmp_path / sub / label.replace("@", "_"))
+            inj = IngestFaultInjector(plan)
+            srv = IngestServer(store, faults=inj).serve()
+            r = stream_ops(srv.host, srv.port, "reg", "r1", ops,
+                           batch=6, attempts=20)
+            srv.shutdown()
+            assert inj.log, f"{sub}/{label}: schedule never engaged"
+            assert r["acked"] == len(ops)
+            a = sequence_audit(wal_of(store))
+            assert a["ok"] and a["ops"] == len(ops), (sub, label, a)
+            assert daemon_verdict(store) == baseline, (sub, label)
+
+
+def test_http_parity_under_fault_schedules(tmp_path):
+    """The HTTP/chunked transport honors the same contract: the
+    schedules enactable at the HTTP boundary (frame/land disconnects,
+    duplicate delivery, ack loss, land stall) all converge to the
+    filesystem verdict."""
+    ops = reg_ops(6, corrupt_read=3)
+    baseline = daemon_verdict(
+        Store(write_fs_run(tmp_path / "fs", "reg", "r1",
+                           ops).parent.parent))
+    schedules = [
+        ("disconnect@frame", IngestFaultPlan.single("frame",
+                                                    "disconnect")),
+        ("dup@frame", IngestFaultPlan.single("frame", "dup")),
+        ("disconnect@land", IngestFaultPlan.single("land",
+                                                   "disconnect")),
+        ("disconnect@ack", IngestFaultPlan.single("ack",
+                                                  "disconnect")),
+        ("stall@land", IngestFaultPlan.single("land", "stall")),
+    ]
+    for label, plan in schedules:
+        store = Store(tmp_path / label.replace("@", "_"))
+        inj = IngestFaultInjector(plan)
+        srv = web_serve(host="127.0.0.1", port=0, store=store)
+        srv.RequestHandlerClass._ingest_core = IngestCore(store,
+                                                          faults=inj)
+        port = srv.server_address[1]
+        r = http_stream_ops("127.0.0.1", port, "reg", "r1", ops,
+                            batch=6, attempts=20)
+        srv.shutdown()
+        assert inj.log, f"{label}: schedule never engaged"
+        assert r["acked"] == len(ops)
+        a = sequence_audit(wal_of(store))
+        assert a["ok"] and a["ops"] == len(ops), (label, a)
+        assert daemon_verdict(store) == baseline, label
+
+
+def _spawn_server(cwd, env, port=0):
+    """``jepsen-tpu ingest --serve`` in a subprocess; returns
+    (proc, port) once the bound-port JSON line appears."""
+    p = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.cli", "ingest", "--serve",
+         "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=cwd, env=env)
+    line = p.stdout.readline()
+    info = json.loads(line)
+    assert info["serving"] is True
+    return p, info["port"]
+
+
+def test_midack_sigkill_reconnect_and_replay(tmp_path):
+    """Acceptance: the server SIGKILLs itself mid-ack (ops landed and
+    fsynced, the ack never leaves). The client — already mid-stream —
+    backs off, reconnects to a replacement server on the same port
+    and store, learns the durable offset, replays the unacked suffix,
+    and the landed WAL plus final verdict are indistinguishable from
+    filesystem ingest."""
+    ops = reg_ops(6, corrupt_read=3)
+    baseline = daemon_verdict(
+        Store(write_fs_run(tmp_path / "fs", "reg", "r1",
+                           ops).parent.parent))
+    cwd = tmp_path / "wire"
+    cwd.mkdir()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": str(REPO), "JT_WAL_FLUSH_MS": "250"}
+    # Kill on the THIRD reply: hello-ack and one ops-ack escape, the
+    # second ops frame lands durably but its ack dies with the server.
+    proc_a, port = _spawn_server(
+        cwd, {**env, "JT_INGEST_FAULT_PLAN": "ack:kill:2"})
+
+    result = {}
+
+    def client():
+        result["r"] = stream_ops("127.0.0.1", port, "reg", "r1", ops,
+                                 batch=6, attempts=200, timeout=5.0)
+
+    th = threading.Thread(target=client)
+    th.start()
+    assert proc_a.wait(timeout=60) == -signal.SIGKILL
+    proc_b, _ = _spawn_server(cwd, env, port=port)
+    th.join(timeout=60)
+    assert not th.is_alive()
+    proc_b.send_signal(signal.SIGTERM)
+    proc_b.wait(timeout=30)
+
+    assert result["r"]["acked"] == len(ops)
+    assert result["r"]["retries"] >= 1     # the crash actually cost one
+    store = Store(cwd / "store")
+    a = sequence_audit(wal_of(store))
+    assert a["ok"] and a["ops"] == len(ops), a
+    assert daemon_verdict(store) == baseline
+
+
+def test_restart_redispatches_zero_decided_prefixes(tmp_path):
+    """A daemon watching a live wire tenant, killed and restarted
+    mid-stream, resumes from its decided-prefix journal — zero
+    re-dispatched prefixes — and still finalizes to the filesystem
+    verdict once the stream completes."""
+    ops = reg_ops(6, corrupt_read=3)
+    baseline = daemon_verdict(
+        Store(write_fs_run(tmp_path / "fs", "reg", "r1",
+                           ops).parent.parent))
+    store = Store(tmp_path / "wire")
+    srv = IngestServer(store).serve()
+    stream_ops(srv.host, srv.port, "reg", "r1", ops[:16], end=False)
+    # The ingest server is THIS process: the writer pid reads alive,
+    # so the daemon checks the growing prefix instead of finalizing.
+    d1 = OnlineDaemon(store=store, config=cfg(crash_quiet_s=60))
+    d1.tick()
+    assert d1.tenants[("reg", "r1")].stats["checks"] >= 1
+    d1.close()                             # kill (journal survives)
+
+    stream_ops(srv.host, srv.port, "reg", "r1", ops)   # finish + end
+    srv.shutdown()
+    d2 = OnlineDaemon(store=store, config=cfg(crash_quiet_s=60))
+    for _ in range(4):
+        d2.tick()
+        if d2.tenants[("reg", "r1")].status == "done":
+            break
+    t = d2.tenants[("reg", "r1")]
+    assert t.stats["resumed_prefixes"] >= 1
+    assert t.status == "done" and t.result == baseline
+    d2.close()
+
+
+# ------------------------------------------------------- backpressure
+
+def test_socket_shed_counted_with_retry_after(tmp_path, monkeypatch):
+    """Past the admission bound the plane sheds — a counted BUSY with
+    a Retry-After — and the shed tenant still lands once the slot
+    frees: graceful degradation, all admitted tenants reach verdicts.
+    """
+    monkeypatch.setenv("JT_INGEST_RETRY_AFTER_S", "0.05")
+    store = Store(tmp_path / "store")
+    ops = reg_ops(3)
+    shed0 = counter("ingest.shed")
+    srv = IngestServer(store, core=IngestCore(store,
+                                              tenant_bound=1)).serve()
+    stream_ops(srv.host, srv.port, "hold", "r1", ops, end=False)
+    # Bound reached: a second tenant sheds on every attempt.
+    with pytest.raises(ingest.IngestError):
+        stream_ops(srv.host, srv.port, "b", "r1", ops, attempts=1)
+    assert counter("ingest.shed") - shed0 >= 2
+    # Direct probe of the advertised interval.
+    with pytest.raises(IngestBusy) as e:
+        srv.core.attach("c", "r1")
+    assert e.value.retry_after > 0
+    # Slot releases -> the shed tenant retries in and lands.
+    stream_ops(srv.host, srv.port, "hold", "r1", ops)
+    r = stream_ops(srv.host, srv.port, "b", "r1", ops, attempts=20)
+    assert r["acked"] == len(ops)
+    srv.shutdown()
+    for name in ("hold", "b"):
+        assert sequence_audit(wal_of(store, name))["ok"]
+    d = OnlineDaemon(store=store, config=cfg())
+    for _ in range(4):
+        d.tick()
+        if d.tenants and all(t.status == "done"
+                             for t in d.tenants.values()):
+            break
+    assert all(t.status == "done" and t.result["valid"]
+               for t in d.tenants.values())
+    assert {k[0] for k in d.tenants} == {"hold", "b"}
+    d.close()
+
+
+def test_retry_after_priced_from_router_rate(tmp_path, monkeypatch):
+    """With $JT_INGEST_OPS_PER_S configured the shed's Retry-After is
+    priced (backlog over rate) instead of the fixed default."""
+    monkeypatch.setenv("JT_INGEST_OPS_PER_S", "1000")
+    core = IngestCore(Store(tmp_path / "s"), tenant_bound=0)
+    with pytest.raises(IngestBusy) as e:
+        core.attach("a", "r1")
+    assert e.value.retry_after == pytest.approx(
+        ingest.batch_ops() / 1000.0, rel=0.01)
+    monkeypatch.setenv("JT_INGEST_OPS_PER_S", "0")
+    monkeypatch.setenv("JT_INGEST_RETRY_AFTER_S", "2.5")
+    with pytest.raises(IngestBusy) as e:
+        core.attach("b", "r1")
+    assert e.value.retry_after == 2.5
+
+
+# ------------------------------------------------- tail race (satellite)
+
+def test_tail_wal_races_live_network_writer(tmp_path):
+    """Satellite: `tail_wal` consuming a tenant WAL WHILE the ingest
+    server lands frames into it — whole lines only, every op seen
+    exactly once, in order, across group-commit boundaries."""
+    store = Store(tmp_path / "store")
+    ops = reg_ops(40)                      # 160 ops
+    srv = IngestServer(store).serve()
+
+    def writer():
+        stream_ops(srv.host, srv.port, "reg", "r1", ops, batch=7)
+
+    th = threading.Thread(target=writer)
+    th.start()
+    path = wal_of(store)
+    st = TailState()
+    seen = []
+    deadline = time.monotonic() + 60
+    while len(seen) < len(ops) and time.monotonic() < deadline:
+        st, out = tail_wal(path, st, materialize=True)
+        seen.extend(op.index for op in out["ops"])
+        assert not out["rotated"] and not out["bad_magic"]
+        time.sleep(0.002)
+    th.join(timeout=30)
+    srv.shutdown()
+    assert seen == list(range(len(ops)))   # zero loss, zero dup
+
+
+# ------------------------------------------------------- observability
+
+def test_metrics_exposes_ingest_series(tmp_path):
+    """Satellite: the ingest counters/histogram land on the unified
+    registry and come out of /metrics as parseable OpenMetrics lines —
+    including explicit zeros for series with no events yet."""
+    store = Store(tmp_path / "store")
+    srv = IngestServer(store).serve()
+    stream_ops(srv.host, srv.port, "reg", "r1", reg_ops(4), batch=4)
+    srv.shutdown()
+    web = web_serve(host="127.0.0.1", port=0, store=store)
+    try:
+        port = web.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+    finally:
+        web.shutdown()
+    vals = {}
+    for line in text.splitlines():
+        if line.startswith("jt_ingest_") and " " in line:
+            k, v = line.rsplit(" ", 1)
+            vals[k] = float(v)
+    assert vals["jt_ingest_frames_total"] >= 1
+    assert vals["jt_ingest_ops_total"] >= 16
+    assert vals["jt_ingest_streams_total"] >= 1
+    # Pre-registered zeros: "no sheds" is an explicit 0, not absence.
+    assert "jt_ingest_shed_total" in vals
+    assert "jt_ingest_torn_total" in vals
+    assert vals["jt_ingest_ack_ms_count"] >= 1
+    assert "jt_ingest_ack_ms_p50" in vals
+    assert "jt_ingest_ack_ms_p99" in vals
+
+
+# ---------------------------------------------------------- EDN adapter
+
+EDN_SAMPLE = """\
+; a stock Jepsen history.edn prefix (one op map per line)
+{:process 0, :type :invoke, :f :write, :value 3, :time 10}
+{:process 0, :type :ok, :f :write, :value 3, :time 20}
+{:process 1, :type :invoke, :f :cas, :value [3 4], :time 30}
+{:process 1, :type :fail, :f :cas, :value [3 4], :error :precondition, :time 40}
+{:process :nemesis, :type :info, :f :start, :value nil, :jepsen/extra "x"}
+"""
+
+
+def test_edn_adapter_parses_jepsen_history():
+    ops = parse_edn_history(EDN_SAMPLE)
+    assert [o.type for o in ops] == ["invoke", "ok", "invoke", "fail",
+                                     "info"]
+    assert ops[2].value == [3, 4]
+    assert ops[3].error == "precondition"
+    assert ops[4].process == "nemesis" and ops[4].value is None
+    assert ops[4].extra == {"extra": "x"}     # namespaced key adapted
+    assert [o.index for o in ops] == [0, 1, 2, 3, 4]  # densified
+    encode_ops(ops)                           # streams as-is
+
+
+def test_edn_stream_end_to_end(tmp_path):
+    """A foreign EDN trace rides the full wire path into an ordinary
+    checkable WAL via the CLI client."""
+    cwd = tmp_path
+    (cwd / "history.edn").write_text(
+        "{:process 0, :type :invoke, :f :write, :value 1}\n"
+        "{:process 0, :type :ok, :f :write, :value 1}\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": str(REPO)}
+    proc, port = _spawn_server(cwd, env)
+    r = subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu.cli", "ingest",
+         "--send", "history.edn", "--tenant", "jepsen-run",
+         "--ts", "r1", "--port", str(port)],
+        capture_output=True, text=True, cwd=cwd, env=env, timeout=60)
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=30)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = json.loads(r.stdout.splitlines()[-1])
+    assert line["acked"] == 2
+    store = Store(cwd / "store")
+    rw = read_wal(wal_of(store, "jepsen-run"))
+    assert rw["header"]["ingest"] == "wire"
+    assert sequence_audit(wal_of(store, "jepsen-run"))["ok"]

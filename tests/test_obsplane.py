@@ -260,8 +260,13 @@ def test_metrics_endpoint_and_cli(tmp_path, monkeypatch, capsys):
         status, headers, body = get("/metrics?merged=1")
         assert status == 200
         merged = _validate_exposition(body)
-        # The merged view folds the series frame in.
-        assert merged["jt_online_checks_total"][0][1] == 10
+        # The merged view folds the peer's series frame (10 checks)
+        # into the live registry's own count — delta-based, because
+        # earlier tests in this process may have ticked real daemons.
+        live_checks = live.get("jt_online_checks_total",
+                               [("", 0)])[0][1]
+        assert merged["jt_online_checks_total"][0][1] \
+            == live_checks + 10
 
         # Satellite: proper 404 with a body + Content-Type.
         with pytest.raises(urllib.error.HTTPError) as e:
@@ -577,6 +582,38 @@ def test_bench_compare_self_and_injected_regression(tmp_path):
          "--tolerance", "0.2"],
         capture_output=True, text=True, timeout=60)
     assert r.returncode == 0
+
+
+def test_bench_compare_covers_ingest_rates():
+    """ISSUE 18 satellite: --compare skips keys absent from either
+    side BY DESIGN, so new sections are invisible to the gate unless
+    their rate keys join the curated list in the SAME round the
+    section ships. Guard: the wire-ingest keys are in RATE_KEYS, and
+    compare_bench actually gates them once both sides carry the
+    section."""
+    import importlib.util as _ilu
+    spec = _ilu.spec_from_file_location("bench", REPO / "bench.py")
+    bench = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    assert "ingest.wire_ops_per_s" in bench.RATE_KEYS
+    assert "ingest.wire_ops_per_s_per_core" in bench.RATE_KEYS
+
+    prev = {"value": 100.0,
+            "ingest": {"wire_ops_per_s": 1000.0,
+                       "wire_ops_per_s_per_core": 100.0}}
+    cur = {"value": 100.0,
+           "ingest": {"wire_ops_per_s": 500.0,     # 50% wire loss
+                      "wire_ops_per_s_per_core": 100.0}}
+    reg = bench.compare_bench(prev, cur, tolerance=0.2)
+    assert reg["regressions"] == ["ingest.wire_ops_per_s"]
+    assert reg["rates"]["ingest.wire_ops_per_s"]["regressed"] is True
+    assert reg["rates"]["ingest.wire_ops_per_s_per_core"][
+        "regressed"] is False
+    # Baselines predating the section: keys skipped, never guessed.
+    reg = bench.compare_bench({"value": 100.0}, cur, tolerance=0.2)
+    assert reg["ok"] is True
+    assert not any(k.startswith("ingest.") for k in reg["rates"])
 
 
 def test_telemetry_dir_constants_agree():

@@ -204,6 +204,41 @@ def test_web_404_body_and_content_types(store):
         srv.shutdown()
 
 
+def test_web_overload_429_retry_after_json(store):
+    """Ingest-plane satellite: with the online daemon's overload
+    ladder at shed-or-worse, EVERY endpoint degrades gracefully — a
+    counted 429 with a parseable Retry-After header and a JSON error
+    body, never a hang or a silent drop — and recovers to 200 the
+    moment the ladder clears."""
+    from jepsen_tpu import telemetry
+
+    level = {"v": 3}
+    srv = serve(host="127.0.0.1", port=0, store=store,
+                overload=lambda: level["v"])
+    try:
+        port = srv.server_address[1]
+        shed0 = telemetry.REGISTRY.get("ingest.shed") or 0
+        for path in ("/", "/live", "/metrics", "/ingest/x/r1"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}")
+            assert e.value.code == 429, path
+            assert float(e.value.headers["Retry-After"]) >= 0
+            assert e.value.headers["Content-Type"] == \
+                "application/json; charset=utf-8"
+            body = json.loads(e.value.read())
+            assert body["error"] == "overloaded"
+            assert body["retry_after"] >= 0
+        assert (telemetry.REGISTRY.get("ingest.shed") or 0) \
+            - shed0 >= 4                       # counted, not silent
+        level["v"] = 0                         # ladder clears
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as r:
+            assert r.status == 200
+    finally:
+        srv.shutdown()
+
+
 # ------------------------------------------- recheck family registry
 
 def _store_runs(tmp_path, monkeypatch, name, runs):
