@@ -29,7 +29,9 @@ runs), JT_SCHED_CLASSES / JT_SCHED_CHUNK_ROWS / JT_SCHED_ENCODE_ROWS
 JT_BENCH_XLONG_OPS (the 100-history x 100k-line probe; 0 skips),
 JT_BENCH_VPU_GOPS / JT_BENCH_HBM_PEAK_GBPS / JT_BENCH_MXU_TMACS
 (roofline ceilings), JT_BENCH_GRAPH_B (dependency-graph cycle-checker
-figure; 0 skips), JT_BENCH_WAL_OPS (run-durability figure: live-WAL
+figure; 0 skips), JT_BENCH_ISO_B (isolation-ladder certifier figure:
+histories/s over a seeded anomaly mix with the per-level breakdown;
+0 skips), JT_BENCH_WAL_OPS (run-durability figure: live-WAL
 worker-loop overhead, group-commit flush percentiles, salvage
 throughput; 0 skips),
 JT_FUSE_KINDS (event-fusion vocabulary budget, ops/encode.py),
@@ -102,6 +104,9 @@ RATE_KEYS = (
     "fold_total_queue_rate",
     "scheduler.streamed_e2e_rate",
     "graph_checker.graphs_per_s",
+    # Isolation-ladder certifier (ISSUE 19): gated from the first
+    # round both sides carry it, same new-key-skipped rule as ingest.
+    "isolation.hist_per_s",
     "run_durability.ops_per_s_wal_on",
     "run_durability.salvage_ops_per_s",
     "long_history.routed.events_per_s",
@@ -870,6 +875,52 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
                 [v, n] for v, n in Counter(
                     bucket_v(g.n) for g in la_graphs).items()),
             "resilience": {k: gstats.get(k, 0) for k in
+                           ("retries", "bisections", "watchdog_fired",
+                            "oom_events", "corrupt_chunks",
+                            "quarantined_rows", "faults_injected")},
+        }
+
+    # Isolation-certifier extra: the THIRD device checker family —
+    # batched isolation-ladder certification of transactional
+    # histories (jepsen_tpu.isolation, doc/isolation.md). A seeded
+    # anomaly mix (synth_txn) lowers to 4 packed cumulative-plane
+    # bitsets plus an in-kernel derived SI plane, and one vmapped
+    # closure dispatch decides the highest level each history
+    # satisfies; the per-level breakdown doubles as the injection-mix
+    # audit.
+    IB = int(os.environ.get("JT_BENCH_ISO_B", "512"))
+    iso_section = None
+    if IB:
+        from collections import Counter
+
+        from jepsen_tpu.isolation import certify_batch
+        from jepsen_tpu.ops.txn_graph import extract_txn_graph
+        from jepsen_tpu.ops.synth_txn import TxnSpec, synth_txn_batch
+        pairs = synth_txn_batch(TxnSpec(n=IB, seed=7, anomaly="mix"))
+        t0 = time.monotonic()
+        txn_graphs = [extract_txn_graph(h) for h, _ in pairs]
+        t_extract = time.monotonic() - t0
+        certify_batch(txn_graphs)                # warm the compiles
+        itimes, istats, irs = [], {}, []
+        for _ in range(max(2, repeats)):
+            istats = {}
+            t0 = time.monotonic()
+            irs = certify_batch(txn_graphs, stats_out=istats)
+            itimes.append(time.monotonic() - t0)
+        t_iso = statistics.median(itimes)
+        iso_section = {
+            "hist_per_s": round(IB / t_iso, 2),
+            "e2e_hist_per_s": round(IB / (t_extract + t_iso), 2),
+            "extract_s": round(t_extract, 3),
+            "device_s": round(t_iso, 3),
+            "histories": IB,
+            "levels": dict(sorted(Counter(
+                r["level"] for r in irs).items())),
+            "anomaly_mix": dict(sorted(Counter(
+                a or "clean" for _, a in pairs).items())),
+            "closure_matmuls": istats.get("closure_matmuls"),
+            "mxu_macs_e9": round(istats.get("mxu_macs", 0.0) / 1e9, 3),
+            "resilience": {k: istats.get(k, 0) for k in
                            ("retries", "bisections", "watchdog_fired",
                             "oom_events", "corrupt_chunks",
                             "quarantined_rows", "faults_injected")},
@@ -2179,6 +2230,7 @@ def main(compare: dict = None, tolerance: float = 0.20) -> int:
         "fold_histories": FB,
         "fold_invalid": fold_invalid,
         "graph_checker": graph_section,
+        "isolation": iso_section,
         "run_durability": durability_section,
         "fusion_ratio": fusion_ratio,
         "mean_live_slots": mean_live_slots,

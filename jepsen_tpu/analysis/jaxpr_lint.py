@@ -73,6 +73,13 @@ FAMILY_PRIMS: Dict[str, frozenset] = {
     # in the algorithm.
     "dc": _COMMON | {"argmin", "reduce_min", "scatter-min",
                      "scatter-max"},
+    # The isolation-ladder closure is the graph family's kernel shape
+    # — bitset unpack, per-plane boolean matmul squaring, the derived
+    # SI composition — so it shares the graph allowlist exactly. A
+    # divergence (a new primitive appearing in the txn kernel only)
+    # is a reviewed diff, which is why the family is registered
+    # separately rather than aliased.
+    "txn": _COMMON | {"dot_general", "argmax", "div", "rem"},
 }
 FAMILY_DTYPES: Dict[str, frozenset] = {
     "wgl": frozenset({"bool", "int8", "int32", "uint32"}),
@@ -81,6 +88,7 @@ FAMILY_DTYPES: Dict[str, frozenset] = {
     "synth": frozenset({"bool", "int8", "int16", "int32", "uint32"}),
     "pallas": frozenset({"bool", "int8", "int32", "uint32"}),
     "dc": frozenset({"bool", "int32"}),
+    "txn": frozenset({"bool", "int32", "uint32", "float32"}),
 }
 
 
@@ -316,6 +324,12 @@ def probe_specs() -> Dict[str, dict]:
         return (graph_kernel(GV),
                 (_sd((8, N_LEVELS, GV, GV // 32), np.uint32),))
 
+    def txn_closure():
+        from ..ops.txn_graph import N_TXN_PLANES, txn_kernel
+        GV = 32
+        return (txn_kernel(GV),
+                (_sd((8, N_TXN_PLANES, GV, GV // 32), np.uint32),))
+
     def fold_set():
         from ..ops.folds import _set_kernel
         return (_set_kernel(16),
@@ -379,6 +393,7 @@ def probe_specs() -> Dict[str, dict]:
         "wgl-fused": {"build": wgl_fused, "kind": "wgl",
                       "donate": frozenset({0, 1, 2, 4, 5, 6})},
         "graph-closure": {"build": graph_closure, "kind": "graph"},
+        "txn-closure": {"build": txn_closure, "kind": "txn"},
         "fold-set": {"build": fold_set, "kind": "fold"},
         "fold-counter": {"build": fold_counter, "kind": "fold"},
         "synth-cas": {"build": synth_cas, "kind": "synth"},

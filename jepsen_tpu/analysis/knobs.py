@@ -167,8 +167,16 @@ _k("JT_ONLINE_INCREMENTAL", "1", "flag", "online.py",
 _k("JT_DEFER_MAX_S", "300", "float", "online.py",
    "Hard re-admission deadline for a deferred tenant (starvation "
    "rescue).")
+_k("JT_ONLINE_ISO", "1", "flag", "online.py",
+   "Live isolation monitoring of transactional tenants (0 disables "
+   "the per-tick IncrementalIsolation monitor; checks are unaffected).")
 _k("JT_LIVE_STALE_S", "30", "float", "web.py",
    "WAL staleness past which a live run badges stalled vs crashed.")
+
+# --------------------------------------------------------- isolation
+_k("JT_TXN_DEVICE", "1", "flag", "isolation.py",
+   "MXU isolation certification (0 = every transactional history "
+   "certifies on the host DFS oracle — the restore switch).")
 
 # ----------------------------------------------------- fleet/service
 _k("JT_LEASE_TTL_S", "15", "float", "fleet.py",
@@ -257,6 +265,8 @@ _k("JT_BENCH_FOLD_B", "2000", "int", "bench.py",
    "Histories for the invariant-fold section.")
 _k("JT_BENCH_GRAPH_B", "2000", "int", "bench.py",
    "Graphs for the graph-checker section.")
+_k("JT_BENCH_ISO_B", "512", "int", "bench.py",
+   "Transactional histories for the isolation-certifier section.")
 _k("JT_BENCH_MXU_TMACS", "98.5", "float", "bench.py",
    "Assumed peak MXU TMAC/s for mxu_util.")
 _k("JT_BENCH_VPU_GOPS", "6800", "float", "bench.py",

@@ -23,6 +23,9 @@ body { font-family: sans-serif; }
       font-size: 9px; overflow: hidden; border: 1px solid #888;
       box-sizing: border-box; width: 120px; }
 .label { position: absolute; font-size: 11px; font-weight: bold; }
+.badge-iso { background: #5b3b8c; color: #fff; border-radius: 3px;
+             padding: 1px 6px; font-size: 12px; margin-left: 8px;
+             vertical-align: middle; }
 """
 
 PX_PER_S = 100.0
@@ -51,6 +54,25 @@ def render_op(inv: Op, comp: Optional[Op], end_s: float, col: int) -> str:
             f'height:{height:.1f}px;background:{color}">{body}</div>')
 
 
+def _iso_badge(client_ops: Sequence[Op]) -> str:
+    """An ``iso:SI``-style badge for transactional histories — the
+    certified highest isolation level, from the host oracle (a
+    timeline render is a one-off host pass anyway). Empty for
+    non-transactional histories; a malformed txn history badges
+    ``iso:?`` rather than failing the render."""
+    if not any(op.f == "txn" for op in client_ops):
+        return ""
+    from ..ops.txn_graph import (check_txn_host, extract_txn_graph,
+                                 iso_abbrev)
+    try:
+        level = check_txn_host(extract_txn_graph(
+            list(client_ops)))["level"]
+    except ValueError:
+        level = None
+    return (f'<span class="badge-iso">'
+            f"iso:{html.escape(iso_abbrev(level))}</span>")
+
+
 def render_html(test: dict, history: Sequence[Op]) -> str:
     client_ops = [op for op in history if op.is_client]
     end_s = max(((op.time or 0) for op in history), default=0) / 1e9
@@ -64,7 +86,8 @@ def render_html(test: dict, history: Sequence[Op]) -> str:
     blocks = [render_op(inv, comp, end_s, col_of[inv.process])
               for inv, comp in pairs(client_ops)]
     return (f"<html><head><style>{STYLE}</style></head><body>"
-            f"<h1>{html.escape(str(test.get('name', 'test')))}</h1>"
+            f"<h1>{html.escape(str(test.get('name', 'test')))}"
+            f"{_iso_badge(client_ops)}</h1>"
             f'<div class="ops" style="height:'
             f"{end_s * PX_PER_S + 40:.0f}px\">"
             + "".join(labels) + "".join(blocks)

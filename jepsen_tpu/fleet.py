@@ -327,11 +327,15 @@ def estimate_w(history) -> int:
 
 
 def classify_history(history) -> str:
-    """Which checker family decides a unit: ``graph`` for histories
-    whose vocabulary lowers to dependency graphs (list-append /
-    adya-g2 — ops.graph.extract_graph's own sniff rule), ``wgl`` for
-    everything the linearizable frontier scan owns."""
+    """Which checker family decides a unit: ``txn`` for transactional
+    histories (the isolation-ladder certifier), ``graph`` for
+    histories whose vocabulary lowers to dependency graphs
+    (list-append / adya-g2 — ops.graph.extract_graph's own sniff
+    rule), ``wgl`` for everything the linearizable frontier scan
+    owns."""
     fs = {op.f for op in history if op.is_client}
+    if "txn" in fs:
+        return "txn"
     return "graph" if ("append" in fs or "insert" in fs) else "wgl"
 
 
@@ -465,6 +469,21 @@ class CostRouter:
                 * self.rates["graph_host_s_per_edge"])
         return {"graph-device": dev, "graph-host": host}
 
+    def price_txn(self, n_vertices: int, n_edges: int,
+                  rows: int = 1) -> Dict[str, float]:
+        """Per-unit cost of a transactional (isolation-ladder) unit:
+        the MXU ladder closure pays txn_op_model MACs (5 planes + the
+        SI composition matmul) at the padded vertex bucket; the host
+        DFS oracle is linear in vertices + edges per plane."""
+        from .ops.graph import bucket_v
+        from .ops.txn_graph import N_CYC_PLANES, txn_op_model
+        m = txn_op_model(bucket_v(max(int(n_vertices), 1)))
+        dev = (m["macs"] / self.rates["macs_per_s"]
+               + self._overhead_s() / max(int(rows), 1))
+        host = (N_CYC_PLANES * (n_vertices + n_edges)
+                * self.rates["graph_host_s_per_edge"])
+        return {"txn-device": dev, "txn-host": host}
+
     def _record(self, backend: str, costs: Dict[str, float]) -> None:
         self.chosen[backend] = self.chosen.get(backend, 0) + 1
         self.est_cost_s[backend] = (self.est_cost_s.get(backend, 0.0)
@@ -488,6 +507,13 @@ class CostRouter:
     def choose_graph(self, n_vertices: int, n_edges: int,
                      rows: int = 1) -> Tuple[str, Dict[str, float]]:
         costs = self.price_graph(n_vertices, n_edges, rows)
+        backend = min(costs, key=costs.get)
+        self._record(backend, costs)
+        return backend, costs
+
+    def choose_txn(self, n_vertices: int, n_edges: int,
+                   rows: int = 1) -> Tuple[str, Dict[str, float]]:
+        costs = self.price_txn(n_vertices, n_edges, rows)
         backend = min(costs, key=costs.get)
         self._record(backend, costs)
         return backend, costs
@@ -555,7 +581,14 @@ def route_check(model, histories: Sequence, *, router: Optional[
     plan: List[Tuple[int, str]] = []
     graphs: Dict[int, object] = {}
     for i, h in enumerate(histories):
-        if classify_history(h) == "graph":
+        fam = classify_history(h)
+        if fam == "txn":
+            from .ops.txn_graph import extract_txn_graph
+            g = extract_txn_graph(h)
+            graphs[i] = g
+            edges = sum(int(e.shape[0]) for e in g.edges.values())
+            backend, _ = router.choose_txn(g.n, edges)
+        elif fam == "graph":
             from .ops.graph import extract_graph
             g = extract_graph(h)
             graphs[i] = g
@@ -615,6 +648,17 @@ def route_check(model, histories: Sequence, *, router: Optional[
         for i in groups["graph-host"]:
             results[i] = check_graph_host(graphs[i],
                                           provenance="host-oracle")
+    if groups.get("txn-device"):
+        from .isolation import certify_batch
+        idx = groups["txn-device"]
+        rs = certify_batch([graphs[i] for i in idx])
+        for i, r in zip(idx, rs):
+            results[i] = r
+    if groups.get("txn-host"):
+        from .ops.txn_graph import check_txn_host
+        for i in groups["txn-host"]:
+            results[i] = check_txn_host(graphs[i],
+                                        provenance="host-oracle")
     for (i, backend) in plan:
         results[i]["backend"] = backend
     routing = {"units": n,

@@ -893,7 +893,9 @@ def parse_edn_history(text: str) -> List[Op]:
     nil/true/false. Keywords become strings (``:invoke`` -> "invoke");
     ``:index``/``:time`` map onto the op's fields; unknown keys ride
     in ``extra``. Indices are reassigned densely when absent — the
-    wire requires a dense prefix."""
+    wire requires a dense prefix. ``:txn`` ops have their micro-op
+    vectors (``[:r :x nil]``, ``[:append :y 2]``) normalized into the
+    isolation checker's ``[f, key, value]`` lists."""
     ops: List[Op] = []
     for line in text.splitlines():
         line = line.strip()
@@ -906,10 +908,13 @@ def parse_edn_history(text: str) -> List[Op]:
         known = {"process", "type", "f", "value", "time", "index",
                  "error"}
         extra = {k: v for k, v in val.items() if k not in known}
+        value = val.get("value")
+        if val.get("f") == "txn":
+            value = _txn_mops(value)
         ops.append(Op(process=val.get("process"),
                       type=val.get("type"),
                       f=val.get("f"),
-                      value=val.get("value"),
+                      value=value,
                       time=val.get("time"),
                       index=val.get("index"),
                       error=val.get("error"),
@@ -918,6 +923,22 @@ def parse_edn_history(text: str) -> List[Op]:
         for i, op in enumerate(ops):
             op.index = i
     return ops
+
+
+def _txn_mops(value):
+    """Normalize a Jepsen ``:txn`` value — a vector of micro-op
+    vectors, possibly short (``[:r :x]``) — into 3-slot
+    ``[f, key, value]`` lists (ops.txn_graph's mop form). Non-vector
+    values pass through untouched (the extractor raises its own,
+    better error)."""
+    if not isinstance(value, (list, tuple)):
+        return value
+    out = []
+    for m in value:
+        if isinstance(m, (list, tuple)) and 1 <= len(m) <= 3:
+            m = list(m) + [None] * (3 - len(m))
+        out.append(m)
+    return out
 
 
 _EDN_WS = " \t\r\n,"

@@ -100,9 +100,9 @@ from .history.core import index
 from .history.ops import FAIL, INVOKE, OK, Op
 from .history.wal import (TailState, WAL_FILE, salvage_history, tail_wal,
                           writer_alive)
-from .store import (FIRST_VIOLATION, ONLINE_DEFERRED, ONLINE_JOURNAL,
-                    ONLINE_VERDICT, ChunkJournal, DEFAULT, Store,
-                    atomic_write_json)
+from .store import (FIRST_VIOLATION, ONLINE_DEFERRED, ONLINE_ISO,
+                    ONLINE_JOURNAL, ONLINE_VERDICT, ChunkJournal,
+                    DEFAULT, Store, atomic_write_json)
 
 log = logging.getLogger("jepsen.online")
 
@@ -238,6 +238,14 @@ def checkable_prefix(ops: List[Op]) -> List[Op]:
     return index([op.with_() for op in ops])
 
 
+def _is_txn(history: List[Op]) -> bool:
+    """Transactional vocabulary sniff (fleet.classify_history's rule):
+    any ``txn`` client op routes the history to the isolation plane —
+    the register/WGL engines have no semantics for multi-key micro-op
+    vectors."""
+    return any(getattr(op, "f", None) == "txn" for op in history)
+
+
 def _bad_index(r: dict) -> Optional[int]:
     """The first-impossible-op index out of a result dict, from either
     engine's shape (device details decode an op dict; the host engine
@@ -294,6 +302,15 @@ class OnlineConfig:
     # full Store.recheck engine call — the parity contract is
     # structurally untouched by this switch.
     incremental: Optional[bool] = None
+    # -- live isolation monitoring ($JT_ONLINE_ISO, default on;
+    # 0 = the restore switch). Transactional tenants (txn vocabulary)
+    # feed an isolation.IncrementalIsolation monitor per tail tick:
+    # the per-tick "strongest level still holding" verdict is monotone
+    # non-increasing, and every downgrade persists durably as
+    # ``online-iso.json`` (the first-violation pattern). Interim and
+    # final CHECKS are unaffected — they ride the isolation certifier
+    # either way; this switch only governs the per-tick monitor.
+    iso: Optional[bool] = None
     # -- finalization
     crash_quiet_s: float = 1.0      # writer dead AND quiet this long
     min_device_batch: int = 64      # Store.recheck's value (parity)
@@ -306,6 +323,8 @@ class OnlineConfig:
         if self.incremental is None:
             self.incremental = os.environ.get(
                 "JT_ONLINE_INCREMENTAL", "1") != "0"
+        if self.iso is None:
+            self.iso = os.environ.get("JT_ONLINE_ISO", "1") != "0"
         if self.defer_max_s is None:
             try:
                 self.defer_max_s = max(
@@ -353,6 +372,18 @@ class OnlineCheckEngine:
         from .ops.statespace import StateSpaceExplosion
 
         cfg = self.cfg
+        if _is_txn(history):
+            # Transactional tenant: certification rides the isolation
+            # plane (jepsen_tpu.isolation), not the register engines.
+            # ``shed`` maps to the host oracle twin exactly like the
+            # WGL route; final/interim ride certify_batch, which is
+            # also what Store.recheck_isolation dispatches — the
+            # parity contract carries over unchanged.
+            from .isolation import certify_batch, certify_host
+            if shed:
+                return certify_host([history])[0], "online-iso-host"
+            r = certify_batch([history])[0]
+            return r, ("online-iso-final" if final else "online-iso")
         if final:
             r = check_batch_columnar(
                 cfg.model, [history], details="invalid",
@@ -393,6 +424,11 @@ class OnlineCheckEngine:
         from .ops.schedule import FrontierInvalid, ResidentFrontier
         from .ops.statespace import StateSpaceExplosion
 
+        if tenant.is_txn:
+            # The WGL frontier has no transactional semantics; txn
+            # tenants' interim checks ride the isolation certifier
+            # (their O(new ops) path is the per-tick monitor).
+            return None
         d = tenant.daemon
         # $JT_ONLINE_DC: the decrease-and-conquer incremental monitor
         # sits BEFORE the frontier's width guard — its carry is flat
@@ -575,6 +611,13 @@ class OnlineTenant:
         self._no_frontier = False
         self._frontier_ckpt_pos = -1
         self._frontier_ckpt_bad = False
+        # Live isolation monitoring (doc/isolation.md): the txn-
+        # vocabulary latch, the lazy IncrementalIsolation monitor, its
+        # fed-ops watermark, and the durable downgrade record.
+        self.is_txn = False
+        self._iso = None
+        self._iso_cursor = 0
+        self.iso_record: Optional[dict] = None
         # Restart rehydration, cheapest gate first: a durable final
         # verdict means ZERO work; a decided-prefix journal means zero
         # re-dispatch of decided prefixes; a deferred mark means the
@@ -606,6 +649,9 @@ class OnlineTenant:
         fv = daemon.store.first_violation(name, ts)
         if fv is not None:
             self.first_violation = fv
+        iso = daemon.store.online_iso(name, ts)
+        if iso is not None:
+            self.iso_record = iso
 
     def corr_id(self) -> str:
         """This tenant's correlation id: run key + writer INCARNATION
@@ -683,6 +729,8 @@ class OnlineTenant:
         # :info completions do NOT close the slot — the op pends
         # forever, which is exactly what the encoder's window must
         # hold; the admission estimate has to agree with it.
+        if op.f == "txn":
+            self.is_txn = True
         if op.type == INVOKE:
             self._open.add(op.process)
             if len(self._open) > self.peak_w:
@@ -733,6 +781,13 @@ class OnlineTenant:
             fv = self.run_dir / FIRST_VIOLATION
             if fv.exists():
                 fv.unlink()
+        self._iso = None
+        self._iso_cursor = 0
+        if self.iso_record is not None:
+            self.iso_record = None
+            rec = self.run_dir / ONLINE_ISO
+            if rec.exists():
+                rec.unlink()
 
     # ------------------------------------------------------------- tail
     def tail(self) -> bool:
@@ -776,7 +831,47 @@ class OnlineTenant:
                 # The daemon's ingest meter — what the service layer's
                 # cluster-wide ingest-rate budget is enforced against.
                 d._count("ingested_ops", len(out["ops"]))
+            self._iso_tick()
         return bool(out["grew"])
+
+    def _iso_tick(self) -> None:
+        """Feed newly tailed ops to the live isolation monitor
+        (isolation.IncrementalIsolation) and durably persist level
+        DOWNGRADES as ``online-iso.json`` — the first-violation
+        pattern, keyed to the segment incarnation. Only txn-vocabulary
+        tenants ever allocate a monitor; $JT_ONLINE_ISO=0 disables the
+        whole tick. The monitor is advisory observability — a failure
+        here must never cost the tenant its verdict."""
+        d = self.daemon
+        if not d.cfg.iso or not self.is_txn \
+                or self._iso_cursor >= len(self.ops):
+            return
+        try:
+            from .isolation import IncrementalIsolation
+            from .ops.txn_graph import LADDER, iso_abbrev
+            if self._iso is None:
+                self._iso = IncrementalIsolation()
+            new = self.ops[self._iso_cursor:]
+            self._iso_cursor = len(self.ops)
+            level = self._iso.observe(new)
+        except Exception:
+            log.warning("isolation monitor tick of %s failed",
+                        self.key, exc_info=True)
+            return
+        if level is None or level == "serializability":
+            return
+        prev = (self.iso_record or {}).get("level")
+        if prev in LADDER and LADDER.index(level) >= LADDER.index(prev):
+            return
+        rec = {"run": self.key, "level": level,
+               "abbrev": iso_abbrev(level),
+               "prefix_ops": len(self.ops), "ino": self.state.ino,
+               "detected_at": time.time()}
+        atomic_write_json(self.run_dir / ONLINE_ISO, rec)
+        self.iso_record = rec
+        d._count("iso_downgrades")
+        log.warning("ISOLATION DOWNGRADE in %s: %s (caught at a "
+                    "%d-op prefix)", self.key, level, len(self.ops))
 
     # ----------------------------------------------------------- checks
     def _note_verdict(self, verdict, bad: Optional[int],
@@ -931,6 +1026,10 @@ class OnlineTenant:
         d = self.daemon
         d._fire("encode")
         self._drain_tail()
+        # The monitor's last word covers the whole drained segment, so
+        # its final verdict and the post-mortem certification describe
+        # the same ops.
+        self._iso_tick()
         with telemetry.correlation_scope(self.corr_id()), \
                 telemetry.span("online.finalize", tenant=self.key,
                                ops=len(self.ops)):
@@ -1016,6 +1115,11 @@ class OnlineTenant:
         self.state = TailState()
         self._open = set()
         self.peak_w = 0
+        # The monitor re-feeds from op 0 when the tail re-buffers; the
+        # durable downgrade record (online-iso.json) carries the floor
+        # across the pause.
+        self._iso = None
+        self._iso_cursor = 0
         self.status = "deferred"
 
     def resume(self) -> None:
@@ -1052,6 +1156,11 @@ class OnlineTenant:
                     in self.daemon.engine.resident.frontiers),
                 "delta_checks": self.stats.get("delta_checks", 0),
                 "rotations": self.rotations,
+                # Live isolation verdict (txn tenants): the monitor's
+                # current abbreviated level, else the durable downgrade
+                # record's — None for non-transactional tenants.
+                "iso": (self._iso.abbrev() if self._iso is not None
+                        else (self.iso_record or {}).get("abbrev")),
                 # Wire-fed tenant (landed by the ingest plane rather
                 # than a filesystem writer) — display-only: every
                 # checking/finalization path treats both identically.
@@ -1084,7 +1193,7 @@ class OnlineDaemon:
                       "stage_faults": 0, "check_errors": 0,
                       "unknown_verdicts": 0, "first_violations": 0,
                       "finalized": 0, "resumed_prefixes": 0,
-                      "ingested_ops": 0,
+                      "ingested_ops": 0, "iso_downgrades": 0,
                       "delta_ops": 0, "frontier_resumes": 0,
                       "frontier_invalidations": 0,
                       "deferred_starvation_rescues": 0}

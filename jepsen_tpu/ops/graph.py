@@ -361,11 +361,16 @@ def bucket_v(n: int) -> int:
     return max(GRAPH_MIN_V, _pow2(max(n, 1)))
 
 
-def pack_graph(g: DepGraph, V: int) -> np.ndarray:
-    """[L, V, V/32] uint32 packed cumulative masks for one graph."""
+def pack_graph(g: DepGraph, V: int,
+               level_types: Optional[Sequence[Sequence[str]]] = None
+               ) -> np.ndarray:
+    """[L, V, V/32] uint32 packed cumulative masks for one graph.
+    ``level_types`` overrides the plane masks (txn isolation ladder)."""
+    if level_types is None:
+        level_types = LEVEL_TYPES
     Wd = max(V // 32, 1)
-    dense = np.zeros((N_LEVELS, V, Wd * 32), np.uint8)
-    for li, types in enumerate(LEVEL_TYPES):
+    dense = np.zeros((len(level_types), V, Wd * 32), np.uint8)
+    for li, types in enumerate(level_types):
         for t in types:
             e = g.edges.get(t)
             if e is not None and len(e):
@@ -375,7 +380,8 @@ def pack_graph(g: DepGraph, V: int) -> np.ndarray:
 
 
 def encode_graphs(graphs: Sequence[DepGraph],
-                  indices: Optional[Sequence[int]] = None
+                  indices: Optional[Sequence[int]] = None,
+                  level_types: Optional[Sequence[Sequence[str]]] = None
                   ) -> List[GraphBucket]:
     """Bucket a batch of graphs by padded vertex count (powers of two,
     floor GRAPH_MIN_V) and pack each bucket's adjacency bitsets."""
@@ -390,7 +396,8 @@ def encode_graphs(graphs: Sequence[DepGraph],
         for V in sorted(by_v):
             js = by_v[V]
             out.append(GraphBucket(
-                adj=np.stack([pack_graph(graphs[j], V) for j in js]),
+                adj=np.stack([pack_graph(graphs[j], V, level_types)
+                              for j in js]),
                 V=V, indices=[indices[j] for j in js]))
         return out
 
@@ -544,23 +551,28 @@ def shortest_cycle(n: int, succ: List[List[int]]) -> Optional[List[int]]:
     return best
 
 
-def refine_witness(g: DepGraph, level_index: int) -> List[dict]:
+def refine_witness(g: DepGraph, level_index: int,
+                   types: Optional[Sequence[str]] = None) -> List[dict]:
     """Host refinement of a device-flagged cyclic graph into the
     minimal witness cycle, annotated with per-vertex op descriptors and
-    the edge types carrying each hop (the fused_refine pattern)."""
+    the edge types carrying each hop (the fused_refine pattern).
+    ``types`` overrides the cumulative mask for families whose level
+    masks are not LEVEL_TYPES (the txn isolation ladder)."""
     from .. import telemetry
     telemetry.event("graph.refine", vertices=g.n, level=level_index)
-    succ = _succ_lists(g, LEVEL_TYPES[level_index])
+    if types is None:
+        types = LEVEL_TYPES[level_index]
+    succ = _succ_lists(g, types)
     cyc = shortest_cycle(g.n, succ)
     if cyc is None:                  # defensive: caller said cyclic
         return []
-    sets = g.edge_sets()
+    sets = {t: {(int(u), int(v)) for u, v in g.edges.get(t, ())}
+            for t in types}
     vmeta = g.meta.get("vertices") or [{} for _ in range(g.n)]
     out = []
     for i, v in enumerate(cyc):
         w = cyc[(i + 1) % len(cyc)]
-        via = sorted(t for t in LEVEL_TYPES[level_index]
-                     if (v, w) in sets[t])
+        via = sorted(t for t in types if (v, w) in sets[t])
         out.append({"vertex": v, "via": via, **vmeta[v]})
     return out
 
@@ -620,13 +632,23 @@ class IncrementalClosure:
     whose closure holds a diagonal bit (levels only ever gain edges,
     so the verdict is monotone — once cyclic at a level, forever
     cyclic there). Parity: tests pin it against check_graph_host and
-    the from-scratch closure on every prefix of an edge stream."""
+    the from-scratch closure on every prefix of an edge stream.
 
-    def __init__(self, n: int = 0):
+    ``level_types``/``names`` parameterize the cumulative masks so
+    other graph families (the txn isolation ladder) reuse the same
+    incremental machinery; defaults are this family's LEVEL_TYPES."""
+
+    def __init__(self, n: int = 0,
+                 level_types: Optional[Sequence[Sequence[str]]] = None,
+                 names: Optional[Sequence[str]] = None):
+        self.level_types = tuple(tuple(ts) for ts in (
+            LEVEL_TYPES if level_types is None else level_types))
+        self.names = tuple(LEVELS if names is None else names)
+        self.n_levels = len(self.level_types)
         self.n = 0
         self.cols = 0                  # padded column bucket
         self.edges: List[List[Tuple[int, int]]] = \
-            [[] for _ in range(N_LEVELS)]
+            [[] for _ in range(self.n_levels)]
         self.stats = {"edges": 0, "implied": 0, "row_updates": 0,
                       "recloses": 0}
         self._C: Optional[np.ndarray] = None   # [L, V, V/32] uint32
@@ -640,7 +662,8 @@ class IncrementalClosure:
         # join a cycle (the pack_graph invariant).
         self.cols = max(GRAPH_MIN_V, _pow2(n))
         self._C = np.zeros(
-            (N_LEVELS, self.cols, max(1, self.cols // 32)), np.uint32)
+            (self.n_levels, self.cols, max(1, self.cols // 32)),
+            np.uint32)
 
     def grow(self, n: int) -> None:
         """Widen the vertex space to ``n``. Free within the padded
@@ -655,7 +678,7 @@ class IncrementalClosure:
             return                      # pad columns were always zero
         self._alloc(n)
         self.stats["recloses"] += 1
-        for li in range(N_LEVELS):
+        for li in range(self.n_levels):
             for u, v in self.edges[li]:
                 self._apply(li, u, v)
 
@@ -666,7 +689,7 @@ class IncrementalClosure:
         C = self._C
         touched = False
         wv, bv = v // 32, np.uint32(1 << (v % 32))
-        for l in range(li, N_LEVELS):
+        for l in range(li, self.n_levels):
             if C[l, u, wv] & bv:
                 continue                # already implied at this level
             # rows that reach u (plus u itself) gain v's reach plus v.
@@ -687,7 +710,7 @@ class IncrementalClosure:
         hi = max(int(u), int(v)) + 1
         if hi > self.n:
             self.grow(hi)
-        li = next(i for i, types in enumerate(LEVEL_TYPES)
+        li = next(i for i, types in enumerate(self.level_types)
                   if etype in types)
         self.edges[li].append((int(u), int(v)))
         self.stats["edges"] += 1
@@ -708,16 +731,16 @@ class IncrementalClosure:
         """Per cumulative level: does the closure hold a diagonal bit?
         (The device kernel's ``cyc`` output, derived incrementally.)"""
         if self._C is None:
-            return [False] * N_LEVELS
+            return [False] * self.n_levels
         idx = np.arange(self.n)
         return [bool((self._C[l, idx, idx // 32]
                       >> (idx % 32).astype(np.uint32) & 1).any())
-                for l in range(N_LEVELS)]
+                for l in range(self.n_levels)]
 
     def anomaly(self) -> Optional[str]:
         """The running verdict: the FIRST cumulative level whose mask
         closed into a cycle, or None. Monotone in the edge stream."""
         for li, cyc in enumerate(self.cyclic_levels()):
             if cyc:
-                return LEVELS[li]
+                return self.names[li]
         return None

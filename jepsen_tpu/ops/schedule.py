@@ -2521,7 +2521,19 @@ class GraphScheduler:
                  backoff_s: Optional[float] = None,
                  on_chunk=None,
                  compilation_cache: bool = True,
-                 resident: Optional[ResidentState] = None):
+                 resident: Optional[ResidentState] = None,
+                 family: str = "graph",
+                 kernel=None, levels: Optional[int] = None,
+                 op_model=None):
+        # family/kernel/levels/op_model parameterize which closure
+        # family this scheduler drives (default: the ops.graph anomaly
+        # planes; the txn isolation ladder passes its own). The fault
+        # ladder, journaling hooks and stats contract are identical.
+        from .graph import N_LEVELS, graph_kernel, mxu_op_model
+        self.family = family
+        self.kernel = graph_kernel if kernel is None else kernel
+        self.levels = N_LEVELS if levels is None else int(levels)
+        self.op_model = mxu_op_model if op_model is None else op_model
         self.chunk_rows = (GRAPH_CHUNK_ROWS if chunk_rows is None
                            else max(1, int(chunk_rows)))
         if compilation_cache:
@@ -2556,14 +2568,13 @@ class GraphScheduler:
         }
 
     def _inc(self, key: str, n=1) -> None:
-        _stat_inc(self, "graph", key, n)
+        _stat_inc(self, self.family, key, n)
 
     # ------------------------------------------------------------ plumbing
     def _deadline(self, b, rows: int) -> float:
-        from .graph import mxu_op_model
         if self.faults is not None and self.faults.deadline_s is not None:
             return self.faults.deadline_s
-        est = rows * mxu_op_model(b.V)["macs"]
+        est = rows * self.op_model(b.V)["macs"]
         d = max(WATCHDOG_MIN_S,
                 est / WATCHDOG_MXU_MACS_PER_S * WATCHDOG_FACTOR)
         if b.V not in self._awaited_shapes:
@@ -2575,9 +2586,9 @@ class GraphScheduler:
         """The ONE dispatch sequence for both the happy path and every
         ladder re-dispatch: fault hooks, zero-pad to Bp rows (padding
         graphs are edgeless, never cyclic), async kernel launch."""
-        from .graph import graph_kernel, mxu_op_model
         nb = hi - lo
-        with telemetry.span("encode", family="graph", V=b.V, rows=nb):
+        with telemetry.span("encode", family=self.family, V=b.V,
+                            rows=nb):
             if self.faults is not None:
                 self.faults.fire("encode")
             adj = np.zeros((Bp,) + b.adj.shape[1:], np.uint32)
@@ -2585,10 +2596,10 @@ class GraphScheduler:
         delay = 0.0
         if self.faults is not None:
             delay = self.faults.sleep_for(self.faults.fire("dispatch"))
-        with telemetry.span("dispatch", cat="device", family="graph",
-                            V=b.V, rows=nb):
-            out = graph_kernel(b.V)(adj)
-        m = mxu_op_model(b.V)
+        with telemetry.span("dispatch", cat="device",
+                            family=self.family, V=b.V, rows=nb):
+            out = self.kernel(b.V)(adj)
+        m = self.op_model(b.V)
         self._inc("chunks")
         self._inc("closure_matmuls", Bp * int(m["matmuls"]))
         self._inc("mxu_macs", Bp * m["macs"])
@@ -2608,8 +2619,8 @@ class GraphScheduler:
             try:
                 if delay:
                     time.sleep(delay)
-                with telemetry.span("decode", family="graph", V=b.V,
-                                    rows=nb):
+                with telemetry.span("decode", family=self.family,
+                                    V=b.V, rows=nb):
                     kind = None
                     if self.faults is not None:
                         kind = self.faults.fire("decode")
@@ -2632,10 +2643,10 @@ class GraphScheduler:
             r, err = q.get(timeout=deadline)
         except queue.Empty:
             self._inc("watchdog_fired")
-            telemetry.event("scheduler.watchdog", family="graph",
+            telemetry.event("scheduler.watchdog", family=self.family,
                             V=b.V, rows=nb)
             raise WatchdogExpired(
-                f"graph chunk (V={b.V}, rows={nb}) exceeded its "
+                f"{self.family} chunk (V={b.V}, rows={nb}) exceeded its "
                 f"{deadline:.2f}s decode deadline") from None
         if err is not None:
             raise err
@@ -2656,7 +2667,7 @@ class GraphScheduler:
         for attempt in range(self.max_retries + 1):
             if attempt:
                 self._inc("retries")
-                telemetry.event("scheduler.retry", family="graph",
+                telemetry.event("scheduler.retry", family=self.family,
                                 V=b.V, attempt=attempt)
                 time.sleep(self.backoff_s * (2 ** (attempt - 1)))
             try:
@@ -2671,9 +2682,8 @@ class GraphScheduler:
         raise _ChunkFailed(last)
 
     def _placeholder(self, n: int):
-        from .graph import N_LEVELS
-        return (np.zeros((n, N_LEVELS), bool),
-                np.full((n, N_LEVELS), INT32_MAX, np.int32))
+        return (np.zeros((n, self.levels), bool),
+                np.full((n, self.levels), INT32_MAX, np.int32))
 
     def _quarantine(self, b, row: int, cause: BaseException):
         i = b.indices[row]
@@ -2681,7 +2691,7 @@ class GraphScheduler:
         self.quarantined[i] = reason
         self.row_provenance[i] = "host-fallback"
         self._inc("quarantined_rows")
-        telemetry.event("scheduler.quarantine", family="graph",
+        telemetry.event("scheduler.quarantine", family=self.family,
                         row=int(i), reason=reason)
         log.warning("quarantining graph %s after exhausting the device "
                     "ladder (%s); the host DFS oracle decides it", i,
@@ -2733,8 +2743,9 @@ class GraphScheduler:
             if Bp > 1:
                 Bp = max(1, Bp // 2)
                 self._inc("bisections")
-                telemetry.event("scheduler.bisection", family="graph",
-                                V=b.V, rows_per_dispatch=Bp)
+                telemetry.event("scheduler.bisection",
+                                family=self.family, V=b.V,
+                                rows_per_dispatch=Bp)
                 self._safe_bp[b.V] = Bp
                 log.warning("OOM on graph chunk (V=%s): bisecting to %s "
                             "rows/dispatch", b.V, Bp)
@@ -2749,7 +2760,7 @@ class GraphScheduler:
             self._inc("oom_events")
         if isinstance(cause, CorruptOutput):
             self._inc("corrupt_chunks")
-        telemetry.event("scheduler.retry", family="graph", V=b.V,
+        telemetry.event("scheduler.retry", family=self.family, V=b.V,
                         rows=hi - lo, cause=type(cause).__name__)
         log.warning("graph chunk (V=%s, rows %s:%s) failed (%s: %s); "
                     "entering the degradation ladder", b.V, lo, hi,

@@ -63,6 +63,10 @@ ONLINE_JOURNAL = "online.journal.jsonl"
 ONLINE_VERDICT = "online-verdict.json"
 ONLINE_DEFERRED = "online-deferred.json"
 FIRST_VIOLATION = "first-violation.json"
+# The live isolation monitor's durable downgrade record (txn tenants):
+# which ladder level the run fell to and at what prefix — the
+# first-violation pattern applied to the isolation plane.
+ONLINE_ISO = "online-iso.json"
 
 # Store-level tenant registry the daemon persists each tick (web /live
 # reads it cross-process).
@@ -500,6 +504,13 @@ class Store:
         run invalid and at what prefix the daemon caught it."""
         return self._run_json(test_name, ts, FIRST_VIOLATION)
 
+    def online_iso(self, test_name: str, ts: str) -> Optional[dict]:
+        """The live isolation monitor's durable downgrade record
+        (level, prefix, incarnation), or None while the run still
+        holds serializability / was never watched / is not
+        transactional."""
+        return self._run_json(test_name, ts, ONLINE_ISO)
+
     def load(self, test_name: str, ts: str = "latest") -> dict:
         """Rehydrate a stored run: test map slice + history + results
         (store.clj:165-171)."""
@@ -632,6 +643,35 @@ class Store:
                     faults=faults))
         out = group_unit_results(labels, rs)
         self._tag_recheck(out, test_name, ts)
+        return out
+
+    def recheck_isolation(self, test_name: str,
+                          timestamps: Optional[Sequence[str]] = None, *,
+                          faults=None) -> dict:
+        """Post-mortem isolation certification of every stored
+        transactional history of a test in one batched dispatch — the
+        txn family's ``recheck`` twin and the online daemon's parity
+        reference (the daemon's final check routes through the same
+        ``isolation.certify_batch`` call). Returns
+        {"valid", "runs": {ts: result}} where each result is an
+        ops.txn_graph.txn_result dict carrying the certified level."""
+        from .isolation import certify_batch
+
+        ts = (list(timestamps) if timestamps is not None
+              else self.tests().get(test_name, []))
+        units, labels = [], []
+        for t in ts:
+            loaded = self.load(test_name, t)
+            if "history" in loaded:
+                units.append(loaded["history"])
+                labels.append(t)
+        if not units:
+            return {"valid": "unknown", "runs": {},
+                    "error": f"no stored histories for {test_name!r}"}
+        rs = certify_batch(units, faults=faults)
+        out = {"valid": all(r["valid"] for r in rs),
+               "runs": dict(zip(labels, rs))}
+        self._tag_recheck(out, test_name, labels)
         return out
 
     def _tag_recheck(self, out: dict, test_name: str, ts) -> None:

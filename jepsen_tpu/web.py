@@ -52,6 +52,7 @@ td, th { padding: .3em .8em; border: 1px solid #ccc; text-align: left; }
 .badge-clean { background: #3a8f3a; color: #fff; }
 .badge-fleet { background: #5b4fa2; color: #fff; }
 .badge-inc { background: #2a7f74; color: #fff; }
+.badge-iso { background: #5b3b8c; color: #fff; }
 a { text-decoration: none; }
 pre { background: #f7f7f7; padding: 1em; overflow-x: auto; }
 """
@@ -436,22 +437,33 @@ class Handler(BaseHTTPRequestHandler):
                 return True       # nothing newer on disk to contradict
         v = self.store.online_verdict(name, ts)
         fv = self.store.first_violation(name, ts)
+        iso = self.store.online_iso(name, ts)
         if not fresh(v):
             v = None
         if not fresh(fv):
             fv = None
+        if not fresh(iso):
+            iso = None
+        t = (reg.get("tenants") or {}).get(f"{name}/{ts}")
+        # Per-tenant isolation badge (txn tenants): the live monitor's
+        # current level from the registry, else the durable downgrade
+        # record (doc/isolation.md "Online monitoring").
+        iso_abbr = (t or {}).get("iso") or (iso or {}).get("abbrev")
+        iso_b = (f' <span class="badge badge-iso">iso:'
+                 f"{html.escape(str(iso_abbr))}</span>" if iso_abbr
+                 else "")
         if fv is not None:
             where = fv.get("op_index")
             return (f'<span class="badge badge-violation">INVALID @ op '
-                    f"{html.escape(str(where))}</span>")
+                    f"{html.escape(str(where))}</span>{iso_b}")
         if v is not None:
             ok = v.get("valid") is True
             cls = "badge-clean" if ok else "badge-violation"
             txt = "valid" if ok else f"invalid: {v.get('valid')}"
-            return f'<span class="badge {cls}">{html.escape(txt)}</span>'
-        t = (reg.get("tenants") or {}).get(f"{name}/{ts}")
+            return (f'<span class="badge {cls}">{html.escape(txt)}'
+                    f"</span>{iso_b}")
         if t is None:
-            return "—"
+            return "—" + iso_b
         # Incremental-status badge: this tenant's interim checks are
         # riding a resident device frontier (O(new ops) per tick —
         # doc/online.md "The resident frontier").
@@ -459,11 +471,11 @@ class Handler(BaseHTTPRequestHandler):
                if t.get("incremental") else "")
         if t.get("valid_so_far") is True:
             return (f'<span class="badge badge-clean">✓ so far '
-                    f"({t.get('checked_ops', 0)} ops)</span>{inc}")
+                    f"({t.get('checked_ops', 0)} ops)</span>{inc}{iso_b}")
         if t.get("valid_so_far") is False:
             return ('<span class="badge badge-violation">invalid'
-                    f"</span>{inc}")
-        return html.escape(str(t.get("status", "watched"))) + inc
+                    f"</span>{inc}{iso_b}")
+        return html.escape(str(t.get("status", "watched"))) + inc + iso_b
 
     def index(self):
         incomplete = set(self.store.incomplete(include_salvaged=False))

@@ -127,6 +127,15 @@ def pytest_configure(config):
                    "writer, and the Jepsen-EDN adapter "
                    "(deterministic; runs in tier-1)")
     config.addinivalue_line(
+        "markers", "isolation: isolation-ladder certification plane — "
+                   "seeded per-anomaly kill tests at exact expected "
+                   "levels, device-vs-host-oracle field parity "
+                   "(fault-free and under every single-fault "
+                   "schedule), kill-and-resume with zero re-dispatch, "
+                   "incremental monitor monotone-downgrade parity, "
+                   "and the live online-monitoring contract "
+                   "(deterministic; runs in tier-1)")
+    config.addinivalue_line(
         "markers", "obsplane: cluster observability plane — durable "
                    "metrics series ring files, OpenMetrics exposition "
                    "validity, cross-worker trace correlation/merge, "

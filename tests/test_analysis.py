@@ -374,7 +374,7 @@ def test_pallas_supports_consults_the_vmem_model(monkeypatch):
 EXPECTED_FAMILIES = {
     "wgl-scan", "wgl-resume", "wgl-fused", "graph-closure",
     "fold-set", "fold-counter", "synth-cas", "synth-la",
-    "synth-wide", "pallas-wgl", "dc-peel"}
+    "synth-wide", "pallas-wgl", "dc-peel", "txn-closure"}
 
 
 def test_jaxpr_lint_covers_all_registered_kernel_families(

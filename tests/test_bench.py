@@ -19,7 +19,7 @@ def test_bench_emits_contract_json():
     env = dict(os.environ,
                JT_BENCH_B="200", JT_BENCH_OPS="100",
                JT_BENCH_REPEATS="1", JT_BENCH_FOLD_B="32",
-               JT_BENCH_GRAPH_B="32",
+               JT_BENCH_GRAPH_B="32", JT_BENCH_ISO_B="24",
                JT_BENCH_STORE_B="12", JT_BENCH_CONVERTED="120",
                JT_BENCH_FULL_PARITY="0", JT_BENCH_WAL_OPS="300",
                # Per-op commits: 400 toy ops can finish inside one
@@ -100,6 +100,20 @@ def test_bench_emits_contract_json():
     assert g["anomalies"] >= 1
     assert g["vertex_buckets"]
     assert g["resilience"]["quarantined_rows"] == 0
+    # Isolation-certifier section (ISSUE 19 acceptance): ladder
+    # throughput over a seeded anomaly mix, with the per-level
+    # breakdown doubling as the injection-mix audit.
+    iso = d["isolation"]
+    assert iso["histories"] == 24 and iso["hist_per_s"] > 0
+    assert iso["e2e_hist_per_s"] > 0 and iso["device_s"] > 0
+    assert iso["closure_matmuls"] > 0
+    assert sum(iso["levels"].values()) == 24
+    assert set(iso["levels"]) <= {
+        "none", "read-uncommitted", "read-committed",
+        "repeatable-read", "snapshot-isolation", "serializability"}
+    assert sum(iso["anomaly_mix"].values()) == 24
+    assert "clean" in iso["anomaly_mix"]
+    assert iso["resilience"]["quarantined_rows"] == 0
     # Run-durability section (ISSUE 5 acceptance): live-WAL worker-loop
     # overhead, group-commit flush percentiles, salvage throughput.
     rd = d["run_durability"]
@@ -286,10 +300,11 @@ def test_bench_emits_contract_json():
     # wall-clock.
     an = d["analysis"]
     assert len(an["rules_run"]) == 13    # +JTL-H-SOCK (ISSUE 18)
-    assert len(an["families"]) == 11
+    assert len(an["families"]) == 12     # +txn-closure (ISSUE 19)
     assert "wgl-scan" in an["families"] and \
         "pallas-wgl" in an["families"] and \
-        "dc-peel" in an["families"]
+        "dc-peel" in an["families"] and \
+        "txn-closure" in an["families"]
     assert an["files_scanned"] > 80
     assert an["findings"] == 0 and an["by_rule"] == {}
     assert an["suppressed"] == 0        # the committed baseline is empty

@@ -216,8 +216,8 @@ def test_broken_encoder_is_caught_by_parity_gate(monkeypatch,
     the fuzz gate is vacuous."""
     real = graph_mod.pack_graph
 
-    def lobotomized(g, V):
-        return np.zeros_like(real(g, V))
+    def lobotomized(g, V, *a, **kw):
+        return np.zeros_like(real(g, V, *a, **kw))
 
     monkeypatch.setattr(graph_mod, "pack_graph", lobotomized)
     got = check_graphs_batch(graph_corpus)

@@ -1,0 +1,423 @@
+"""Isolation-ladder certification plane (jepsen_tpu.isolation,
+ops.txn_graph, ops.synth_txn — doc/isolation.md).
+
+The third device checker family under the repo's parity discipline:
+the MXU ladder-closure kernel and the host DFS oracle were written as
+independent algorithms, so their field-for-field agreement over a
+seeded anomaly-mix corpus — fault-free AND under every single-fault
+nemesis schedule — is the acceptance gate. Also here: the per-anomaly
+kill tests (every injected anomaly class certifies at EXACTLY its
+expected maximum level on BOTH engines), the ChunkJournal
+kill-and-resume contract for transactional batches, the incremental
+monitor's every-prefix monotone-downgrade parity, the live online
+monitoring contract (per-tick verdict monotone non-increasing, final
+verdict field-identical to a post-mortem Store.recheck_isolation),
+and the EDN-over-the-wire e2e (a stock Jepsen ``:txn`` trace streamed
+through the ingest plane to a final isolation verdict).
+"""
+import json
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu.history.codec import dumps_op, write_jsonl
+from jepsen_tpu.history.core import index
+from jepsen_tpu.history.ops import invoke_op, ok_op
+from jepsen_tpu.history.wal import WAL_FILE, WAL_MAGIC
+from jepsen_tpu.isolation import (HostIsolationChecker,
+                                  IncrementalIsolation, IsolationChecker,
+                                  certify_batch, certify_host)
+from jepsen_tpu.models.core import cas_register
+from jepsen_tpu.online import OnlineConfig, OnlineDaemon
+from jepsen_tpu.ops.faults import (FaultInjector, FaultPlan, InjectedKill,
+                                   single_fault_schedules)
+from jepsen_tpu.ops.graph import closure_iters
+from jepsen_tpu.ops.synth_txn import (ANOMALIES, EXPECTED_CAP, TxnSpec,
+                                      synth_txn_batch, synth_txn_history)
+from jepsen_tpu.ops.txn_graph import (ISO_LEVELS, LADDER, N_CYC_PLANES,
+                                      check_txn_host, extract_txn_graph,
+                                      iso_abbrev, txn_op_model)
+from jepsen_tpu.store import ChunkJournal, ONLINE_ISO, Store
+
+pytestmark = pytest.mark.isolation
+
+PROVENANCE_TAGS = {"device", "device-retried", "host-fallback"}
+DEAD_PID = 2 ** 22 + 12345
+
+#: Exact (level, violated-plane) expectation per injected anomaly —
+#: the kill-test table (doc/isolation.md documents each construction).
+EXPECTED = {
+    None: ("serializability", None),
+    "write-skew": ("snapshot-isolation", "G2"),
+    "phantom": ("repeatable-read", "G-SI"),
+    "lost-update": ("read-committed", "G2-item"),
+    "fractured-read": ("read-committed", "G2-item"),
+    "aborted-read": ("read-uncommitted", "G1a"),
+    "intermediate-read": ("read-uncommitted", "G1b"),
+    "dirty-write": ("none", "G0"),
+}
+
+
+# ------------------------------------------------------------- fixtures
+
+@pytest.fixture(scope="module")
+def txn_corpus():
+    """A seeded anomaly mix: (ops, injected-anomaly) per history."""
+    return synth_txn_batch(TxnSpec(n=28, seed=11, n_txns=8,
+                                   anomaly="mix"))
+
+
+@pytest.fixture(scope="module")
+def txn_graphs(txn_corpus):
+    return [extract_txn_graph(ops) for ops, _ in txn_corpus]
+
+
+@pytest.fixture(scope="module")
+def host_verdicts(txn_graphs):
+    return certify_host(txn_graphs)
+
+
+@pytest.fixture(scope="module")
+def device_baseline(txn_graphs):
+    """Fault-free device verdicts (also warms every kernel shape, so
+    fault runs never trip the watchdog on a compile)."""
+    return certify_batch(txn_graphs)
+
+
+def assert_field_parity(got, want, ctx=""):
+    for i, (g, w) in enumerate(zip(got, want, strict=True)):
+        for k in ("valid", "level", "anomaly", "cycle", "edges",
+                  "g1a", "g1b"):
+            assert g[k] == w[k], (ctx, i, k)
+
+
+# ---------------------------------------------- per-anomaly kill tests
+
+@pytest.mark.parametrize("anomaly", list(EXPECTED))
+def test_anomaly_certifies_at_exactly_its_cap_both_engines(anomaly):
+    """The gate has teeth, per level: each injected anomaly class caps
+    the certified level at EXACTLY its Adya expectation, on BOTH
+    engines, across seeds."""
+    spec = TxnSpec(n=3, seed=5, n_txns=6, anomaly=anomaly)
+    level, plane = EXPECTED[anomaly]
+    for ops, got_anom in synth_txn_batch(spec):
+        assert got_anom == anomaly
+        g = extract_txn_graph(ops)
+        host = check_txn_host(g)
+        dev = certify_batch([g])[0]
+        for r, eng in ((host, "host"), (dev, "device")):
+            assert r["level"] == level, (anomaly, eng, r["level"])
+            assert r["anomaly"] == plane, (anomaly, eng)
+            assert r["valid"] is (level == "serializability")
+        # The witness names the violation: a minimal cycle for the
+        # cyclic planes, the offending read for the G1a/G1b flags.
+        if plane in ("G1a", "G1b"):
+            assert host["cycle"] and all(
+                "key" in w and "writer" in w for w in host["cycle"])
+        elif plane is not None:
+            assert len(host["cycle"]) >= 2
+
+
+def test_mix_injection_labels_match_verdicts(txn_corpus, host_verdicts):
+    """The mix stream's injected-anomaly label agrees with the oracle
+    verdict history by history — and the mix actually covers every
+    class plus the clean baseline."""
+    seen = set()
+    for (ops, anom), r in zip(txn_corpus, host_verdicts, strict=True):
+        assert r["level"] == EXPECTED_CAP[anom], anom
+        seen.add(anom)
+    assert seen == set(ANOMALIES) | {None}
+
+
+# ---------------------------------------------------- device-host parity
+
+def test_device_matches_host_oracle(host_verdicts, device_baseline):
+    assert_field_parity(device_baseline, host_verdicts)
+    assert all(r["provenance"] == "device" for r in device_baseline)
+
+
+def test_parity_under_every_single_fault_schedule(txn_graphs,
+                                                  host_verdicts,
+                                                  device_baseline):
+    """The acceptance gate: under every single-fault schedule the
+    certifier returns a verdict for 100% of histories, field-for-field
+    identical to the fault-free run, each row carrying a legal
+    provenance tag, with recovery provenance actually appearing."""
+    for name, plan in single_fault_schedules():
+        inj = FaultInjector(plan)
+        got = certify_batch(txn_graphs, faults=inj,
+                            scheduler_opts={"chunk_rows": 8})
+        assert_field_parity(got, host_verdicts, name)
+        assert all(r["provenance"] in PROVENANCE_TAGS for r in got), name
+        assert inj.log, f"schedule {name} never engaged"
+        assert any(r["provenance"] != "device" for r in got), \
+            f"schedule {name} engaged but no row records a recovery"
+
+
+def test_sticky_corruption_quarantines_to_host_oracle(txn_graphs,
+                                                      host_verdicts):
+    inj = FaultInjector(FaultPlan.sticky("decode", "corrupt"))
+    stats = {}
+    got = certify_batch(txn_graphs, faults=inj,
+                        scheduler_opts={"chunk_rows": 8,
+                                        "max_retries": 1},
+                        stats_out=stats)
+    assert_field_parity(got, host_verdicts, "sticky-corrupt")
+    assert all(r["provenance"] == "host-fallback" for r in got)
+    assert stats["quarantined_rows"] == len(txn_graphs)
+
+
+def test_txn_device_restore_switch(monkeypatch, txn_graphs,
+                                   host_verdicts):
+    """JT_TXN_DEVICE=0: every history certifies on the host oracle —
+    same fields, ``host`` provenance, zero device dispatch."""
+    monkeypatch.setenv("JT_TXN_DEVICE", "0")
+    got = certify_batch(txn_graphs)
+    assert_field_parity(got, host_verdicts, "restore-switch")
+    assert all(r["provenance"] == "host" for r in got)
+
+
+# --------------------------------------- durable journal + resume
+
+def test_kill_and_resume_redispatches_zero_decided_graphs(
+        tmp_path, txn_graphs, host_verdicts, device_baseline):
+    key = {"digest": "txn-kill"}
+    j1 = ChunkJournal(tmp_path / "t.jsonl", key)
+    inj = FaultInjector(FaultPlan.single("dispatch", "kill", chunk=2,
+                                         deadline_s=5.0))
+    with pytest.raises(InjectedKill):
+        certify_batch(txn_graphs, faults=inj, journal=j1,
+                      scheduler_opts={"chunk_rows": 8})
+    j1.close()
+    j2 = ChunkJournal(tmp_path / "t.jsonl", key, resume=True)
+    decided = j2.decided()
+    assert 0 < len(decided) < len(txn_graphs)
+    stats = {}
+    got = certify_batch(txn_graphs, journal=j2,
+                        scheduler_opts={"chunk_rows": 8},
+                        stats_out=stats)
+    assert stats["graphs"] == len(txn_graphs) - len(decided), \
+        "decided histories must not re-dispatch"
+    n_resumed = 0
+    for i, (g, w) in enumerate(zip(got, host_verdicts, strict=True)):
+        assert g["valid"] == w["valid"], i
+        assert g["level"] == w["level"], i
+        if g.get("resumed"):
+            n_resumed += 1
+        else:
+            assert g["anomaly"] == w["anomaly"], i
+            assert g["cycle"] == w["cycle"], i
+    assert n_resumed == len(decided) == j2.resume_hits
+    j2.finish()
+    assert not (tmp_path / "t.jsonl").exists()
+
+
+# ------------------------------------------------- incremental monitor
+
+@pytest.mark.parametrize("anomaly", ["write-skew", "phantom",
+                                     "lost-update", "aborted-read",
+                                     "intermediate-read", "dirty-write"])
+def test_incremental_monitor_every_prefix_monotone_parity(anomaly):
+    """Feed the history ONE op at a time: the monitor's verdict is
+    monotone non-increasing at every prefix, and at every prefix
+    equals the running minimum of the full host-oracle certification
+    of that prefix — the downgrade lands at the same op the oracle
+    would flag."""
+    ops, _ = synth_txn_history(
+        TxnSpec(n_txns=5, seed=2, anomaly=anomaly), 0)
+    mon = IncrementalIsolation()
+    prev = floor = len(LADDER) - 1
+    for i in range(len(ops)):
+        level = mon.observe([ops[i]])
+        assert level is not None
+        cur = LADDER.index(level)
+        assert cur <= prev, (anomaly, i, "verdict must never raise")
+        prev = cur
+        host = check_txn_host(extract_txn_graph(ops[:i + 1]))["level"]
+        floor = min(floor, LADDER.index(host))
+        assert cur == floor, (anomaly, i)
+    assert LADDER[prev] == EXPECTED_CAP[anomaly]
+    assert mon.stats["ops"] == len(ops)
+    assert mon.abbrev() == iso_abbrev(EXPECTED_CAP[anomaly])
+
+
+def test_incremental_monitor_batch_feed_matches_oracle(txn_corpus):
+    """Chunked feeding (the daemon's real cadence) converges to the
+    same final level as the one-shot oracle for every mix history."""
+    for ops, anom in txn_corpus[:8]:
+        mon = IncrementalIsolation()
+        for lo in range(0, len(ops), 5):
+            mon.observe(ops[lo:lo + 5])
+        assert mon.level() == EXPECTED_CAP[anom], anom
+
+
+# ----------------------------------------------------- online monitoring
+
+def _write_txn_wal(run_dir: Path, ops, *, analyzed=False, append=False):
+    lines = []
+    if not append:
+        run_dir.mkdir(parents=True, exist_ok=True)
+        lines += [json.dumps({"wal": WAL_MAGIC,
+                              "test": {"name": run_dir.parent.name},
+                              "seed": 0, "pid": DEAD_PID,
+                              "phase": "setup"}),
+                  json.dumps({"phase": "run", "wal_ops": 0})]
+    lines += [dumps_op(o) for o in ops]
+    if analyzed:
+        lines.append(json.dumps({"phase": "analyzed",
+                                 "wal_ops": len(ops)}))
+    with open(run_dir / WAL_FILE, "ab" if append else "wb") as f:
+        f.write(("\n".join(lines) + "\n").encode())
+
+
+def test_online_monitor_monotone_and_final_matches_recheck(tmp_path):
+    """The live-monitoring acceptance contract: a txn tenant's
+    per-tick verdict is monotone non-increasing, the downgrade lands
+    durably as online-iso.json, the /live summary carries the badge
+    abbreviation, and the daemon's FINAL verdict is field-identical to
+    a post-mortem Store.recheck_isolation certification."""
+    clean, _ = synth_txn_history(TxnSpec(n_txns=6, seed=3), 0)
+    ops, _ = synth_txn_history(
+        TxnSpec(n_txns=6, seed=3, anomaly="write-skew"), 0)
+    ops = index([o.with_() for o in ops])
+    assert ops[:len(clean)] and len(ops) > len(clean)
+    d = tmp_path / "txn" / "r1"
+    _write_txn_wal(d, ops[:len(clean)])
+    store = Store(tmp_path)
+    daemon = OnlineDaemon(store=store, config=OnlineConfig(
+        poll_s=0, check_interval_ops=4, crash_quiet_s=60))
+    daemon.tick()
+    t = daemon.tenants[("txn", "r1")]
+    assert t.is_txn and t._iso is not None
+    assert t._iso.level() == "serializability"
+    assert t.iso_record is None and not (d / ONLINE_ISO).exists()
+    # ...the anomaly suffix streams in: the verdict downgrades, once,
+    # durably, and never climbs back.
+    _write_txn_wal(d, ops[len(clean):], append=True, analyzed=True)
+    write_jsonl(d / "history.jsonl", ops)
+    daemon.tick()
+    assert t._iso.level() == "snapshot-isolation"
+    assert t.summary()["iso"] == "SI"
+    rec = json.loads((d / ONLINE_ISO).read_text())
+    assert rec["level"] == "snapshot-isolation"
+    assert daemon.stats["iso_downgrades"] == 1
+    for _ in range(3):
+        daemon.tick()
+        if t.status == "done":
+            break
+    assert t.status == "done"
+    post = store.recheck_isolation("txn")["runs"]["r1"]
+    for k in ("valid", "level", "anomaly", "cycle", "edges"):
+        assert t.result[k] == post[k], k
+    assert t.result["level"] == "snapshot-isolation"
+    # The monitor's verdict and the certification agree at the end.
+    assert t._iso.level() == t.result["level"]
+    # Rehydration: a fresh daemon serves the downgrade record with
+    # zero work, straight from the durable file.
+    d2 = OnlineDaemon(store=store, config=OnlineConfig(poll_s=0))
+    d2.tick()
+    t2 = d2.tenants[("txn", "r1")]
+    assert t2.status == "done" and t2.summary()["iso"] == "SI"
+    daemon.close()
+    d2.close()
+
+
+def test_online_iso_restore_switch(tmp_path, monkeypatch):
+    """JT_ONLINE_ISO=0: no monitor, no downgrade record — but the
+    tenant's CHECKS still certify (the switch governs only the
+    per-tick monitor)."""
+    monkeypatch.setenv("JT_ONLINE_ISO", "0")
+    ops, _ = synth_txn_history(
+        TxnSpec(n_txns=4, seed=1, anomaly="lost-update"), 0)
+    ops = index([o.with_() for o in ops])
+    d = tmp_path / "txn" / "r1"
+    _write_txn_wal(d, ops, analyzed=True)
+    write_jsonl(d / "history.jsonl", ops)
+    store = Store(tmp_path)
+    daemon = OnlineDaemon(store=store, config=OnlineConfig(
+        poll_s=0, check_interval_ops=4, crash_quiet_s=0))
+    for _ in range(4):
+        daemon.tick()
+    t = daemon.tenants[("txn", "r1")]
+    assert t.status == "done"
+    assert t._iso is None and not (d / ONLINE_ISO).exists()
+    assert t.summary()["iso"] is None
+    assert t.result["level"] == "read-committed"
+    daemon.close()
+
+
+# --------------------------------------------------- EDN over the wire
+
+def test_edn_txn_trace_streams_to_isolation_verdict(tmp_path):
+    """E2E: a stock Jepsen ``:txn`` EDN trace → parse_edn_history →
+    exactly-once wire streaming (ingest plane) → online daemon →
+    final isolation verdict with the live badge."""
+    from jepsen_tpu.ingest import (IngestServer, parse_edn_history,
+                                   sequence_audit, stream_ops)
+    edn = "\n".join([
+        '{:process 0, :type :invoke, :f :txn,'
+        ' :value [[:r :x nil] [:w :x 1]]}',
+        '{:process 0, :type :ok, :f :txn,'
+        ' :value [[:r :x nil] [:w :x 1]]}',
+        '{:process 1, :type :invoke, :f :txn, :value [[:r :x] [:w :x 2]]}',
+        '{:process 1, :type :ok, :f :txn,'
+        ' :value [[:r :x nil] [:w :x 2]]}',
+    ])
+    ops = parse_edn_history(edn)
+    assert [op.index for op in ops] == [0, 1, 2, 3]
+    assert ops[0].value == [["r", "x", None], ["w", "x", 1]]
+    store = Store(tmp_path)
+    srv = IngestServer(store).serve()
+    try:
+        r = stream_ops(srv.host, srv.port, "edn", "r1", ops,
+                       batch=2, attempts=5)
+    finally:
+        srv.shutdown()
+    assert r["acked"] == len(ops)
+    audit = sequence_audit(store.run_dir("edn", "r1") / WAL_FILE)
+    assert audit["ok"] and audit["ops"] == len(ops)
+    daemon = OnlineDaemon(store=store, config=OnlineConfig(
+        poll_s=0, check_interval_ops=2, crash_quiet_s=0))
+    for _ in range(4):
+        daemon.tick()
+        if daemon.tenants and all(t.status == "done"
+                                  for t in daemon.tenants.values()):
+            break
+    (t,) = daemon.tenants.values()
+    assert t.status == "done"
+    assert t.result["level"] == "read-committed"       # lost update
+    assert t.result["anomaly"] == "G2-item"
+    assert t.summary()["iso"] == "RC"
+    daemon.close()
+
+
+# ------------------------------------------- adapters, routing, model
+
+def test_checker_adapters_and_fleet_routing():
+    ops, _ = synth_txn_history(
+        TxnSpec(n_txns=4, seed=9, anomaly="write-skew"), 0)
+    r = IsolationChecker().check({}, None, ops)
+    assert (r["level"], r["valid"]) == ("snapshot-isolation", False)
+    rh = HostIsolationChecker().check({}, None, ops)
+    assert rh["level"] == r["level"] and rh["provenance"] == "host"
+
+    from jepsen_tpu.fleet import classify_history, route_check
+    assert classify_history(ops) == "txn"
+    reg = index([invoke_op(0, "write", 1), ok_op(0, "write", 1),
+                 invoke_op(0, "read", None), ok_op(0, "read", 1)])
+    rs, summary = route_check(cas_register(), [ops, reg])
+    assert rs[0]["level"] == "snapshot-isolation"
+    assert rs[0]["backend"].startswith("txn-")
+    assert rs[1]["valid"] is True
+    assert not rs[1]["backend"].startswith("txn-")
+
+
+def test_ladder_and_op_model_shape():
+    assert LADDER == ("none",) + ISO_LEVELS
+    assert [iso_abbrev(x) for x in LADDER] == \
+        ["NONE", "RU", "RC", "RR", "SI", "SER"]
+    assert iso_abbrev(None) == "?"
+    for v in (8, 16, 64):
+        m = txn_op_model(v)
+        assert m["matmuls"] == N_CYC_PLANES * closure_iters(v) + 1
+        assert m["macs"] == m["matmuls"] * v ** 3

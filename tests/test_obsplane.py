@@ -616,6 +616,26 @@ def test_bench_compare_covers_ingest_rates():
     assert not any(k.startswith("ingest.") for k in reg["rates"])
 
 
+def test_bench_compare_covers_isolation_rate():
+    """ISSUE 19 satellite: same guard for the isolation-certifier
+    section — ``isolation.hist_per_s`` is in RATE_KEYS, gated once
+    both sides carry the section, and silently skipped against
+    baselines that predate it."""
+    import importlib.util as _ilu
+    spec = _ilu.spec_from_file_location("bench", REPO / "bench.py")
+    bench = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    assert "isolation.hist_per_s" in bench.RATE_KEYS
+    prev = {"value": 100.0, "isolation": {"hist_per_s": 1000.0}}
+    cur = {"value": 100.0, "isolation": {"hist_per_s": 500.0}}
+    reg = bench.compare_bench(prev, cur, tolerance=0.2)
+    assert reg["regressions"] == ["isolation.hist_per_s"]
+    reg = bench.compare_bench({"value": 100.0}, cur, tolerance=0.2)
+    assert reg["ok"] is True
+    assert not any(k.startswith("isolation.") for k in reg["rates"])
+
+
 def test_telemetry_dir_constants_agree():
     from jepsen_tpu import store as store_mod
     assert store_mod.TELEMETRY_DIR == series.TELEMETRY_DIR

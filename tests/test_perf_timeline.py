@@ -94,6 +94,19 @@ def test_timeline_html(tmp_path):
     assert "process 0" in html and "process 2" in html
     assert html.count('class="op"') == 3
     assert "write" in html and "cas" in html
+    # Non-transactional histories never wear the isolation badge
+    # (the stylesheet ships either way; the span must not).
+    assert 'class="badge-iso">' not in html
+
+
+def test_timeline_isolation_badge():
+    """A transactional history's timeline is headed by the certified
+    highest isolation level (ISSUE 19 satellite — doc/isolation.md)."""
+    from jepsen_tpu.ops.synth_txn import TxnSpec, synth_txn_history
+    ops, _ = synth_txn_history(
+        TxnSpec(n_txns=4, seed=9, anomaly="write-skew"), 0)
+    html = render_html({"name": "txn"}, index([o.with_() for o in ops]))
+    assert 'class="badge-iso">iso:SI</span>' in html
 
 
 def test_invalid_analysis_renders_linear_svg(tmp_path):

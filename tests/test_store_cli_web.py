@@ -204,6 +204,42 @@ def test_web_404_body_and_content_types(store):
         srv.shutdown()
 
 
+def test_web_live_isolation_badge(tmp_path):
+    """ISSUE 19 satellite: /live wears the per-tenant ``iso:SI``-style
+    badge over HTTP — from the live registry's monitor level while a
+    daemon is tailing, and from the durable online-iso.json downgrade
+    record when none is."""
+    from jepsen_tpu.history.wal import WAL_FILE, WAL_MAGIC
+    from jepsen_tpu.store import ONLINE_ISO
+    base = tmp_path / "store"
+    for name in ("txnreg", "txnrec"):
+        d = base / name / "r1"
+        d.mkdir(parents=True)
+        (d / WAL_FILE).write_text(
+            json.dumps({"wal": WAL_MAGIC, "test": {"name": name},
+                        "seed": 0, "pid": 2 ** 22 + 12345,
+                        "phase": "setup"}) + "\n"
+            + json.dumps({"phase": "run", "wal_ops": 0}) + "\n")
+    (base / "txnrec" / "r1" / ONLINE_ISO).write_text(json.dumps(
+        {"level": "snapshot-isolation", "abbrev": "SI",
+         "prefix_ops": 12}))
+    store = Store(base)
+    store.save_online_registry(
+        {"tenants": {"txnreg/r1": {"status": "tailing",
+                                   "valid_so_far": True,
+                                   "checked_ops": 4, "iso": "RC"}}})
+    srv = serve(host="127.0.0.1", port=0, store=store)
+    try:
+        port = srv.server_address[1]
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/live",
+            timeout=10).read().decode()
+    finally:
+        srv.shutdown()
+    assert 'badge-iso">iso:RC' in page      # registry (live monitor)
+    assert 'badge-iso">iso:SI' in page      # durable downgrade record
+
+
 def test_web_overload_429_retry_after_json(store):
     """Ingest-plane satellite: with the online daemon's overload
     ladder at shed-or-worse, EVERY endpoint degrades gracefully — a
